@@ -1,0 +1,20 @@
+"""kubeflow_tpu — a TPU-pod-native ML platform control plane.
+
+A from-scratch rebuild of the capabilities of the Kubeflow control plane
+(reference: equinor/kubeflow) re-targeted at Google Cloud TPU pod slices:
+
+- CRD controllers (Notebook, Profile, Tensorboard) that materialize multi-host
+  TPU workloads as StatefulSets whose workers rendezvous over ICI/DCN,
+- a PodDefault mutating admission webhook that injects ``google.com/tpu``
+  slice resources and JAX coordinator/worker environment,
+- access management (KFAM), dashboard and CRUD web APIs,
+- a JAX/XLA workload layer (models, parallelism, Pallas ops, serving, Katib HPO)
+  replacing the reference's delegated CUDA/NCCL stack.
+
+The control-plane substrate (API machinery, MVCC store with watch streams,
+controller runtime) is implemented in-tree so the whole platform runs and is
+testable without an external Kubernetes cluster, while speaking the same REST
+and reconcile semantics as one.
+"""
+
+__version__ = "0.1.0"

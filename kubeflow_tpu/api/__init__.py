@@ -1,0 +1,14 @@
+from .meta import (  # noqa: F401
+    GroupVersionKind,
+    Resource,
+    REGISTRY,
+    api_version_of,
+    gvk_of,
+    match_label_selector,
+    matches_selector,
+    name_of,
+    namespace_of,
+    new_object,
+    owner_reference,
+    set_owner_reference,
+)

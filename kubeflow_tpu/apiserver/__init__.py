@@ -1,0 +1,2 @@
+from .store import ApiError, Conflict, Forbidden, Invalid, NotFound, Store, WatchEvent  # noqa: F401
+from .client import Client  # noqa: F401

@@ -1,0 +1,123 @@
+"""Typed-ish client over the Store.
+
+Controllers and web backends use this interface; it is shaped so an HTTP
+implementation against a real Kubernetes API server is a drop-in (same verbs,
+same addressing). Mirrors the role of controller-runtime's ``client.Client``
+in the reference controllers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..api.meta import REGISTRY, Resource
+from .store import NotFound, Store
+
+
+class Client:
+    def __init__(self, store: Store):
+        self.store = store
+
+    def _res(self, api_version: str, kind: str) -> Resource:
+        return REGISTRY.for_kind(api_version, kind)
+
+    # -- verbs --------------------------------------------------------------
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.store.create(obj)
+
+    def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        return self.store.get(self._res(api_version, kind), name, namespace)
+
+    def get_opt(
+        self, api_version: str, kind: str, name: str, namespace: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        return self.store.list(
+            self._res(api_version, kind),
+            namespace=namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+        )
+
+    def update(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.store.update(obj)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.store.update_status(obj)
+
+    def patch(
+        self, api_version: str, kind: str, name: str, patch: Dict[str, Any], namespace: Optional[str] = None
+    ) -> Dict[str, Any]:
+        return self.store.patch(self._res(api_version, kind), name, patch, namespace)
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        return self.store.delete(self._res(api_version, kind), name, namespace)
+
+    def delete_opt(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None:
+        try:
+            self.delete(api_version, kind, name, namespace)
+        except NotFound:
+            pass
+
+    def watch(self, api_version: str, kind: str, namespace: Optional[str] = None, **kw):
+        return self.store.watch(self._res(api_version, kind), namespace=namespace, **kw)
+
+    # -- helpers ------------------------------------------------------------
+    def create_or_get(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            return self.create(obj)
+        except Exception:
+            return self.get(
+                apimeta.api_version_of(obj), obj["kind"], apimeta.name_of(obj), apimeta.namespace_of(obj)
+            )
+
+    def emit_event(
+        self,
+        involved: Dict[str, Any],
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+        component: str = "kubeflow-tpu",
+    ) -> Dict[str, Any]:
+        """Record a v1 Event against an object (reference mirrors pod events
+        onto Notebook CRs — notebook_controller.go:90-109)."""
+        ns = apimeta.namespace_of(involved) or "default"
+        ev = apimeta.new_object(
+            "v1",
+            "Event",
+            name="",
+            namespace=ns,
+        )
+        ev["metadata"]["generateName"] = f"{apimeta.name_of(involved)}."
+        ev.update(
+            {
+                "involvedObject": {
+                    "apiVersion": apimeta.api_version_of(involved),
+                    "kind": involved.get("kind"),
+                    "name": apimeta.name_of(involved),
+                    "namespace": ns,
+                    "uid": apimeta.uid_of(involved),
+                },
+                "reason": reason,
+                "message": message,
+                "type": type_,
+                "source": {"component": component},
+                "firstTimestamp": Store.now(),
+                "lastTimestamp": Store.now(),
+                "count": 1,
+            }
+        )
+        return self.create(ev)

@@ -1,0 +1,394 @@
+"""In-process API storage: MVCC object store with watch streams.
+
+This is the platform's etcd+apiserver analog. Every object lives under a
+``group/version/plural`` bucket keyed by ``(namespace, name)``; a global
+monotonically increasing resourceVersion stamps each write; watchers receive
+ADDED/MODIFIED/DELETED events through bounded queues. Deletion honors
+finalizers the way Kubernetes does (set ``deletionTimestamp``, wait for
+finalizer removal) — the profile-controller's teardown path depends on this
+(reference: profile-controller/controllers/profile_controller.go:277-312).
+
+Admission hooks run on pod writes before persistence — the seam where the
+PodDefault mutating webhook attaches (reference: admission-webhook/main.go:443).
+A C++ storage core can replace the dict backend behind the same interface.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..api.meta import REGISTRY, Resource
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message
+
+    def to_status(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1",
+            "kind": "Status",
+            "status": "Failure",
+            "code": self.code,
+            "reason": self.reason,
+            "message": self.message,
+        }
+
+
+class NotFound(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class Conflict(ApiError):
+    code = 409
+    reason = "Conflict"
+
+
+class Invalid(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class Forbidden(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Dict[str, Any]
+
+
+# Admission hook signature: (operation, resource, obj) -> mutated obj (or raise
+# ApiError to reject). operation in {"CREATE", "UPDATE", "DELETE"}.
+AdmissionHook = Callable[[str, Resource, Dict[str, Any]], Dict[str, Any]]
+
+
+class _Watcher:
+    def __init__(self, key: str, namespace: Optional[str], selector: Optional[Dict[str, str]]):
+        self.key = key
+        self.namespace = namespace
+        self.selector = selector
+        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=4096)
+        self.closed = False
+
+    def matches(self, res_key: str, obj: Dict[str, Any]) -> bool:
+        if not fnmatch.fnmatch(res_key, self.key):
+            return False
+        if self.namespace is not None and apimeta.namespace_of(obj) != self.namespace:
+            return False
+        if self.selector:
+            labels = apimeta.labels_of(obj)
+            if any(labels.get(k) != v for k, v in self.selector.items()):
+                return False
+        return True
+
+    def send(self, event: WatchEvent) -> None:
+        if self.closed:
+            return
+        try:
+            self.queue.put_nowait(event)
+        except queue.Full:
+            # Slow watcher: drop it rather than block the write path; informers
+            # relist on close, same as an expired etcd watch window.
+            self.close()
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.queue.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def __iter__(self):
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            yield item
+
+
+class Store:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        # bucket key -> {(namespace or "", name) -> object}
+        self._data: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
+        self._watchers: List[_Watcher] = []
+        self._admission: List[AdmissionHook] = []
+
+    # -- admission ----------------------------------------------------------
+    def register_admission(self, hook: AdmissionHook) -> None:
+        self._admission.append(hook)
+
+    def _admit(self, op: str, res: Resource, obj: Dict[str, Any]) -> Dict[str, Any]:
+        for hook in self._admission:
+            obj = hook(op, res, obj)
+        return obj
+
+    # -- internals ----------------------------------------------------------
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _bucket(self, res: Resource) -> Dict[Tuple[str, str], Dict[str, Any]]:
+        return self._data.setdefault(res.key, {})
+
+    @staticmethod
+    def _obj_key(res: Resource, namespace: Optional[str], name: str) -> Tuple[str, str]:
+        return (namespace or "") if res.namespaced else "", name
+
+    def _notify(self, res: Resource, event: WatchEvent) -> None:
+        obj = event.object
+        for w in list(self._watchers):
+            if w.closed:
+                self._watchers.remove(w)
+                continue
+            if w.matches(res.key, obj):
+                w.send(WatchEvent(event.type, apimeta.deepcopy(obj)))
+
+    @staticmethod
+    def now() -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    # -- CRUD ---------------------------------------------------------------
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        res = REGISTRY.for_object(obj)
+        obj = apimeta.deepcopy(obj)
+        md = obj.setdefault("metadata", {})
+        name = md.get("name") or ""
+        if not name and md.get("generateName"):
+            name = md["generateName"] + uuid.uuid4().hex[:6]
+            md["name"] = name
+        if not name:
+            raise Invalid(f"{res.kind}: metadata.name required")
+        if res.namespaced and not md.get("namespace"):
+            raise Invalid(f"{res.kind} {name}: metadata.namespace required")
+        obj = self._admit("CREATE", res, obj)
+        with self._lock:
+            bucket = self._bucket(res)
+            key = self._obj_key(res, md.get("namespace"), name)
+            if key in bucket:
+                raise Conflict(f"{res.kind} {'/'.join(k for k in key if k)} already exists")
+            md["uid"] = md.get("uid") or str(uuid.uuid4())
+            md["creationTimestamp"] = self.now()
+            md["resourceVersion"] = self._next_rv()
+            md.setdefault("generation", 1)
+            bucket[key] = obj
+            self._notify(res, WatchEvent("ADDED", obj))
+            return apimeta.deepcopy(obj)
+
+    def get(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        with self._lock:
+            bucket = self._bucket(res)
+            key = self._obj_key(res, namespace, name)
+            if key not in bucket:
+                where = f" in {namespace}" if res.namespaced else ""
+                raise NotFound(f'{res.kind} "{name}" not found{where}')
+            return apimeta.deepcopy(bucket[key])
+
+    def list(
+        self,
+        res: Resource,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._bucket(res).items():
+                if res.namespaced and namespace is not None and ns != namespace:
+                    continue
+                if label_selector:
+                    labels = apimeta.labels_of(obj)
+                    if any(labels.get(k) != v for k, v in label_selector.items()):
+                        continue
+                if field_selector and not _match_fields(obj, field_selector):
+                    continue
+                out.append(apimeta.deepcopy(obj))
+            return out
+
+    def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
+        res = REGISTRY.for_object(obj)
+        obj = apimeta.deepcopy(obj)
+        md = obj.setdefault("metadata", {})
+        with self._lock:
+            bucket = self._bucket(res)
+            key = self._obj_key(res, md.get("namespace"), md.get("name", ""))
+            if key not in bucket:
+                raise NotFound(f'{res.kind} "{md.get("name")}" not found')
+            current = bucket[key]
+            cur_md = current["metadata"]
+            if md.get("resourceVersion") and md["resourceVersion"] != cur_md["resourceVersion"]:
+                raise Conflict(
+                    f"{res.kind} {md.get('name')}: resourceVersion mismatch "
+                    f"({md['resourceVersion']} != {cur_md['resourceVersion']})"
+                )
+            if subresource == "status":
+                # Status updates only replace .status.
+                merged = apimeta.deepcopy(current)
+                merged["status"] = obj.get("status", {})
+                obj = merged
+                md = obj["metadata"]
+            else:
+                obj = self._admit("UPDATE", res, obj)
+                md = obj.setdefault("metadata", {})
+                # Immutable fields survive.
+                md["uid"] = cur_md["uid"]
+                md["creationTimestamp"] = cur_md["creationTimestamp"]
+                if cur_md.get("deletionTimestamp"):
+                    md["deletionTimestamp"] = cur_md["deletionTimestamp"]
+                if _spec_changed(current, obj):
+                    md["generation"] = cur_md.get("generation", 1) + 1
+                else:
+                    md["generation"] = cur_md.get("generation", 1)
+            md["resourceVersion"] = self._next_rv()
+            bucket[key] = obj
+            self._notify(res, WatchEvent("MODIFIED", obj))
+            # Finalizer removal on a deleting object completes the delete.
+            if md.get("deletionTimestamp") and not md.get("finalizers"):
+                del bucket[key]
+                self._notify(res, WatchEvent("DELETED", obj))
+            return apimeta.deepcopy(obj)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.update(obj, subresource="status")
+
+    def patch(
+        self,
+        res: Resource,
+        name: str,
+        patch: Dict[str, Any],
+        namespace: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """RFC 7386 JSON merge patch (null deletes a key)."""
+        with self._lock:
+            current = self.get(res, name, namespace)
+            merged = _merge_patch(current, patch)
+            merged["metadata"]["resourceVersion"] = current["metadata"]["resourceVersion"]
+            return self.update(merged)
+
+    def delete(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        with self._lock:
+            bucket = self._bucket(res)
+            key = self._obj_key(res, namespace, name)
+            if key not in bucket:
+                where = f" in {namespace}" if res.namespaced else ""
+                raise NotFound(f'{res.kind} "{name}" not found{where}')
+            obj = bucket[key]
+            md = obj["metadata"]
+            if md.get("finalizers"):
+                if not md.get("deletionTimestamp"):
+                    md["deletionTimestamp"] = self.now()
+                    md["resourceVersion"] = self._next_rv()
+                    self._notify(res, WatchEvent("MODIFIED", obj))
+                return apimeta.deepcopy(obj)
+            del bucket[key]
+            self._notify(res, WatchEvent("DELETED", obj))
+            return apimeta.deepcopy(obj)
+
+    def delete_collection(
+        self, res: Resource, namespace: Optional[str] = None, label_selector: Optional[Dict[str, str]] = None
+    ) -> int:
+        n = 0
+        for obj in self.list(res, namespace=namespace, label_selector=label_selector):
+            try:
+                self.delete(res, apimeta.name_of(obj), apimeta.namespace_of(obj))
+                n += 1
+            except NotFound:
+                pass
+        return n
+
+    # -- watch --------------------------------------------------------------
+    def watch(
+        self,
+        res: Optional[Resource] = None,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        send_initial: bool = False,
+    ) -> _Watcher:
+        key = res.key if res else "*"
+        w = _Watcher(key, namespace, label_selector)
+        with self._lock:
+            if send_initial and res is not None:
+                for obj in self.list(res, namespace=namespace, label_selector=label_selector):
+                    w.send(WatchEvent("ADDED", obj))
+            self._watchers.append(w)
+        return w
+
+    # -- garbage collection (ownerReference cascade) ------------------------
+    def collect_garbage(self) -> int:
+        """Delete objects whose controller owner is gone (one sweep).
+
+        Kubernetes runs this in kube-controller-manager; here it is invoked by
+        the manager loop so e2e deletes cascade (Notebook → StatefulSet → Pod).
+        """
+        deleted = 0
+        with self._lock:
+            uids = set()
+            for bucket in self._data.values():
+                for obj in bucket.values():
+                    uids.add(obj["metadata"]["uid"])
+            doomed: List[Tuple[Resource, str, Optional[str]]] = []
+            for res_key, bucket in self._data.items():
+                for obj in bucket.values():
+                    refs = obj["metadata"].get("ownerReferences") or []
+                    if refs and all(r.get("uid") not in uids for r in refs):
+                        res = next(r for r in REGISTRY.all() if r.key == res_key)
+                        doomed.append((res, apimeta.name_of(obj), apimeta.namespace_of(obj)))
+        for res, name, ns in doomed:
+            try:
+                self.delete(res, name, ns)
+                deleted += 1
+            except NotFound:
+                pass
+        return deleted
+
+
+def _match_fields(obj: Dict[str, Any], field_selector: Dict[str, str]) -> bool:
+    for path, want in field_selector.items():
+        cur: Any = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return False
+            cur = cur[part]
+        if str(cur) != want:
+            return False
+    return True
+
+
+def _spec_changed(old: Dict[str, Any], new: Dict[str, Any]) -> bool:
+    for section in ("spec", "data"):
+        if old.get(section) != new.get(section):
+            return True
+    for field in ("labels", "annotations", "finalizers", "ownerReferences"):
+        if old["metadata"].get(field) != new.get("metadata", {}).get(field):
+            return True
+    return False
+
+
+def _merge_patch(target: Any, patch: Any) -> Any:
+    if not isinstance(patch, dict):
+        return apimeta.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    out = apimeta.deepcopy(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out

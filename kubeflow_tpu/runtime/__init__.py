@@ -1,0 +1,3 @@
+from .manager import Manager, Reconciler, Request, Result  # noqa: F401
+from . import reconcile  # noqa: F401
+from .metrics import MetricsRegistry, METRICS  # noqa: F401

@@ -1,0 +1,121 @@
+"""Prometheus-style metrics registry with text exposition.
+
+Every reference Go service exposes Prometheus counters/gauges (e.g.
+notebook-controller/pkg/metrics/metrics.go:13-60, access-management
+kfam/monitoring.go). This registry provides the same surface — counters,
+gauges, histograms, label sets, ``/metrics`` text format — stdlib-only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+class _Counter:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+
+class _Gauge:
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+
+class _Histogram:
+    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0)
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(self.BUCKETS) + 1)
+        self.sum = 0.0
+        self.total = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.total += 1
+        for i, b in enumerate(self.BUCKETS):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Dict[Tuple[Tuple[str, str], ...], object]] = {}
+        self._types: Dict[str, str] = {}
+
+    def _get(self, name: str, kind: str, factory, labels: Dict[str, str]):
+        with self._lock:
+            if name in self._types and self._types[name] != kind:
+                raise ValueError(f"metric {name} already registered as {self._types[name]}")
+            self._types[name] = kind
+            series = self._metrics.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = factory()
+            return series[key]
+
+    def counter(self, name: str, **labels: str) -> _Counter:
+        return self._get(name, "counter", _Counter, labels)
+
+    def gauge(self, name: str, **labels: str) -> _Gauge:
+        return self._get(name, "gauge", _Gauge, labels)
+
+    def histogram(self, name: str, **labels: str) -> _Histogram:
+        return self._get(name, "histogram", _Histogram, labels)
+
+    def value(self, name: str, **labels: str) -> float:
+        with self._lock:
+            series = self._metrics.get(name, {})
+            m = series.get(_label_key(labels))
+            return getattr(m, "value", 0.0) if m else 0.0
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                kind = self._types[name]
+                lines.append(f"# TYPE {name} {kind}")
+                for key, m in sorted(self._metrics[name].items()):
+                    label_str = ",".join(f'{k}="{v}"' for k, v in key)
+                    suffix = f"{{{label_str}}}" if label_str else ""
+                    if isinstance(m, _Histogram):
+                        cum = 0
+                        for i, b in enumerate(m.BUCKETS):
+                            cum += m.counts[i]
+                            le = ("," if label_str else "") + f'le="{b}"'
+                            lines.append(f"{name}_bucket{{{label_str}{le}}} {cum}")
+                        le = ("," if label_str else "") + 'le="+Inf"'
+                        lines.append(f"{name}_bucket{{{label_str}{le}}} {m.total}")
+                        lines.append(f"{name}_sum{suffix} {m.sum}")
+                        lines.append(f"{name}_count{suffix} {m.total}")
+                    else:
+                        lines.append(f"{name}{suffix} {m.value}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self._types.clear()
+
+
+METRICS = MetricsRegistry()

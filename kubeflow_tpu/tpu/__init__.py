@@ -1,0 +1,7 @@
+from .topology import (  # noqa: F401
+    ACCELERATORS,
+    AcceleratorType,
+    SliceTopology,
+    parse_topology,
+)
+from .env import jax_worker_env, coordinator_address  # noqa: F401

@@ -1,0 +1,70 @@
+"""JAX/TPU environment generation for multi-host pod slices.
+
+The reference era injected free-form GPU env (``NVIDIA_VISIBLE_DEVICES``,
+NCCL vars via images — example-notebook-servers/jupyter-pytorch/cuda.Dockerfile).
+Here the coordinator bootstrap is *deterministic and computable at admission
+time*: worker 0's address is the pod-0 DNS name of the workload's headless
+Service (the same service-DNS scheme the reference culler uses to reach
+notebooks — notebook-controller/pkg/culler/culler.go:138-144), and each
+worker derives its process id from its StatefulSet ordinal at runtime.
+Determinism matters because the PodDefault webhook rejects conflicting env
+(reference: admission-webhook/main.go:152-187) — regenerating the same env
+twice must be a no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .topology import SliceTopology
+
+JAX_COORDINATOR_PORT = 8476  # jax.distributed default
+ENV_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+ENV_PROCESS_ID = "TPU_WORKER_ID"
+ENV_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+
+
+def coordinator_address(
+    workload_name: str, namespace: str, cluster_domain: str = "cluster.local", port: int = JAX_COORDINATOR_PORT
+) -> str:
+    """pod-0 of the headless Service: <name>-0.<name>.<ns>.svc.<domain>:<port>."""
+    return f"{workload_name}-0.{workload_name}.{namespace}.svc.{cluster_domain}:{port}"
+
+
+def worker_hostnames(workload_name: str, namespace: str, num_hosts: int, cluster_domain: str = "cluster.local") -> str:
+    return ",".join(
+        f"{workload_name}-{i}.{workload_name}.{namespace}.svc.{cluster_domain}" for i in range(num_hosts)
+    )
+
+
+def jax_worker_env(
+    topology: SliceTopology,
+    workload_name: str,
+    namespace: str,
+    cluster_domain: str = "cluster.local",
+    extra: Optional[Dict[str, str]] = None,
+) -> List[Dict[str, str]]:
+    """Env var list (pod-spec shape) making a pod a JAX TPU slice worker.
+
+    ``TPU_WORKER_ID`` is left to runtime derivation from the StatefulSet
+    ordinal (hostname suffix) by ``kubeflow_tpu.parallel.distributed`` —
+    identical env on every pod keeps webhook injection deterministic.
+    """
+    env = {
+        "JAX_PLATFORMS": "tpu",
+        ENV_COORDINATOR_ADDRESS: coordinator_address(workload_name, namespace, cluster_domain),
+        ENV_NUM_PROCESSES: str(topology.num_hosts),
+        ENV_WORKER_HOSTNAMES: worker_hostnames(workload_name, namespace, topology.num_hosts, cluster_domain),
+        "TPU_ACCELERATOR_TYPE": topology.accelerator.gke_name,
+        "TPU_TOPOLOGY": topology.label,
+        "TPU_CHIPS_PER_HOST": str(topology.chips_per_pod),
+        "TPU_RUNTIME_METRICS_PORTS": "8431",
+    }
+    if extra:
+        env.update(extra)
+    return [{"name": k, "value": v} for k, v in sorted(env.items())]
+
+
+def env_list_to_dict(env: List[Dict[str, str]]) -> Dict[str, str]:
+    return {e["name"]: e.get("value", "") for e in env}

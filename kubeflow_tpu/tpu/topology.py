@@ -1,0 +1,151 @@
+"""TPU accelerator catalog and slice-topology math.
+
+This module replaces the reference's GPU vendor mechanism (spawner
+``gpus.vendors`` list + ``nvidia.com/gpu`` limits injection — reference:
+crud-web-apps/jupyter/backend/apps/common/form.py:262-287 and
+spawner_ui_config.yaml:141-154) with first-class TPU pod-slice topology:
+an accelerator catalog (v4/v5e/v5p/v6e), ``AxB[xC]`` topology parsing, and
+the host/chip math every other layer consumes:
+
+- the notebook controller sizes StatefulSets as ``replicas = num_hosts``,
+- the admission webhook injects ``google.com/tpu: chips_per_host`` limits and
+  GKE nodeSelectors,
+- the spawner validates user-picked topologies,
+- profile quotas count ``requests.google.com/tpu`` in chips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+RESOURCE_TPU = "google.com/tpu"
+NODE_LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+@dataclass(frozen=True)
+class AcceleratorType:
+    """One TPU generation as exposed by GKE node pools."""
+
+    generation: str           # "v5e"
+    gke_name: str             # value of cloud.google.com/gke-tpu-accelerator
+    dims: int                 # 2 = 2D torus slice topologies, 3 = 3D
+    chips_per_host: int       # chips visible to one pod/host in a multi-host slice
+    bf16_tflops_per_chip: float   # peak dense bf16 TFLOP/s (MFU denominators)
+    hbm_gib_per_chip: int
+    max_chips: int            # largest slice
+
+    def topologies(self) -> List["SliceTopology"]:
+        return [t for t in _KNOWN_TOPOLOGIES.get(self.generation, [])]
+
+
+ACCELERATORS: Dict[str, AcceleratorType] = {
+    a.generation: a
+    for a in [
+        AcceleratorType("v4", "tpu-v4-podslice", 3, 4, 275.0, 32, 4096),
+        AcceleratorType("v5e", "tpu-v5-lite-podslice", 2, 4, 197.0, 16, 256),
+        AcceleratorType("v5p", "tpu-v5p-slice", 3, 4, 459.0, 95, 8960),
+        AcceleratorType("v6e", "tpu-v6e-slice", 2, 4, 918.0, 32, 256),
+    ]
+}
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    generation: str
+    dims: Tuple[int, ...]
+
+    @property
+    def accelerator(self) -> AcceleratorType:
+        return ACCELERATORS[self.generation]
+
+    @property
+    def label(self) -> str:
+        return "x".join(str(d) for d in self.dims)
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def num_hosts(self) -> int:
+        """Pods (= TPU VM hosts) needed for this slice.
+
+        Single-host slices expose all their chips to one pod; multi-host
+        slices expose ``chips_per_host`` chips per pod.
+        """
+        cph = self.accelerator.chips_per_host
+        if self.num_chips <= cph:
+            return 1
+        if self.num_chips % cph:
+            raise ValueError(f"{self.generation} {self.label}: {self.num_chips} chips not divisible by {cph}")
+        return self.num_chips // cph
+
+    @property
+    def chips_per_pod(self) -> int:
+        return self.num_chips if self.num_hosts == 1 else self.accelerator.chips_per_host
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def node_selector(self) -> Dict[str, str]:
+        return {
+            NODE_LABEL_ACCELERATOR: self.accelerator.gke_name,
+            NODE_LABEL_TOPOLOGY: self.label,
+        }
+
+    def resource_limits(self) -> Dict[str, str]:
+        return {RESOURCE_TPU: str(self.chips_per_pod)}
+
+    def peak_bf16_tflops(self) -> float:
+        return self.num_chips * self.accelerator.bf16_tflops_per_chip
+
+
+def parse_topology(generation: str, label: str) -> SliceTopology:
+    """Parse e.g. ``("v5e", "4x8")`` or ``("v4", "2x2x4")`` with validation."""
+    if generation not in ACCELERATORS:
+        raise ValueError(f"unknown TPU generation {generation!r}; known: {sorted(ACCELERATORS)}")
+    acc = ACCELERATORS[generation]
+    try:
+        dims = tuple(int(p) for p in label.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"bad topology {label!r}: expected AxB[xC]") from None
+    if any(d < 1 for d in dims):
+        raise ValueError(f"bad topology {label!r}: dimensions must be >= 1")
+    if len(dims) != acc.dims:
+        raise ValueError(f"{generation} topologies are {acc.dims}D; got {label!r}")
+    topo = SliceTopology(generation, dims)
+    if topo.num_chips > acc.max_chips:
+        raise ValueError(f"{generation} {label}: {topo.num_chips} chips exceeds max {acc.max_chips}")
+    topo.num_hosts  # validates divisibility
+    return topo
+
+
+_KNOWN_TOPOLOGIES: Dict[str, List[SliceTopology]] = {
+    "v5e": [
+        SliceTopology("v5e", d)
+        for d in [(1, 1), (2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16), (16, 16)]
+    ],
+    "v6e": [
+        SliceTopology("v6e", d)
+        for d in [(1, 1), (2, 2), (2, 4), (4, 4), (4, 8), (8, 8), (8, 16), (16, 16)]
+    ],
+    "v4": [
+        SliceTopology("v4", d)
+        for d in [(2, 2, 1), (2, 2, 2), (2, 2, 4), (2, 4, 4), (4, 4, 4), (4, 4, 8), (4, 8, 8), (8, 8, 8)]
+    ],
+    "v5p": [
+        SliceTopology("v5p", d)
+        for d in [(2, 2, 1), (2, 2, 2), (2, 2, 4), (2, 4, 4), (4, 4, 4), (4, 4, 8), (4, 8, 8), (8, 8, 8)]
+    ],
+}
+
+
+def chips_in_quota(quantity: str) -> int:
+    """Parse a quota quantity for google.com/tpu (always integral chips)."""
+    return int(str(quantity))

@@ -1,0 +1,44 @@
+"""Test configuration: force JAX onto 8 virtual CPU devices.
+
+Multi-chip hardware is unavailable in CI; all sharding/parallelism tests run
+against a virtual 8-device CPU mesh (the reference's e2e harness likewise
+tests distributed control flow against CPU-only CI clusters — SURVEY.md §4).
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from kubeflow_tpu.apiserver.store import Store  # noqa: E402
+from kubeflow_tpu.apiserver.client import Client  # noqa: E402
+from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
+from kubeflow_tpu.runtime.metrics import METRICS  # noqa: E402
+
+
+@pytest.fixture()
+def store():
+    return Store()
+
+
+@pytest.fixture()
+def client(store):
+    return Client(store)
+
+
+@pytest.fixture()
+def manager():
+    mgr = Manager()
+    yield mgr
+    mgr.stop()
+
+
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    METRICS.reset()
+    yield

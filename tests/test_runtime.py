@@ -1,0 +1,147 @@
+"""Controller runtime: watch → queue → reconcile; helpers; metrics."""
+
+import threading
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.runtime import reconcile as rh
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Request, Result
+from kubeflow_tpu.runtime.metrics import METRICS, MetricsRegistry
+
+
+class EchoReconciler(Reconciler):
+    """Writes an annotation onto every Notebook it sees."""
+
+    FOR = ("kubeflow.org/v1beta1", "Notebook")
+
+    def __init__(self):
+        self.seen = []
+        self.event = threading.Event()
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        self.seen.append(req)
+        obj = client.get_opt(*self.FOR, req.name, req.namespace)
+        if obj is not None and "touched" not in (obj["metadata"].get("annotations") or {}):
+            obj["metadata"].setdefault("annotations", {})["touched"] = "1"
+            client.update(obj)
+        self.event.set()
+        return Result()
+
+
+def test_manager_dispatches_reconcile(manager):
+    rec = EchoReconciler()
+    manager.add(rec).start()
+    manager.client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb1", "default", spec={}))
+    assert rec.event.wait(5)
+    assert manager.wait_idle()
+    live = manager.client.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+    assert live["metadata"]["annotations"]["touched"] == "1"
+    assert Request("default", "nb1") in rec.seen
+
+
+def test_owned_object_events_map_to_owner(manager):
+    class OwnsReconciler(Reconciler):
+        FOR = ("kubeflow.org/v1beta1", "Notebook")
+        OWNS = [("apps/v1", "StatefulSet")]
+
+        def __init__(self):
+            self.requests = []
+
+        def reconcile(self, client, req):
+            self.requests.append(req)
+            return Result()
+
+    rec = OwnsReconciler()
+    manager.add(rec).start()
+    owner = manager.client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb", "ns1", spec={}))
+    manager.wait_idle()
+    rec.requests.clear()
+    sts = new_object("apps/v1", "StatefulSet", "nb", "ns1", spec={"replicas": 1})
+    from kubeflow_tpu.api import meta as apimeta
+
+    apimeta.set_owner_reference(sts, owner)
+    manager.client.create(sts)
+    manager.wait_idle()
+    assert Request("ns1", "nb") in rec.requests
+
+
+def test_failing_reconcile_retries_with_backoff(manager):
+    calls = []
+    done = threading.Event()
+
+    class Flaky(Reconciler):
+        FOR = ("kubeflow.org/v1beta1", "Notebook")
+
+        def reconcile(self, client, req):
+            calls.append(req)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            done.set()
+            return Result()
+
+    manager.add(Flaky()).start()
+    manager.client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb", "default", spec={}))
+    assert done.wait(10)
+    assert len(calls) >= 3
+    assert METRICS.value("controller_reconcile_total", controller="Flaky", result="error") == 2
+
+
+def test_requeue_after(manager):
+    hits = []
+    done = threading.Event()
+
+    class Periodic(Reconciler):
+        FOR = ("kubeflow.org/v1beta1", "Notebook")
+
+        def reconcile(self, client, req):
+            hits.append(req)
+            if len(hits) >= 3:
+                done.set()
+                return Result()
+            return Result(requeue_after=0.02)
+
+    manager.add(Periodic()).start()
+    manager.client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb", "default", spec={}))
+    assert done.wait(10)
+
+
+def test_reconcile_object_create_then_update(client):
+    owner = client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb", "ns", spec={}))
+    desired = new_object("apps/v1", "StatefulSet", "nb", "ns", spec={"replicas": 2, "template": {"spec": {}}})
+    live = rh.reconcile_object(client, desired, owner)
+    assert live["metadata"]["ownerReferences"][0]["name"] == "nb"
+    # Re-reconcile with same desired: no rv bump.
+    rv = live["metadata"]["resourceVersion"]
+    live2 = rh.reconcile_object(client, desired, owner)
+    assert live2["metadata"]["resourceVersion"] == rv
+    # Drift: someone scales it; reconcile restores.
+    drifted = client.get("apps/v1", "StatefulSet", "nb", "ns")
+    drifted["spec"]["replicas"] = 0
+    client.update(drifted)
+    live3 = rh.reconcile_object(client, desired, owner)
+    assert live3["spec"]["replicas"] == 2
+
+
+def test_service_reconcile_preserves_cluster_ip(client):
+    desired = new_object("v1", "Service", "svc", "ns", spec={"ports": [{"port": 80}], "type": "ClusterIP"})
+    live = rh.reconcile_object(client, desired)
+    live["spec"]["clusterIP"] = "10.0.0.42"  # cluster-assigned
+    client.update(live)
+    desired2 = new_object("v1", "Service", "svc", "ns", spec={"ports": [{"port": 81}], "type": "ClusterIP"})
+    live2 = rh.reconcile_object(client, desired2)
+    assert live2["spec"]["clusterIP"] == "10.0.0.42"
+    assert live2["spec"]["ports"] == [{"port": 81}]
+
+
+def test_metrics_registry_render():
+    reg = MetricsRegistry()
+    reg.counter("requests_total", code="200").inc()
+    reg.counter("requests_total", code="500").inc(2)
+    reg.gauge("notebook_running", namespace="a").set(3)
+    reg.histogram("latency_seconds").observe(0.002)
+    text = reg.render()
+    assert 'requests_total{code="200"} 1.0' in text
+    assert 'requests_total{code="500"} 2.0' in text
+    assert 'notebook_running{namespace="a"} 3.0' in text
+    assert "latency_seconds_count 1" in text
+    assert reg.value("requests_total", code="500") == 2.0

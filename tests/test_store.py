@@ -1,0 +1,166 @@
+"""Store CRUD, resourceVersion, finalizers, watches, GC."""
+
+import pytest
+
+from kubeflow_tpu.api import meta as apimeta
+from kubeflow_tpu.api.meta import REGISTRY, new_object
+from kubeflow_tpu.apiserver.store import Conflict, Invalid, NotFound, Store
+
+PODS = REGISTRY.for_kind("v1", "Pod")
+NS = REGISTRY.for_kind("v1", "Namespace")
+
+
+def mkpod(name="p1", ns="default", labels=None):
+    return new_object("v1", "Pod", name, ns, labels=labels, spec={"containers": []})
+
+
+def test_create_get_roundtrip(store):
+    created = store.create(mkpod())
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    got = store.get(PODS, "p1", "default")
+    assert got["metadata"]["uid"] == created["metadata"]["uid"]
+
+
+def test_create_requires_namespace_for_namespaced(store):
+    with pytest.raises(Invalid):
+        store.create(new_object("v1", "Pod", "p1"))
+
+
+def test_cluster_scoped_needs_no_namespace(store):
+    store.create(new_object("v1", "Namespace", "team-a"))
+    assert store.get(NS, "team-a")["metadata"]["name"] == "team-a"
+
+
+def test_duplicate_create_conflicts(store):
+    store.create(mkpod())
+    with pytest.raises(Conflict):
+        store.create(mkpod())
+
+
+def test_generate_name(store):
+    obj = new_object("v1", "Pod", "", "default", spec={})
+    obj["metadata"] = {"generateName": "trial-", "namespace": "default"}
+    created = store.create(obj)
+    assert created["metadata"]["name"].startswith("trial-")
+
+
+def test_update_bumps_rv_and_checks_conflict(store):
+    obj = store.create(mkpod())
+    obj["spec"]["containers"] = [{"name": "c"}]
+    updated = store.update(obj)
+    assert int(updated["metadata"]["resourceVersion"]) > int(obj["metadata"]["resourceVersion"])
+    stale = dict(obj)
+    with pytest.raises(Conflict):
+        store.update(stale)
+
+
+def test_generation_increments_only_on_spec_change(store):
+    obj = store.create(mkpod())
+    assert obj["metadata"]["generation"] == 1
+    obj["status"] = {"phase": "Running"}
+    updated = store.update_status(obj)
+    assert updated["metadata"]["generation"] == 1
+    updated["spec"] = {"containers": [{"name": "x"}]}
+    updated = store.update(updated)
+    assert updated["metadata"]["generation"] == 2
+
+
+def test_status_subresource_only_touches_status(store):
+    obj = store.create(mkpod())
+    hacked = apimeta.deepcopy(obj)
+    hacked["spec"] = {"containers": [{"name": "evil"}]}
+    hacked["status"] = {"phase": "Running"}
+    store.update_status(hacked)
+    live = store.get(PODS, "p1", "default")
+    assert live["spec"] == {"containers": []}
+    assert live["status"] == {"phase": "Running"}
+
+
+def test_delete_and_notfound(store):
+    store.create(mkpod())
+    store.delete(PODS, "p1", "default")
+    with pytest.raises(NotFound):
+        store.get(PODS, "p1", "default")
+
+
+def test_finalizers_defer_deletion(store):
+    obj = mkpod()
+    obj["metadata"]["finalizers"] = ["example.com/cleanup"]
+    store.create(obj)
+    deleting = store.delete(PODS, "p1", "default")
+    assert deleting["metadata"]["deletionTimestamp"]
+    # Object still present until finalizer removed.
+    live = store.get(PODS, "p1", "default")
+    live["metadata"]["finalizers"] = []
+    store.update(live)
+    with pytest.raises(NotFound):
+        store.get(PODS, "p1", "default")
+
+
+def test_list_with_label_selector(store):
+    store.create(mkpod("a", labels={"app": "x"}))
+    store.create(mkpod("b", labels={"app": "y"}))
+    store.create(mkpod("c", ns="other", labels={"app": "x"}))
+    assert {p["metadata"]["name"] for p in store.list(PODS, "default", {"app": "x"})} == {"a"}
+    assert len(store.list(PODS, label_selector={"app": "x"})) == 2
+
+
+def test_field_selector(store):
+    obj = mkpod("a")
+    obj["involvedObject"] = {"kind": "Notebook", "name": "nb"}
+    store.create(obj)
+    store.create(mkpod("b"))
+    out = store.list(PODS, "default", field_selector={"involvedObject.name": "nb"})
+    assert [p["metadata"]["name"] for p in out] == ["a"]
+
+
+def test_merge_patch(store):
+    store.create(mkpod("a", labels={"keep": "1", "drop": "2"}))
+    store.patch(PODS, "a", {"metadata": {"labels": {"drop": None, "new": "3"}}}, "default")
+    live = store.get(PODS, "a", "default")
+    assert live["metadata"]["labels"] == {"keep": "1", "new": "3"}
+
+
+def test_watch_receives_lifecycle_events(store):
+    w = store.watch(PODS, namespace="default")
+    store.create(mkpod("a"))
+    obj = store.get(PODS, "a", "default")
+    obj["spec"]["containers"] = [{"name": "c"}]
+    store.update(obj)
+    store.delete(PODS, "a", "default")
+    events = [w.queue.get(timeout=1) for _ in range(3)]
+    assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+    w.close()
+
+
+def test_watch_send_initial(store):
+    store.create(mkpod("pre"))
+    w = store.watch(PODS, send_initial=True)
+    ev = w.queue.get(timeout=1)
+    assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "pre"
+    w.close()
+
+
+def test_garbage_collection_cascade(store):
+    owner = store.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb", "default", spec={}))
+    child = mkpod("child")
+    apimeta.set_owner_reference(child, owner)
+    store.create(child)
+    assert store.collect_garbage() == 0
+    nb_res = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+    store.delete(nb_res, "nb", "default")
+    assert store.collect_garbage() == 1
+    with pytest.raises(NotFound):
+        store.get(PODS, "child", "default")
+
+
+def test_admission_hook_mutates_on_create(store):
+    def hook(op, res, obj):
+        if op == "CREATE" and res.kind == "Pod":
+            obj.setdefault("metadata", {}).setdefault("annotations", {})["mutated"] = "yes"
+        return obj
+
+    store.register_admission(hook)
+    created = store.create(mkpod())
+    assert created["metadata"]["annotations"]["mutated"] == "yes"

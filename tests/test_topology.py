@@ -1,0 +1,83 @@
+"""TPU topology math + env generation."""
+
+import pytest
+
+from kubeflow_tpu.tpu.env import coordinator_address, env_list_to_dict, jax_worker_env
+from kubeflow_tpu.tpu.topology import ACCELERATORS, parse_topology
+
+
+def test_v5e_known_slices():
+    cases = {
+        "1x1": (1, 1, 1),
+        "2x2": (4, 1, 4),
+        "2x4": (8, 2, 4),
+        "4x4": (16, 4, 4),
+        "4x8": (32, 8, 4),
+        "16x16": (256, 64, 4),
+    }
+    for label, (chips, hosts, per_pod) in cases.items():
+        t = parse_topology("v5e", label)
+        assert t.num_chips == chips
+        assert t.num_hosts == hosts
+        assert t.chips_per_pod == per_pod
+
+
+def test_v4_3d_topologies():
+    t = parse_topology("v4", "2x2x4")
+    assert t.num_chips == 16 and t.num_hosts == 4
+    with pytest.raises(ValueError):
+        parse_topology("v4", "2x4")  # v4 is 3D
+
+
+def test_invalid_topologies():
+    with pytest.raises(ValueError):
+        parse_topology("v5e", "3x5x7")
+    with pytest.raises(ValueError):
+        parse_topology("v5e", "bogus")
+    with pytest.raises(ValueError):
+        parse_topology("v9x", "2x2")
+    with pytest.raises(ValueError):
+        parse_topology("v5e", "64x64")  # > 256 chips
+
+
+def test_node_selector_and_limits():
+    t = parse_topology("v5e", "4x8")
+    assert t.node_selector() == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x8",
+    }
+    assert t.resource_limits() == {"google.com/tpu": "4"}
+
+
+def test_single_host_gets_all_chips():
+    t = parse_topology("v5e", "2x4")
+    assert t.is_multi_host
+    single = parse_topology("v5e", "2x2")
+    assert not single.is_multi_host
+    assert single.resource_limits() == {"google.com/tpu": "4"}
+
+
+def test_peak_flops():
+    t = parse_topology("v5e", "4x8")
+    assert t.peak_bf16_tflops() == 32 * ACCELERATORS["v5e"].bf16_tflops_per_chip
+
+
+def test_coordinator_address_is_pod0_headless_dns():
+    assert (
+        coordinator_address("mynb", "team-a")
+        == "mynb-0.mynb.team-a.svc.cluster.local:8476"
+    )
+
+
+def test_jax_worker_env_deterministic_and_complete():
+    t = parse_topology("v5e", "4x8")
+    env1 = jax_worker_env(t, "nb", "ns1")
+    env2 = jax_worker_env(t, "nb", "ns1")
+    assert env1 == env2  # determinism: webhook re-injection must not conflict
+    d = env_list_to_dict(env1)
+    assert d["JAX_COORDINATOR_ADDRESS"] == "nb-0.nb.ns1.svc.cluster.local:8476"
+    assert d["JAX_NUM_PROCESSES"] == "8"
+    assert d["JAX_PLATFORMS"] == "tpu"
+    assert d["TPU_TOPOLOGY"] == "4x8"
+    assert d["TPU_WORKER_HOSTNAMES"].split(",")[0] == "nb-0.nb.ns1.svc.cluster.local"
+    assert len(d["TPU_WORKER_HOSTNAMES"].split(",")) == 8
