@@ -178,6 +178,7 @@ class Store:
         if res.namespaced and not md.get("namespace"):
             raise Invalid(f"{res.kind} {name}: metadata.namespace required")
         obj = self._admit("CREATE", res, obj)
+        md = obj.setdefault("metadata", {})  # hooks may return a fresh copy
         with self._lock:
             bucket = self._bucket(res)
             key = self._obj_key(res, md.get("namespace"), name)
@@ -255,6 +256,12 @@ class Store:
                     md["generation"] = cur_md.get("generation", 1) + 1
                 else:
                     md["generation"] = cur_md.get("generation", 1)
+            # No-op writes neither bump resourceVersion nor notify — without
+            # this, a controller that unconditionally writes status would
+            # requeue itself forever (controllers in the reference rely on
+            # apiserver-side semantic no-op detection the same way).
+            if _equal_ignoring_rv(current, obj):
+                return apimeta.deepcopy(current)
             md["resourceVersion"] = self._next_rv()
             bucket[key] = obj
             self._notify(res, WatchEvent("MODIFIED", obj))
@@ -356,6 +363,15 @@ class Store:
             except NotFound:
                 pass
         return deleted
+
+
+def _equal_ignoring_rv(old: Dict[str, Any], new: Dict[str, Any]) -> bool:
+    a = apimeta.deepcopy(old)
+    b = apimeta.deepcopy(new)
+    for o in (a, b):
+        o.get("metadata", {}).pop("resourceVersion", None)
+        o.get("metadata", {}).pop("generation", None)
+    return a == b
 
 
 def _match_fields(obj: Dict[str, Any], field_selector: Dict[str, str]) -> bool:

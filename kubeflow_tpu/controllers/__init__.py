@@ -1,0 +1,2 @@
+from .builtin import DeploymentReconciler, PodletReconciler, StatefulSetReconciler  # noqa: F401
+from .notebook import NotebookReconciler, NotebookConfig  # noqa: F401

@@ -1,0 +1,271 @@
+"""Cluster-substrate controllers: StatefulSet/Deployment → Pods, fake kubelet.
+
+The reference runs against a real Kubernetes cluster whose controller-manager
+and kubelets materialize pods; its envtest suites explicitly *cannot* observe
+pods (notebook_controller_bdd_test.go:71-75 — only the API server runs).
+This module closes that gap for the TPU build: a minimal in-process
+controller-manager + "podlet" that schedules pods onto fake TPU nodes
+(nodes advertising ``google.com/tpu`` capacity — the fixture SURVEY.md §4
+calls for), so e2e flows (spawn → webhook injection → scheduling → Running)
+are testable without a cluster or real chips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.manager import Reconciler, Request, Result
+from ..tpu.topology import RESOURCE_TPU
+
+
+def _pod_for_template(
+    owner: Dict[str, Any], name: str, template: Dict[str, Any], extra_labels: Dict[str, str]
+) -> Dict[str, Any]:
+    tmpl_meta = template.get("metadata", {})
+    labels = dict(tmpl_meta.get("labels") or {})
+    labels.update(extra_labels)
+    pod = apimeta.new_object(
+        "v1",
+        "Pod",
+        name,
+        apimeta.namespace_of(owner),
+        labels=labels,
+        annotations=dict(tmpl_meta.get("annotations") or {}),
+        spec=apimeta.deepcopy(template.get("spec", {})),
+    )
+    apimeta.set_owner_reference(pod, owner)
+    return pod
+
+
+class StatefulSetReconciler(Reconciler):
+    """Materializes ordinal pods with stable hostnames + subdomain DNS —
+    exactly the properties the JAX coordinator bootstrap relies on."""
+
+    FOR = ("apps/v1", "StatefulSet")
+    OWNS = [("v1", "Pod")]
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        sts = client.get_opt(*self.FOR, req.name, req.namespace)
+        if sts is None:
+            return Result()
+        spec = sts.get("spec", {})
+        replicas = spec.get("replicas", 1)
+        template = spec.get("template", {})
+        service_name = spec.get("serviceName") or req.name
+        selector_labels = (spec.get("selector") or {}).get("matchLabels") or {}
+
+        existing = {
+            apimeta.name_of(p): p
+            for p in client.list("v1", "Pod", req.namespace)
+            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(sts)
+        }
+        want_names = [f"{req.name}-{i}" for i in range(replicas)]
+        for i, name in enumerate(want_names):
+            if name in existing:
+                continue
+            pod = _pod_for_template(sts, name, template, selector_labels)
+            pod["spec"]["hostname"] = name
+            pod["spec"]["subdomain"] = service_name
+            pod["metadata"].setdefault("annotations", {})[
+                "apps.kubernetes.io/pod-index"
+            ] = str(i)
+            pod["metadata"].setdefault("labels", {})[
+                "statefulset.kubernetes.io/pod-name"
+            ] = name
+            client.create(pod)
+        for name in set(existing) - set(want_names):
+            client.delete_opt("v1", "Pod", name, req.namespace)
+        # Pod template drift → recreate (simplified rolling update).
+        for name in want_names:
+            pod = existing.get(name)
+            if pod is None:
+                continue
+            if _template_drifted(pod["spec"], template.get("spec", {})):
+                client.delete_opt("v1", "Pod", name, req.namespace)
+
+        pods = [
+            p
+            for p in client.list("v1", "Pod", req.namespace)
+            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(sts)
+        ]
+        ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
+        sts["status"] = {"replicas": len(pods), "readyReplicas": ready, "currentReplicas": len(pods)}
+        client.update_status(sts)
+        return Result()
+
+
+def _template_drifted(live_spec: Dict[str, Any], want_spec: Dict[str, Any]) -> bool:
+    """Compare the fields the template owns, ignoring admission-injected ones.
+
+    The webhook mutates pods at creation (env/resources/nodeSelector), so a
+    naive spec comparison would bounce pods forever. Compare container
+    image/command and counts only.
+    """
+    live_c = live_spec.get("containers") or []
+    want_c = want_spec.get("containers") or []
+    if len(live_c) != len(want_c):
+        return True
+    for lc, wc in zip(live_c, want_c):
+        for field in ("image", "command", "args", "name"):
+            if lc.get(field) != wc.get(field):
+                return True
+    return False
+
+
+class DeploymentReconciler(Reconciler):
+    """Deployment → pods (no ReplicaSet indirection; tensorboards and web
+    apps only need replica maintenance)."""
+
+    FOR = ("apps/v1", "Deployment")
+    OWNS = [("v1", "Pod")]
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        dep = client.get_opt(*self.FOR, req.name, req.namespace)
+        if dep is None:
+            return Result()
+        spec = dep.get("spec", {})
+        replicas = spec.get("replicas", 1)
+        template = spec.get("template", {})
+        selector_labels = (spec.get("selector") or {}).get("matchLabels") or {}
+        existing = {
+            apimeta.name_of(p): p
+            for p in client.list("v1", "Pod", req.namespace)
+            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(dep)
+        }
+        want_names = [f"{req.name}-{i}" for i in range(replicas)]
+        for name in want_names:
+            if name not in existing:
+                client.create(_pod_for_template(dep, name, template, selector_labels))
+        for name in set(existing) - set(want_names):
+            client.delete_opt("v1", "Pod", name, req.namespace)
+        pods = [
+            p
+            for p in client.list("v1", "Pod", req.namespace)
+            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(dep)
+        ]
+        ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
+        dep["status"] = {
+            "replicas": len(pods),
+            "readyReplicas": ready,
+            "availableReplicas": ready,
+            "conditions": [
+                {
+                    "type": "Available",
+                    "status": "True" if ready >= replicas else "False",
+                    "reason": "MinimumReplicasAvailable" if ready >= replicas else "MinimumReplicasUnavailable",
+                }
+            ],
+        }
+        client.update_status(dep)
+        return Result()
+
+
+class PodletReconciler(Reconciler):
+    """Fake scheduler + kubelet: binds pods to nodes and runs containers.
+
+    Scheduling honors nodeSelector and extended-resource capacity
+    (``google.com/tpu``), so tests exercise the same admission → selector →
+    capacity path a GKE TPU node pool enforces. With zero nodes in the store
+    the cluster is treated as unschedulable-free (pods just run) to keep
+    non-scheduling tests lightweight.
+    """
+
+    FOR = ("v1", "Pod")
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        pod = client.get_opt("v1", "Pod", req.name, req.namespace)
+        if pod is None or pod.get("status", {}).get("phase") == "Running":
+            return Result()
+        nodes = client.list("v1", "Node")
+        node_name = None
+        if nodes:
+            node_name = self._schedule(client, pod, nodes)
+            if node_name is None:
+                pod["status"] = {
+                    "phase": "Pending",
+                    "conditions": [
+                        {"type": "PodScheduled", "status": "False", "reason": "Unschedulable"}
+                    ],
+                }
+                client.update_status(pod)
+                # Retry scheduling: capacity may free when another slice stops
+                # (kube-scheduler's backoff-and-retry behavior).
+                return Result(requeue_after=0.25)
+            pod["spec"]["nodeName"] = node_name
+            client.update(pod)
+            pod = client.get("v1", "Pod", req.name, req.namespace)
+        pod["status"] = {
+            "phase": "Running",
+            "podIP": "10.1.0.1",
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Ready", "status": "True"},
+            ],
+            "containerStatuses": [
+                {
+                    "name": c.get("name", "main"),
+                    "ready": True,
+                    "restartCount": 0,
+                    "state": {"running": {"startedAt": client.store.now()}},
+                }
+                for c in pod.get("spec", {}).get("containers", [])
+            ],
+        }
+        client.update_status(pod)
+        return Result()
+
+    def _schedule(self, client: Client, pod: Dict[str, Any], nodes: List[Dict[str, Any]]) -> Optional[str]:
+        selector = pod.get("spec", {}).get("nodeSelector") or {}
+        tpu_request = 0
+        for c in pod.get("spec", {}).get("containers", []):
+            limits = (c.get("resources") or {}).get("limits") or {}
+            tpu_request += int(limits.get(RESOURCE_TPU, 0))
+        for node in nodes:
+            labels = apimeta.labels_of(node)
+            if any(labels.get(k) != v for k, v in selector.items()):
+                continue
+            capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
+            if tpu_request:
+                if capacity < tpu_request:
+                    continue
+                used = self._tpu_in_use(client, apimeta.name_of(node), exclude=apimeta.uid_of(pod))
+                if used + tpu_request > capacity:
+                    continue
+            return apimeta.name_of(node)
+        return None
+
+    def _tpu_in_use(self, client: Client, node_name: str, exclude: str) -> int:
+        total = 0
+        for p in client.list("v1", "Pod"):
+            if p.get("spec", {}).get("nodeName") != node_name or apimeta.uid_of(p) == exclude:
+                continue
+            for c in p.get("spec", {}).get("containers", []):
+                limits = (c.get("resources") or {}).get("limits") or {}
+                total += int(limits.get(RESOURCE_TPU, 0))
+        return total
+
+
+def make_tpu_node(name: str, generation: str, topology_label: str, chips: int) -> Dict[str, Any]:
+    """Fixture: a GKE-shaped TPU node (SURVEY §4 'fake TPU node fixture')."""
+    from ..tpu.topology import ACCELERATORS, NODE_LABEL_ACCELERATOR, NODE_LABEL_TOPOLOGY
+
+    acc = ACCELERATORS[generation]
+    node = apimeta.new_object(
+        "v1",
+        "Node",
+        name,
+        labels={
+            NODE_LABEL_ACCELERATOR: acc.gke_name,
+            NODE_LABEL_TOPOLOGY: topology_label,
+            "cloud.google.com/gke-nodepool": f"tpu-{generation}-pool",
+        },
+        spec={"providerID": f"gce://tpu-project/us-central2-b/{name}"},
+    )
+    node["status"] = {
+        "capacity": {RESOURCE_TPU: str(chips), "cpu": "96", "memory": "340Gi"},
+        "allocatable": {RESOURCE_TPU: str(chips)},
+        "conditions": [{"type": "Ready", "status": "True"}],
+    }
+    return node

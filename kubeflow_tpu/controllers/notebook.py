@@ -1,0 +1,378 @@
+"""Notebook controller: TPU-slice-aware workload reconciliation.
+
+Re-implements the reference notebook-controller
+(components/notebook-controller/controllers/notebook_controller.go) with the
+structural changes the TPU re-targeting demands (SURVEY.md §7 step 3):
+
+- ``replicas = num_hosts(topology)`` instead of the reference's hard-coded 1
+  (notebook_controller.go:302): a multi-host slice notebook is one
+  StatefulSet with one pod per TPU VM host.
+- A *headless* governing Service named after the notebook provides the
+  stable per-pod DNS the JAX coordinator bootstrap needs; a separate
+  ClusterIP Service ``<name>-http`` carries UI traffic (the reference's
+  single ClusterIP Service — generateService :368-395 — cannot provide
+  per-pod A records).
+- Culling aggregates idleness across hosts and stops the whole slice
+  (annotation ``kubeflow-resource-stopped`` scaling replicas→0, same
+  mechanism as pkg/culler/culler.go:37,91-135).
+- Event mirroring: pod/StatefulSet events re-emitted onto the Notebook CR
+  (notebook_controller.go:90-109, nbNameFromInvolvedObject :539).
+- Prometheus metrics keep the reference names (pkg/metrics/metrics.go:13-60)
+  plus TPU chip gauges.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.manager import Reconciler, Request, Result
+from ..runtime.metrics import METRICS
+from ..runtime import reconcile as rh
+from ..tpu.env import JAX_COORDINATOR_PORT
+from ..tpu.topology import SliceTopology, parse_topology
+
+STOP_ANNOTATION = "kubeflow-resource-stopped"  # reference: culler.go:37
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+HTTP_REWRITE_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
+HEADERS_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
+NOTEBOOK_NAME_LABEL = "notebook-name"
+DEFAULT_CONTAINER_PORT = 8888
+DEFAULT_FSGROUP = 100
+
+
+@dataclass
+class NotebookConfig:
+    """Env-knob surface of the reference controller (main.go + culler.go:24-27)."""
+
+    use_istio: bool = True
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    enable_culling: bool = False
+    idle_time_minutes: int = 1440
+    culling_check_period_minutes: int = 1
+    add_fsgroup: bool = True
+    # Idleness prober: (notebook) -> last_activity epoch seconds or None.
+    # Production probes Jupyter's /api/status over HTTP per host
+    # (culler.go:138-169); tests inject a fake.
+    activity_prober: Optional[Callable[[Dict[str, Any]], Optional[float]]] = None
+
+
+def tpu_topology_of(notebook: Dict[str, Any]) -> Optional[SliceTopology]:
+    tpu = notebook.get("spec", {}).get("tpu")
+    if not tpu:
+        return None
+    return parse_topology(tpu["generation"], tpu["topology"])
+
+
+def is_stopped(obj: Dict[str, Any]) -> bool:
+    return STOP_ANNOTATION in apimeta.annotations_of(obj)
+
+
+class NotebookReconciler(Reconciler):
+    FOR = ("kubeflow.org/v1beta1", "Notebook")
+    OWNS = [
+        ("apps/v1", "StatefulSet"),
+        ("v1", "Service"),
+        ("networking.istio.io/v1beta1", "VirtualService"),
+    ]
+
+    def __init__(self, config: Optional[NotebookConfig] = None):
+        self.config = config or NotebookConfig()
+
+    def watches(self):
+        def map_pod(pod: Dict[str, Any]) -> List[Request]:
+            nb = apimeta.labels_of(pod).get(NOTEBOOK_NAME_LABEL)
+            return [Request(apimeta.namespace_of(pod), nb)] if nb else []
+
+        def map_event(ev: Dict[str, Any]) -> List[Request]:
+            name = _nb_name_from_involved_object(ev)
+            if name:
+                return [Request(ev.get("involvedObject", {}).get("namespace"), name)]
+            return []
+
+        return [(("v1", "Pod"), map_pod), (("v1", "Event"), map_event)]
+
+    # -- reconcile -----------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        nb = client.get_opt(*self.FOR, req.name, req.namespace)
+        if nb is None:
+            return Result()
+
+        self._mirror_child_events(client, nb)
+
+        try:
+            sts = self._generate_statefulset(nb)
+        except ValueError as e:
+            # Invalid spec (bad tpu topology etc.): terminal, not retryable —
+            # surface it instead of crash-looping (the reference validates at
+            # spawn time; CRs can still arrive malformed via kubectl).
+            METRICS.counter("notebook_create_failed_total").inc()
+            nb = apimeta.deepcopy(nb)
+            nb["status"] = {
+                "conditions": [
+                    {"type": "Failed", "status": "True", "reason": "InvalidSpec", "message": str(e)}
+                ]
+            }
+            client.update_status(nb)
+            existing = [
+                ev
+                for ev in client.list("v1", "Event", req.namespace)
+                if ev.get("involvedObject", {}).get("name") == req.name
+                and ev.get("reason") == "InvalidSpec"
+            ]
+            if not existing:
+                client.emit_event(nb, "InvalidSpec", str(e), type_="Warning")
+            return Result()
+        live_sts = client.get_opt("apps/v1", "StatefulSet", req.name, req.namespace)
+        created = live_sts is None
+        rh.reconcile_object(client, sts, nb)
+        if created:
+            METRICS.counter("notebook_create_total").inc()
+
+        rh.reconcile_object(client, self._generate_headless_service(nb), nb)
+        rh.reconcile_object(client, self._generate_http_service(nb), nb)
+        if self.config.use_istio:
+            rh.reconcile_object(client, self._generate_virtual_service(nb), nb)
+
+        self._update_status(client, nb)
+        self._update_running_gauge(client, req.namespace)
+
+        if self.config.enable_culling and not is_stopped(nb):
+            return self._check_culling(client, nb)
+        return Result()
+
+    # -- generators ----------------------------------------------------------
+    def _generate_statefulset(self, nb: Dict[str, Any]) -> Dict[str, Any]:
+        name = apimeta.name_of(nb)
+        ns = apimeta.namespace_of(nb)
+        topo = tpu_topology_of(nb)
+        replicas = 0 if is_stopped(nb) else (topo.num_hosts if topo else 1)
+
+        template = apimeta.deepcopy(nb.get("spec", {}).get("template") or {"spec": {"containers": [{}]}})
+        pod_meta = template.setdefault("metadata", {})
+        pod_labels = pod_meta.setdefault("labels", {})
+        # Copy notebook labels onto pods — PodDefault matching depends on it
+        # (reference: notebook_controller.go:328-332).
+        pod_labels.update(apimeta.labels_of(nb))
+        pod_labels[NOTEBOOK_NAME_LABEL] = name
+        pod_labels["app"] = name
+        pod_labels["statefulset"] = name  # must cover the selector below
+
+        spec = template.setdefault("spec", {})
+        containers = spec.setdefault("containers", [{}])
+        if not containers:
+            containers.append({})
+        first = containers[0]
+        first.setdefault("name", name)
+        first.setdefault("workingDir", "/home/jovyan")
+        ports = first.setdefault("ports", [])
+        if not ports:
+            ports.append(
+                {"containerPort": DEFAULT_CONTAINER_PORT, "name": "notebook-port", "protocol": "TCP"}
+            )
+        env = first.setdefault("env", [])
+        if not any(e.get("name") == "NB_PREFIX" for e in env):
+            env.append({"name": "NB_PREFIX", "value": f"/notebook/{ns}/{name}"})
+        if self.config.add_fsgroup:
+            spec.setdefault("securityContext", {}).setdefault("fsGroup", DEFAULT_FSGROUP)
+
+        return apimeta.new_object(
+            "apps/v1",
+            "StatefulSet",
+            name,
+            ns,
+            spec={
+                "replicas": replicas,
+                "serviceName": name,  # headless governing service = per-pod DNS
+                "selector": {"matchLabels": {"statefulset": name, NOTEBOOK_NAME_LABEL: name}},
+                "template": template,
+                "podManagementPolicy": "Parallel",  # gang-start all slice hosts
+            },
+        )
+
+    def _generate_headless_service(self, nb: Dict[str, Any]) -> Dict[str, Any]:
+        """Worker rendezvous: clusterIP None + coordinator port; publishes
+        not-ready addresses so worker 0 is resolvable before Ready."""
+        name = apimeta.name_of(nb)
+        return apimeta.new_object(
+            "v1",
+            "Service",
+            name,
+            apimeta.namespace_of(nb),
+            spec={
+                "clusterIP": "None",
+                "publishNotReadyAddresses": True,
+                "selector": {NOTEBOOK_NAME_LABEL: name},
+                "ports": [
+                    {"name": "jax-coordinator", "port": JAX_COORDINATOR_PORT, "protocol": "TCP"},
+                    {"name": f"http-{name}", "port": 80, "targetPort": DEFAULT_CONTAINER_PORT},
+                ],
+            },
+        )
+
+    def _generate_http_service(self, nb: Dict[str, Any]) -> Dict[str, Any]:
+        """UI traffic: ClusterIP, port name http-<name> for Istio RBAC
+        (reference: generateService :368-395, port naming :386)."""
+        name = apimeta.name_of(nb)
+        return apimeta.new_object(
+            "v1",
+            "Service",
+            f"{name}-http",
+            apimeta.namespace_of(nb),
+            spec={
+                "type": "ClusterIP",
+                "selector": {NOTEBOOK_NAME_LABEL: name, "statefulset.kubernetes.io/pod-name": f"{name}-0"},
+                "ports": [
+                    {"name": f"http-{name}", "port": 80, "targetPort": DEFAULT_CONTAINER_PORT, "protocol": "TCP"}
+                ],
+            },
+        )
+
+    def _generate_virtual_service(self, nb: Dict[str, Any]) -> Dict[str, Any]:
+        """reference: generateVirtualService :401-496."""
+        name = apimeta.name_of(nb)
+        ns = apimeta.namespace_of(nb)
+        prefix = f"/notebook/{ns}/{name}/"
+        annotations = apimeta.annotations_of(nb)
+        rewrite = annotations.get(HTTP_REWRITE_ANNOTATION, prefix)
+        vs = apimeta.new_object(
+            "networking.istio.io/v1beta1",
+            "VirtualService",
+            f"notebook-{ns}-{name}",
+            ns,
+            spec={
+                "hosts": [self.config.istio_host],
+                "gateways": [self.config.istio_gateway],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": rewrite},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}-http.{ns}.svc.{self.config.cluster_domain}",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                        "timeout": "300s",
+                    }
+                ],
+            },
+        )
+        headers = annotations.get(HEADERS_ANNOTATION)
+        if headers:
+            import json
+
+            vs["spec"]["http"][0]["headers"] = {"request": {"set": json.loads(headers)}}
+        return vs
+
+    # -- status / events -----------------------------------------------------
+    def _update_status(self, client: Client, nb: Dict[str, Any]) -> None:
+        name, ns = apimeta.name_of(nb), apimeta.namespace_of(nb)
+        sts = client.get_opt("apps/v1", "StatefulSet", name, ns)
+        ready = (sts or {}).get("status", {}).get("readyReplicas", 0)
+        pod0 = client.get_opt("v1", "Pod", f"{name}-0", ns)
+        container_state: Dict[str, Any] = {}
+        conditions: List[Dict[str, Any]] = []
+        if pod0 is not None:
+            for cs in pod0.get("status", {}).get("containerStatuses", []):
+                if cs.get("name") in (name, pod0["spec"].get("containers", [{}])[0].get("name")):
+                    container_state = cs.get("state", {})
+                    break
+            else:
+                statuses = pod0.get("status", {}).get("containerStatuses", [])
+                if statuses:
+                    container_state = statuses[0].get("state", {})
+        topo = tpu_topology_of(nb)
+        status = {
+            "readyReplicas": ready,
+            "containerState": container_state,
+            "conditions": conditions,
+        }
+        if topo is not None:
+            status["tpu"] = {
+                "topology": topo.label,
+                "generation": topo.generation,
+                "numHosts": topo.num_hosts,
+                "numChips": topo.num_chips,
+                "readyHosts": ready,
+            }
+        if nb.get("status") != status:
+            nb = apimeta.deepcopy(nb)
+            nb["status"] = status
+            client.update_status(nb)
+
+    def _mirror_child_events(self, client: Client, nb: Dict[str, Any]) -> None:
+        """Re-emit pod/sts events on the Notebook (reference :90-109)."""
+        name, ns = apimeta.name_of(nb), apimeta.namespace_of(nb)
+        events = client.list("v1", "Event", ns)
+        mirrored = {
+            (e.get("reason"), e.get("message"))
+            for e in events
+            if e.get("involvedObject", {}).get("kind") == "Notebook"
+            and e.get("involvedObject", {}).get("name") == name
+        }
+        for ev in events:
+            inv = ev.get("involvedObject", {})
+            if inv.get("kind") not in ("Pod", "StatefulSet"):
+                continue
+            if _nb_name_from_involved_object(ev) != name:
+                continue
+            if ev.get("type") != "Warning":
+                continue
+            key = (ev.get("reason"), ev.get("message"))
+            if key in mirrored:
+                continue
+            client.emit_event(nb, ev.get("reason", ""), ev.get("message", ""), type_="Warning")
+            mirrored.add(key)
+
+    def _update_running_gauge(self, client: Client, namespace: Optional[str]) -> None:
+        running = 0
+        for sts in client.list("apps/v1", "StatefulSet", namespace):
+            if NOTEBOOK_NAME_LABEL in (sts.get("spec", {}).get("selector", {}).get("matchLabels") or {}):
+                if sts.get("status", {}).get("readyReplicas", 0) > 0:
+                    running += 1
+        METRICS.gauge("notebook_running", namespace=namespace or "").set(running)
+
+    # -- culling -------------------------------------------------------------
+    def _check_culling(self, client: Client, nb: Dict[str, Any]) -> Result:
+        period = self.config.culling_check_period_minutes * 60.0
+        prober = self.config.activity_prober
+        if prober is None:
+            return Result(requeue_after=period)
+        last_activity = prober(nb)
+        now = time.time()
+        if last_activity is None:
+            return Result(requeue_after=period)
+        idle_seconds = now - last_activity
+        if idle_seconds >= self.config.idle_time_minutes * 60.0:
+            nb = apimeta.deepcopy(nb)
+            anns = nb["metadata"].setdefault("annotations", {})
+            anns[STOP_ANNOTATION] = client.store.now()
+            client.update(nb)
+            METRICS.counter("notebook_culling_total").inc()
+            METRICS.gauge("last_notebook_culling_timestamp_seconds").set(now)
+            client.emit_event(nb, "Culling", f"idle for {idle_seconds:.0f}s; stopping", type_="Normal")
+            return Result()
+        return Result(requeue_after=period)
+
+
+def _nb_name_from_involved_object(ev: Dict[str, Any]) -> Optional[str]:
+    """Map pod/sts event → notebook name (reference: nbNameFromInvolvedObject
+    :539 — strips the ordinal suffix from StatefulSet pod names)."""
+    inv = ev.get("involvedObject", {})
+    name = inv.get("name", "")
+    kind = inv.get("kind")
+    if kind == "StatefulSet":
+        return name or None
+    if kind == "Pod":
+        base, dash, ordinal = name.rpartition("-")
+        if dash and ordinal.isdigit():
+            return base
+    return None

@@ -1,0 +1,36 @@
+"""Platform assembly: wire the full control plane over one store.
+
+The in-process analog of deploying all reference components into a cluster
+(manifests L9): builtin substrate controllers, the PodDefault webhook, and
+the platform controllers, all sharing one Store. Tests and the e2e harness
+build a platform and drive it exactly the way a user drives a cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .apiserver.store import Store
+from .controllers.builtin import DeploymentReconciler, PodletReconciler, StatefulSetReconciler
+from .controllers.notebook import NotebookConfig, NotebookReconciler
+from .runtime.manager import Manager
+from .webhook.poddefault import admission_hook
+
+
+def build_platform(
+    store: Optional[Store] = None,
+    notebook_config: Optional[NotebookConfig] = None,
+    with_substrate: bool = True,
+    extra_reconcilers=(),
+) -> Manager:
+    mgr = Manager(store)
+    domain = (notebook_config or NotebookConfig()).cluster_domain
+    mgr.store.register_admission(admission_hook(mgr.client, cluster_domain=domain))
+    if with_substrate:
+        mgr.add(StatefulSetReconciler())
+        mgr.add(DeploymentReconciler())
+        mgr.add(PodletReconciler())
+    mgr.add(NotebookReconciler(notebook_config))
+    for rec in extra_reconcilers:
+        mgr.add(rec)
+    return mgr

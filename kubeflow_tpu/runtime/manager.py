@@ -63,6 +63,7 @@ class _WorkQueue:
         self._delayed: List[Tuple[float, int, Request]] = []
         self._seq = 0
         self._failures: Dict[Request, int] = {}
+        self._processing = 0
         self._shutdown = False
 
     def add(self, req: Request) -> None:
@@ -99,6 +100,7 @@ class _WorkQueue:
                 if self._pending:
                     req = next(iter(self._pending))
                     del self._pending[req]
+                    self._processing += 1
                     return req
                 if self._shutdown:
                     return None
@@ -112,14 +114,21 @@ class _WorkQueue:
                     wait = rem if wait is None else min(wait, rem)
                 self._cond.wait(wait)
 
+    def task_done(self) -> None:
+        with self._cond:
+            self._processing -= 1
+
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
 
     def empty(self) -> bool:
+        """Idle = nothing queued and nothing in flight. Delayed items
+        (periodic requeues: culling cadence, scheduler retries) don't count —
+        they represent scheduled future work, not outstanding work."""
         with self._cond:
-            return not self._pending and not self._delayed
+            return not self._pending and self._processing == 0
 
 
 class _Controller:
@@ -187,6 +196,7 @@ class _Controller:
                 log.debug("%s: reconcile %s failed:\n%s", self.name, req, traceback.format_exc())
                 self.queue.add_rate_limited(req)
             finally:
+                self.queue.task_done()
                 METRICS.histogram("controller_reconcile_seconds", controller=self.name).observe(
                     time.monotonic() - start
                 )

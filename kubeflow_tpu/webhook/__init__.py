@@ -1,0 +1,6 @@
+from .poddefault import (  # noqa: F401
+    PodDefaultConflict,
+    admission_hook,
+    filter_pod_defaults,
+    mutate_pod,
+)

@@ -1,0 +1,281 @@
+"""PodDefault mutating admission: the TPU injection plane.
+
+Re-implements the reference admission webhook's merge/conflict semantics
+(reference: components/admission-webhook/main.go — filterPodDefaults :69-94,
+safeToApplyPodDefaultsOnPod :98-132, mergeEnv :152-187, mergeVolumeMounts
+:202-253, mergeVolumes :257-296, mergeTolerations :300-339, mergeMap
+:343-364, mutatePods :443-542) and extends ``PodDefaultSpec`` with a
+first-class ``tpu`` block. Where the reference injected free-form GPU-era
+env, a TPU PodDefault declares a slice once:
+
+    spec:
+      selector: {matchLabels: {tpu-workload: "true"}}
+      tpu:
+        generation: v5e
+        topology: 4x8
+
+and the webhook derives everything: ``google.com/tpu`` chip limits on the
+workload container, GKE accelerator/topology nodeSelectors, and the
+deterministic JAX coordinator/worker env (computable at admission time from
+the pod's headless-service subdomain — SURVEY.md §7 "hard parts").
+
+Conflict semantics are all-or-nothing per pod, as in the reference: if any
+applicable PodDefault conflicts with the pod or another PodDefault, *no*
+mutation happens and the pod is annotated with the rejection reason.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..api.meta import Resource
+from ..runtime.metrics import METRICS
+from ..tpu.env import jax_worker_env
+from ..tpu.topology import SliceTopology, parse_topology
+
+log = logging.getLogger("kubeflow_tpu.webhook")
+
+ANNOTATION_PREFIX = "poddefault.admission.kubeflow.org"
+EXCLUDE_ANNOTATION = f"{ANNOTATION_PREFIX}/exclude"
+REJECT_ANNOTATION = f"{ANNOTATION_PREFIX}/rejected-reason"
+
+
+class PodDefaultConflict(Exception):
+    pass
+
+
+def filter_pod_defaults(
+    pod: Dict[str, Any], poddefaults: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """PodDefaults whose selector matches the pod's labels
+    (reference: main.go:69-94)."""
+    labels = apimeta.labels_of(pod)
+    out = []
+    for pd in poddefaults:
+        selector = pd.get("spec", {}).get("selector")
+        if apimeta.matches_selector(labels, selector):
+            out.append(pd)
+    return sorted(out, key=apimeta.name_of)
+
+
+# --- merge primitives (conflict = same key, different value) ---------------
+
+
+def merge_env(existing: List[Dict], incoming: List[Dict], where: str) -> List[Dict]:
+    by_name = {e["name"]: e for e in existing}
+    out = list(existing)
+    for e in incoming:
+        cur = by_name.get(e["name"])
+        if cur is None:
+            out.append(e)
+            by_name[e["name"]] = e
+        elif cur != e:
+            raise PodDefaultConflict(f"{where}: conflicting env {e['name']!r}")
+    return out
+
+
+def merge_env_from(existing: List[Dict], incoming: List[Dict]) -> List[Dict]:
+    out = list(existing)
+    for e in incoming:
+        if e not in out:
+            out.append(e)
+    return out
+
+
+def merge_volume_mounts(existing: List[Dict], incoming: List[Dict], where: str) -> List[Dict]:
+    out = list(existing)
+    for vm in incoming:
+        clash = next(
+            (
+                c
+                for c in out
+                if c["name"] == vm["name"] or c.get("mountPath") == vm.get("mountPath")
+            ),
+            None,
+        )
+        if clash is None:
+            out.append(vm)
+        elif clash != vm:
+            raise PodDefaultConflict(
+                f"{where}: conflicting volumeMount {vm['name']!r} at {vm.get('mountPath')!r}"
+            )
+    return out
+
+
+def merge_volumes(existing: List[Dict], incoming: List[Dict], where: str) -> List[Dict]:
+    by_name = {v["name"]: v for v in existing}
+    out = list(existing)
+    for v in incoming:
+        cur = by_name.get(v["name"])
+        if cur is None:
+            out.append(v)
+            by_name[v["name"]] = v
+        elif cur != v:
+            raise PodDefaultConflict(f"{where}: conflicting volume {v['name']!r}")
+    return out
+
+
+def merge_tolerations(existing: List[Dict], incoming: List[Dict], where: str) -> List[Dict]:
+    by_key = {t.get("key"): t for t in existing}
+    out = list(existing)
+    for t in incoming:
+        cur = by_key.get(t.get("key"))
+        if cur is None:
+            out.append(t)
+            by_key[t.get("key")] = t
+        elif cur != t:
+            raise PodDefaultConflict(f"{where}: conflicting toleration {t.get('key')!r}")
+    return out
+
+
+def merge_map(existing: Dict[str, str], incoming: Dict[str, str], where: str) -> Dict[str, str]:
+    out = dict(existing)
+    for k, v in incoming.items():
+        if k in out and out[k] != v:
+            raise PodDefaultConflict(f"{where}: conflicting key {k!r} ({out[k]!r} != {v!r})")
+        out[k] = v
+    return out
+
+
+# --- TPU block --------------------------------------------------------------
+
+
+def tpu_spec_of(pd: Dict[str, Any]) -> Optional[SliceTopology]:
+    tpu = pd.get("spec", {}).get("tpu")
+    if not tpu:
+        return None
+    return parse_topology(tpu["generation"], tpu["topology"])
+
+
+def _workload_name(pod: Dict[str, Any]) -> str:
+    """Headless-service coordinate for coordinator DNS.
+
+    StatefulSet pods carry ``spec.subdomain`` (= governing service name) and a
+    controller ownerReference; either names the workload. Falls back to the
+    pod's own name for bare pods (single-host only).
+    """
+    subdomain = pod.get("spec", {}).get("subdomain")
+    if subdomain:
+        return subdomain
+    ref = apimeta.controller_owner_of(pod)
+    if ref is not None:
+        return ref["name"]
+    return apimeta.name_of(pod)
+
+
+def _tpu_mutations(
+    pd: Dict[str, Any], topo: SliceTopology, pod: Dict[str, Any], cluster_domain: str
+) -> Tuple[List[Dict], Dict[str, str], Dict[str, str], List[Dict]]:
+    """(env, resource limits, nodeSelector, tolerations) for the TPU block."""
+    tpu = pd["spec"]["tpu"]
+    name = _workload_name(pod)
+    ns = apimeta.namespace_of(pod) or "default"
+    env = jax_worker_env(
+        topo, name, ns, cluster_domain=tpu.get("clusterDomain", cluster_domain), extra=tpu.get("env")
+    )
+    selector = topo.node_selector()
+    tolerations = [{"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"}]
+    return env, topo.resource_limits(), selector, tolerations
+
+
+def _target_containers(pd: Dict[str, Any], pod_spec: Dict[str, Any]) -> List[Dict]:
+    """TPU limits go on the workload container: named by ``spec.tpu.container``
+    or the first container (the reference's JWA sets GPU limits on the single
+    notebook container — form.py:262-287)."""
+    containers = pod_spec.get("containers") or []
+    want = pd.get("spec", {}).get("tpu", {}).get("container")
+    if want:
+        matched = [c for c in containers if c.get("name") == want]
+        if not matched:
+            raise PodDefaultConflict(f"tpu.container {want!r} not found in pod")
+        return matched
+    return containers[:1]
+
+
+def apply_pod_defaults(
+    pod: Dict[str, Any], poddefaults: List[Dict[str, Any]], cluster_domain: str = "cluster.local"
+) -> Dict[str, Any]:
+    """Apply all PodDefaults onto a deep copy of pod; raises PodDefaultConflict."""
+    pod = apimeta.deepcopy(pod)
+    spec = pod.setdefault("spec", {})
+    md = pod.setdefault("metadata", {})
+    for pd in poddefaults:
+        pd_name = apimeta.name_of(pd)
+        where = f"poddefault/{pd_name}"
+        pspec = pd.get("spec", {})
+
+        for container in spec.get("containers", []) or []:
+            if pspec.get("env"):
+                container["env"] = merge_env(container.get("env") or [], pspec["env"], where)
+            if pspec.get("envFrom"):
+                container["envFrom"] = merge_env_from(container.get("envFrom") or [], pspec["envFrom"])
+            if pspec.get("volumeMounts"):
+                container["volumeMounts"] = merge_volume_mounts(
+                    container.get("volumeMounts") or [], pspec["volumeMounts"], where
+                )
+        if pspec.get("volumes"):
+            spec["volumes"] = merge_volumes(spec.get("volumes") or [], pspec["volumes"], where)
+        if pspec.get("tolerations"):
+            spec["tolerations"] = merge_tolerations(spec.get("tolerations") or [], pspec["tolerations"], where)
+        if pspec.get("labels"):
+            md["labels"] = merge_map(md.get("labels") or {}, pspec["labels"], where)
+        if pspec.get("annotations"):
+            md["annotations"] = merge_map(md.get("annotations") or {}, pspec["annotations"], where)
+
+        topo = tpu_spec_of(pd)
+        if topo is not None:
+            env, limits, node_selector, tolerations = _tpu_mutations(pd, topo, pod, cluster_domain)
+            for container in _target_containers(pd, spec):
+                container["env"] = merge_env(container.get("env") or [], env, where)
+                resources = container.setdefault("resources", {})
+                resources["limits"] = merge_map(resources.get("limits") or {}, limits, where)
+                resources["requests"] = merge_map(resources.get("requests") or {}, limits, where)
+            spec["nodeSelector"] = merge_map(spec.get("nodeSelector") or {}, node_selector, where)
+            spec["tolerations"] = merge_tolerations(spec.get("tolerations") or [], tolerations, where)
+
+        md.setdefault("annotations", {})[f"{ANNOTATION_PREFIX}/poddefault-{pd_name}"] = str(
+            pd["metadata"].get("resourceVersion", "0")
+        )
+    return pod
+
+
+def mutate_pod(
+    pod: Dict[str, Any], poddefaults: List[Dict[str, Any]], cluster_domain: str = "cluster.local"
+) -> Dict[str, Any]:
+    """Full admission path: exclusion check, selector filter, all-or-nothing
+    apply. Never rejects the pod — on conflict the pod passes through
+    unmutated with the reason annotated (reference behavior:
+    main.go:500-517 logs and allows)."""
+    annotations = apimeta.annotations_of(pod)
+    if annotations.get(EXCLUDE_ANNOTATION) == "true":
+        return pod
+    matching = filter_pod_defaults(pod, poddefaults)
+    if not matching:
+        return pod
+    try:
+        mutated = apply_pod_defaults(pod, matching, cluster_domain)
+        METRICS.counter("poddefault_apply_total", result="success").inc()
+        return mutated
+    except PodDefaultConflict as e:
+        METRICS.counter("poddefault_apply_total", result="conflict").inc()
+        log.warning("pod %s/%s: %s", apimeta.namespace_of(pod), apimeta.name_of(pod), e)
+        pod = apimeta.deepcopy(pod)
+        pod.setdefault("metadata", {}).setdefault("annotations", {})[REJECT_ANNOTATION] = str(e)
+        return pod
+
+
+def admission_hook(client, cluster_domain: str = "cluster.local") -> Any:
+    """Store admission hook: mutate pods on CREATE using the PodDefaults in
+    the pod's namespace (the in-process equivalent of registering the webhook
+    with the API server)."""
+
+    def hook(op: str, res: Resource, obj: Dict[str, Any]) -> Dict[str, Any]:
+        if op != "CREATE" or res.kind != "Pod":
+            return obj
+        ns = apimeta.namespace_of(obj)
+        poddefaults = client.list("kubeflow.org/v1alpha1", "PodDefault", namespace=ns)
+        return mutate_pod(obj, poddefaults, cluster_domain)
+
+    return hook

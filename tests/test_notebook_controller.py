@@ -1,0 +1,291 @@
+"""Notebook controller: CR → StatefulSet/Services/VS materialization,
+TPU slice sizing, stop/start, culling, status, event mirroring.
+
+The envtest-analog suite (reference: notebook_controller_bdd_test.go:33-89) —
+but because the platform ships its own substrate controllers, pods and
+scheduling ARE observable here, unlike the reference's envtest.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.builtin import make_tpu_node
+from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION, NotebookConfig
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.tpu.env import env_list_to_dict
+
+
+def mknotebook(name="nb", ns="team-a", tpu=None, labels=None, annotations=None):
+    spec = {"template": {"spec": {"containers": [{"name": name, "image": "jupyter-jax:latest"}]}}}
+    if tpu:
+        spec["tpu"] = tpu
+    return new_object("kubeflow.org/v1beta1", "Notebook", name, ns, labels=labels, annotations=annotations, spec=spec)
+
+
+@pytest.fixture()
+def platform():
+    mgr = build_platform().start()
+    yield mgr
+    mgr.stop()
+
+
+def test_single_host_notebook_materializes(platform):
+    platform.client.create(mknotebook())
+    assert platform.wait_idle()
+    sts = platform.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+    assert sts["spec"]["replicas"] == 1
+    assert sts["spec"]["serviceName"] == "nb"
+    tmpl = sts["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["notebook-name"] == "nb"
+    c = tmpl["spec"]["containers"][0]
+    assert c["workingDir"] == "/home/jovyan"
+    assert {"name": "NB_PREFIX", "value": "/notebook/team-a/nb"} in c["env"]
+    assert tmpl["spec"]["securityContext"]["fsGroup"] == 100
+    # Services
+    headless = platform.client.get("v1", "Service", "nb", "team-a")
+    assert headless["spec"]["clusterIP"] == "None"
+    http = platform.client.get("v1", "Service", "nb-http", "team-a")
+    assert http["spec"]["ports"][0]["name"] == "http-nb"
+    # VirtualService
+    vs = platform.client.get("networking.istio.io/v1beta1", "VirtualService", "notebook-team-a-nb", "team-a")
+    assert vs["spec"]["http"][0]["match"][0]["uri"]["prefix"] == "/notebook/team-a/nb/"
+    assert vs["spec"]["http"][0]["route"][0]["destination"]["host"] == "nb-http.team-a.svc.cluster.local"
+    # Pod actually runs (substrate)
+    pod = platform.client.get("v1", "Pod", "nb-0", "team-a")
+    assert pod["status"]["phase"] == "Running"
+    assert pod["spec"]["subdomain"] == "nb"
+
+
+def test_multi_host_tpu_notebook_scales_to_hosts(platform):
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "4x8"}))
+    assert platform.wait_idle()
+    sts = platform.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+    assert sts["spec"]["replicas"] == 8
+    assert sts["spec"]["podManagementPolicy"] == "Parallel"
+    pods = [p for p in platform.client.list("v1", "Pod", "team-a")]
+    assert len(pods) == 8
+    names = sorted(p["metadata"]["name"] for p in pods)
+    assert names[0] == "nb-0" and names[-1] == "nb-7"
+    nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    assert nb["status"]["tpu"] == {
+        "topology": "4x8",
+        "generation": "v5e",
+        "numHosts": 8,
+        "numChips": 32,
+        "readyHosts": 8,
+    }
+
+
+def test_webhook_injects_tpu_env_into_notebook_pods(platform):
+    """Full injection slice: PodDefault + labeled Notebook → pods carry
+    google.com/tpu limits + JAX coordinator env (minimum e2e slice of
+    SURVEY §7)."""
+    platform.client.create(
+        {
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "PodDefault",
+            "metadata": {"name": "tpu-slice", "namespace": "team-a"},
+            "spec": {
+                "selector": {"matchLabels": {"tpu-workload": "true"}},
+                "tpu": {"generation": "v5e", "topology": "2x4"},
+            },
+        }
+    )
+    platform.client.create(
+        mknotebook(tpu={"generation": "v5e", "topology": "2x4"}, labels={"tpu-workload": "true"})
+    )
+    assert platform.wait_idle()
+    pod = platform.client.get("v1", "Pod", "nb-1", "team-a")
+    c = pod["spec"]["containers"][0]
+    assert c["resources"]["limits"] == {"google.com/tpu": "4"}
+    env = env_list_to_dict(c["env"])
+    assert env["JAX_COORDINATOR_ADDRESS"] == "nb-0.nb.team-a.svc.cluster.local:8476"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+
+
+def test_tpu_pods_schedule_onto_tpu_nodes(platform):
+    """Fake TPU node fixture: pods bind only to matching capacity."""
+    platform.client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+    platform.client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+    platform.client.create(
+        {
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "PodDefault",
+            "metadata": {"name": "tpu-slice", "namespace": "team-a"},
+            "spec": {
+                "selector": {"matchLabels": {"tpu-workload": "true"}},
+                "tpu": {"generation": "v5e", "topology": "2x4"},
+            },
+        }
+    )
+    platform.client.create(
+        mknotebook(tpu={"generation": "v5e", "topology": "2x4"}, labels={"tpu-workload": "true"})
+    )
+    assert platform.wait_idle()
+    pods = platform.client.list("v1", "Pod", "team-a")
+    assert len(pods) == 2
+    nodes = sorted(p["spec"].get("nodeName", "") for p in pods)
+    assert nodes == ["tpu-node-0", "tpu-node-1"]  # one host per node: capacity enforced
+    for p in pods:
+        assert p["status"]["phase"] == "Running"
+
+
+def test_tpu_pod_unschedulable_without_nodes_stays_pending(platform):
+    platform.client.create(new_object("v1", "Node", "cpu-node", spec={}))
+    platform.client.create(
+        {
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "PodDefault",
+            "metadata": {"name": "tpu-slice", "namespace": "team-a"},
+            "spec": {"selector": {"matchLabels": {"t": "1"}}, "tpu": {"generation": "v5e", "topology": "2x2"}},
+        }
+    )
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x2"}, labels={"t": "1"}))
+    assert platform.wait_idle()
+    pod = platform.client.get("v1", "Pod", "nb-0", "team-a")
+    assert pod["status"]["phase"] == "Pending"
+    assert pod["status"]["conditions"][0]["reason"] == "Unschedulable"
+
+
+def test_stop_annotation_scales_to_zero_and_restart(platform):
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+    assert platform.wait_idle()
+    nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    nb["metadata"].setdefault("annotations", {})[STOP_ANNOTATION] = "2026-07-29T00:00:00Z"
+    platform.client.update(nb)
+    assert platform.wait_idle()
+    sts = platform.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+    assert sts["spec"]["replicas"] == 0
+    assert platform.client.list("v1", "Pod", "team-a") == []
+    # restart: remove annotation → full slice returns
+    nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    del nb["metadata"]["annotations"][STOP_ANNOTATION]
+    platform.client.update(nb)
+    assert platform.wait_idle()
+    assert len(platform.client.list("v1", "Pod", "team-a")) == 2
+
+
+def test_culling_stops_idle_notebook():
+    config = NotebookConfig(
+        enable_culling=True,
+        idle_time_minutes=1,
+        culling_check_period_minutes=0.0005,  # 30ms requeue in test
+        activity_prober=lambda nb: time.time() - 3600,  # idle for an hour
+    )
+    mgr = build_platform(notebook_config=config).start()
+    try:
+        mgr.client.create(mknotebook())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+            if STOP_ANNOTATION in (nb["metadata"].get("annotations") or {}):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("notebook was not culled")
+        mgr.wait_idle()
+        sts = mgr.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+        assert sts["spec"]["replicas"] == 0
+        assert METRICS.value("notebook_culling_total") >= 1
+    finally:
+        mgr.stop()
+
+
+def test_active_notebook_not_culled():
+    config = NotebookConfig(
+        enable_culling=True,
+        idle_time_minutes=1,
+        culling_check_period_minutes=0.0005,
+        activity_prober=lambda nb: time.time(),  # active now
+    )
+    mgr = build_platform(notebook_config=config).start()
+    try:
+        mgr.client.create(mknotebook())
+        time.sleep(0.5)
+        nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+        assert STOP_ANNOTATION not in (nb["metadata"].get("annotations") or {})
+    finally:
+        mgr.stop()
+
+
+def test_warning_events_mirrored_onto_notebook(platform):
+    platform.client.create(mknotebook())
+    assert platform.wait_idle()
+    pod = platform.client.get("v1", "Pod", "nb-0", "team-a")
+    platform.client.emit_event(pod, "FailedMount", "volume not found", type_="Warning")
+    assert platform.wait_idle()
+    mirrored = [
+        e
+        for e in platform.client.list("v1", "Event", "team-a")
+        if e["involvedObject"]["kind"] == "Notebook" and e["reason"] == "FailedMount"
+    ]
+    assert len(mirrored) == 1
+    assert mirrored[0]["message"] == "volume not found"
+
+
+def test_notebook_delete_cascades(platform):
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+    assert platform.wait_idle()
+    platform.client.delete("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    assert platform.wait_idle()
+    assert platform.client.get_opt("apps/v1", "StatefulSet", "nb", "team-a") is None
+    assert platform.client.list("v1", "Pod", "team-a") == []
+    assert platform.client.get_opt("v1", "Service", "nb", "team-a") is None
+
+
+def test_notebook_running_metric(platform):
+    platform.client.create(mknotebook())
+    assert platform.wait_idle()
+    assert METRICS.value("notebook_running", namespace="team-a") == 1
+
+
+def test_invalid_tpu_spec_surfaces_condition_not_crashloop(platform):
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "9x9x9"}))
+    assert platform.wait_idle()
+    nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    conds = nb["status"]["conditions"]
+    assert conds[0]["reason"] == "InvalidSpec"
+    events = [
+        e
+        for e in platform.client.list("v1", "Event", "team-a")
+        if e["reason"] == "InvalidSpec" and e["involvedObject"]["name"] == "nb"
+    ]
+    assert len(events) == 1
+    assert platform.client.get_opt("apps/v1", "StatefulSet", "nb", "team-a") is None
+    assert METRICS.value("notebook_create_failed_total") >= 1
+
+
+def test_empty_containers_list_tolerated(platform):
+    nb = new_object(
+        "kubeflow.org/v1beta1", "Notebook", "bare", "team-a", spec={"template": {"spec": {"containers": []}}}
+    )
+    platform.client.create(nb)
+    assert platform.wait_idle()
+    sts = platform.client.get("apps/v1", "StatefulSet", "bare", "team-a")
+    assert sts["spec"]["template"]["spec"]["containers"][0]["name"] == "bare"
+
+
+def test_custom_cluster_domain_threads_into_injected_env():
+    from kubeflow_tpu.controllers.notebook import NotebookConfig
+
+    mgr = build_platform(notebook_config=NotebookConfig(cluster_domain="example.local")).start()
+    try:
+        mgr.client.create(
+            {
+                "apiVersion": "kubeflow.org/v1alpha1",
+                "kind": "PodDefault",
+                "metadata": {"name": "tpu", "namespace": "team-a"},
+                "spec": {"selector": {}, "tpu": {"generation": "v5e", "topology": "2x4"}},
+            }
+        )
+        mgr.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+        assert mgr.wait_idle()
+        pod = mgr.client.get("v1", "Pod", "nb-0", "team-a")
+        env = env_list_to_dict(pod["spec"]["containers"][0]["env"])
+        assert env["JAX_COORDINATOR_ADDRESS"] == "nb-0.nb.team-a.svc.example.local:8476"
+    finally:
+        mgr.stop()

@@ -1,0 +1,172 @@
+"""PodDefault webhook: selector filtering, merge/conflict semantics, TPU injection.
+
+Modeled on the reference's table-driven webhook tests
+(admission-webhook/main_test.go:12-192).
+"""
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.tpu.env import env_list_to_dict
+from kubeflow_tpu.webhook import poddefault as wh
+
+
+def mkpod(name="p", ns="team-a", labels=None, containers=None, annotations=None):
+    return new_object(
+        "v1",
+        "Pod",
+        name,
+        ns,
+        labels=labels,
+        annotations=annotations,
+        spec={"containers": containers or [{"name": "main"}]},
+    )
+
+
+def mkpd(name, selector=None, **spec):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": "team-a", "resourceVersion": "7"},
+        "spec": {"selector": selector or {}, **spec},
+    }
+
+
+def test_selector_filtering():
+    pds = [
+        mkpd("a", {"matchLabels": {"team": "x"}}),
+        mkpd("b", {"matchLabels": {"team": "y"}}),
+        mkpd("c", {"matchExpressions": [{"key": "team", "operator": "Exists"}]}),
+    ]
+    pod = mkpod(labels={"team": "x"})
+    names = [pd["metadata"]["name"] for pd in wh.filter_pod_defaults(pod, pds)]
+    assert names == ["a", "c"]
+
+
+def test_env_injection_and_applied_annotation():
+    pd = mkpd("add-env", {"matchLabels": {"inject": "1"}}, env=[{"name": "FOO", "value": "bar"}])
+    pod = mkpod(labels={"inject": "1"})
+    out = wh.mutate_pod(pod, [pd])
+    env = env_list_to_dict(out["spec"]["containers"][0]["env"])
+    assert env["FOO"] == "bar"
+    assert out["metadata"]["annotations"]["poddefault.admission.kubeflow.org/poddefault-add-env"] == "7"
+
+
+def test_env_conflict_rejects_all_mutations():
+    pd1 = mkpd("one", {}, env=[{"name": "FOO", "value": "a"}], labels={"extra": "x"})
+    pd2 = mkpd("two", {}, env=[{"name": "FOO", "value": "b"}])
+    pod = mkpod()
+    out = wh.mutate_pod(pod, [pd1, pd2])
+    # all-or-nothing: no env, no label, reason annotated
+    assert "env" not in out["spec"]["containers"][0]
+    assert "extra" not in (out["metadata"].get("labels") or {})
+    assert "conflicting env 'FOO'" in out["metadata"]["annotations"][wh.REJECT_ANNOTATION]
+
+
+def test_identical_env_is_not_a_conflict():
+    pd1 = mkpd("one", {}, env=[{"name": "FOO", "value": "same"}])
+    pd2 = mkpd("two", {}, env=[{"name": "FOO", "value": "same"}])
+    out = wh.mutate_pod(mkpod(), [pd1, pd2])
+    assert env_list_to_dict(out["spec"]["containers"][0]["env"])["FOO"] == "same"
+
+
+def test_volume_and_mount_merging():
+    pd = mkpd(
+        "vols",
+        {},
+        volumes=[{"name": "data", "persistentVolumeClaim": {"claimName": "d"}}],
+        volumeMounts=[{"name": "data", "mountPath": "/data"}],
+    )
+    out = wh.mutate_pod(mkpod(), [pd])
+    assert out["spec"]["volumes"] == [{"name": "data", "persistentVolumeClaim": {"claimName": "d"}}]
+    assert out["spec"]["containers"][0]["volumeMounts"] == [{"name": "data", "mountPath": "/data"}]
+
+
+def test_volume_mount_path_clash_conflicts():
+    pod = mkpod(containers=[{"name": "main", "volumeMounts": [{"name": "home", "mountPath": "/data"}]}])
+    pd = mkpd("vols", {}, volumeMounts=[{"name": "data", "mountPath": "/data"}])
+    out = wh.mutate_pod(pod, [pd])
+    assert wh.REJECT_ANNOTATION in out["metadata"]["annotations"]
+
+
+def test_toleration_merge_by_key():
+    pod = mkpod()
+    pod["spec"]["tolerations"] = [{"key": "a", "operator": "Exists"}]
+    pd = mkpd("tol", {}, tolerations=[{"key": "b", "operator": "Exists"}])
+    out = wh.mutate_pod(pod, [pd])
+    assert len(out["spec"]["tolerations"]) == 2
+
+
+def test_exclusion_annotation_skips():
+    pd = mkpd("add-env", {}, env=[{"name": "FOO", "value": "bar"}])
+    pod = mkpod(annotations={"poddefault.admission.kubeflow.org/exclude": "true"})
+    out = wh.mutate_pod(pod, [pd])
+    assert "env" not in out["spec"]["containers"][0]
+
+
+def test_tpu_block_injects_everything():
+    pd = mkpd("tpu-slice", {"matchLabels": {"tpu": "1"}}, tpu={"generation": "v5e", "topology": "4x8"})
+    pod = mkpod(labels={"tpu": "1"})
+    pod["spec"]["subdomain"] = "mynb"  # headless service, as a StatefulSet pod would carry
+    out = wh.mutate_pod(pod, [pd])
+    c = out["spec"]["containers"][0]
+    assert c["resources"]["limits"] == {"google.com/tpu": "4"}
+    assert c["resources"]["requests"] == {"google.com/tpu": "4"}
+    env = env_list_to_dict(c["env"])
+    assert env["JAX_COORDINATOR_ADDRESS"] == "mynb-0.mynb.team-a.svc.cluster.local:8476"
+    assert env["JAX_NUM_PROCESSES"] == "8"
+    assert out["spec"]["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x8",
+    }
+    assert {"key": "google.com/tpu", "operator": "Exists", "effect": "NoSchedule"} in out["spec"]["tolerations"]
+
+
+def test_tpu_block_single_host():
+    pd = mkpd("tpu-single", {}, tpu={"generation": "v5e", "topology": "2x2"})
+    out = wh.mutate_pod(mkpod(), [pd])
+    c = out["spec"]["containers"][0]
+    assert c["resources"]["limits"] == {"google.com/tpu": "4"}
+    env = env_list_to_dict(c["env"])
+    assert env["JAX_NUM_PROCESSES"] == "1"
+
+
+def test_tpu_block_targets_named_container():
+    pd = mkpd("tpu", {}, tpu={"generation": "v5e", "topology": "2x2", "container": "worker"})
+    pod = mkpod(containers=[{"name": "sidecar"}, {"name": "worker"}])
+    out = wh.mutate_pod(pod, [pd])
+    sidecar, worker = out["spec"]["containers"]
+    assert "resources" not in sidecar
+    assert worker["resources"]["limits"] == {"google.com/tpu": "4"}
+
+
+def test_tpu_reinjection_is_idempotent():
+    """Deterministic env: applying the same PodDefault to an already-mutated
+    pod must not conflict (SURVEY §7: 'TPU-generated env must be deterministic
+    or pods bounce')."""
+    pd = mkpd("tpu", {}, tpu={"generation": "v5e", "topology": "4x4"})
+    pod = mkpod()
+    pod["spec"]["subdomain"] = "nb"
+    once = wh.mutate_pod(pod, [pd])
+    twice = wh.mutate_pod(once, [pd])
+    assert wh.REJECT_ANNOTATION not in twice["metadata"]["annotations"]
+    assert twice["spec"]["containers"] == once["spec"]["containers"]
+
+
+def test_store_admission_integration(manager):
+    client = manager.client
+    client.create(
+        {
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "PodDefault",
+            "metadata": {"name": "tpu", "namespace": "team-a"},
+            "spec": {"selector": {"matchLabels": {"tpu": "1"}}, "tpu": {"generation": "v5e", "topology": "2x4"}},
+        }
+    )
+    manager.store.register_admission(wh.admission_hook(client))
+    pod = mkpod(labels={"tpu": "1"})
+    created = client.create(pod)
+    assert created["spec"]["containers"][0]["resources"]["limits"] == {"google.com/tpu": "4"}
+    # unlabeled pod untouched
+    other = client.create(mkpod(name="plain"))
+    assert "resources" not in other["spec"]["containers"][0]
