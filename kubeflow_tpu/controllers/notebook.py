@@ -24,8 +24,8 @@ structural changes the TPU re-targeting demands (SURVEY.md §7 step 3):
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
@@ -107,7 +107,7 @@ class NotebookReconciler(Reconciler):
 
         try:
             sts = self._generate_statefulset(nb)
-        except ValueError as e:
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
             # Invalid spec (bad tpu topology etc.): terminal, not retryable —
             # surface it instead of crash-looping (the reference validates at
             # spawn time; CRs can still arrive malformed via kubectl).
@@ -352,7 +352,12 @@ class NotebookReconciler(Reconciler):
             return Result(requeue_after=period)
         idle_seconds = now - last_activity
         if idle_seconds >= self.config.idle_time_minutes * 60.0:
-            nb = apimeta.deepcopy(nb)
+            # Re-fetch: _update_status may have bumped resourceVersion earlier
+            # in this pass, and the stale copy would Conflict on update.
+            fresh = client.get_opt("kubeflow.org/v1beta1", "Notebook", apimeta.name_of(nb), apimeta.namespace_of(nb))
+            if fresh is None:
+                return Result()
+            nb = apimeta.deepcopy(fresh)
             anns = nb["metadata"].setdefault("annotations", {})
             anns[STOP_ANNOTATION] = client.store.now()
             client.update(nb)

@@ -1,0 +1,26 @@
+"""TPU-native parallelism: device meshes, sharding rules, distributed init.
+
+The reference delegates all distribution to workload pods (SURVEY.md §2.10 —
+no in-tree DP/TP/PP/SP code; CUDA images imply NCCL). Here the workload side
+is first-class: a canonical mesh axis vocabulary shared by every model and by
+the control plane's topology math (``kubeflow_tpu.tpu.topology``), sharding
+via ``jax.sharding`` + XLA collectives over ICI/DCN, and ring attention for
+sequence parallelism.
+"""
+
+from kubeflow_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    MeshConfig,
+    batch_sharding,
+    make_mesh,
+    replicated,
+)
+from kubeflow_tpu.parallel.sharding import (  # noqa: F401
+    LogicalRules,
+    logical_sharding,
+    shard_pytree,
+)

@@ -1,0 +1,89 @@
+"""Multi-host bootstrap: from webhook-injected env to a live JAX cluster.
+
+The admission webhook injects *identical* env on every pod of a slice
+(deterministic injection — kubeflow_tpu/tpu/env.py; the reference rejects
+conflicting env merges, admission-webhook/main.go:152-187). Per-worker
+identity is therefore derived here at runtime from the StatefulSet ordinal
+in the pod hostname (``<name>-3`` → process 3) — the same stable-DNS scheme
+the reference culler relies on (notebook-controller/pkg/culler/culler.go:138-144).
+
+DCN rendezvous goes through ``jax.distributed.initialize`` (worker 0 is the
+coordinator); ICI within a slice needs no code — libtpu/XLA own it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+from kubeflow_tpu.tpu.env import (
+    ENV_COORDINATOR_ADDRESS,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+_ORDINAL_RE = re.compile(r"-(\d+)$")
+
+
+@dataclass(frozen=True)
+class WorkerIdentity:
+    process_id: int
+    num_processes: int
+    coordinator_address: Optional[str]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def ordinal_from_hostname(hostname: Optional[str] = None) -> int:
+    """StatefulSet ordinal from the pod hostname; 0 if not pod-shaped."""
+    host = hostname if hostname is not None else socket.gethostname()
+    m = _ORDINAL_RE.search(host.split(".")[0])
+    return int(m.group(1)) if m else 0
+
+
+def identity_from_env(environ: Optional[dict] = None, hostname: Optional[str] = None) -> WorkerIdentity:
+    env = os.environ if environ is None else environ
+    num = int(env.get(ENV_NUM_PROCESSES, "1"))
+    explicit = env.get(ENV_PROCESS_ID)
+    pid = int(explicit) if explicit is not None else ordinal_from_hostname(hostname)
+    coord = env.get(ENV_COORDINATOR_ADDRESS)
+    if pid >= num:
+        raise ValueError(f"worker ordinal {pid} >= num_processes {num}")
+    return WorkerIdentity(process_id=pid, num_processes=num, coordinator_address=coord)
+
+
+_initialized = False
+
+
+def initialize(environ: Optional[dict] = None, hostname: Optional[str] = None) -> WorkerIdentity:
+    """Idempotently join the JAX cluster described by the injected env.
+
+    Single-process (no coordinator env, or num_processes == 1) is a no-op,
+    so the same training script runs unchanged on one chip or a v5e-256.
+    """
+    global _initialized
+    ident = identity_from_env(environ, hostname)
+    if ident.is_distributed and not _initialized:
+        if not ident.coordinator_address:
+            raise RuntimeError(
+                f"{ENV_NUM_PROCESSES}={ident.num_processes} but {ENV_COORDINATOR_ADDRESS} unset; "
+                "was this pod admitted through the TPU PodDefault webhook?"
+            )
+        jax.distributed.initialize(
+            coordinator_address=ident.coordinator_address,
+            num_processes=ident.num_processes,
+            process_id=ident.process_id,
+        )
+        _initialized = True
+    return ident

@@ -1,0 +1,132 @@
+"""Canonical device mesh construction for TPU slices.
+
+One mesh axis vocabulary is used across the framework:
+
+- ``data``   — pure data parallelism (gradients all-reduced; rides DCN
+  across slices, ICI within one),
+- ``fsdp``   — data parallelism with parameter/optimizer sharding
+  (ZeRO-3 style; params all-gathered per layer, grads reduce-scattered),
+- ``model``  — tensor parallelism (activations/weights split over ICI),
+- ``seq``    — sequence/context parallelism (ring attention),
+- ``expert`` — expert parallelism for MoE layers.
+
+The reference control plane never builds meshes (SURVEY.md §2.10 — pod-level
+delegation only); this module is the in-workload half the reference left to
+CUDA images. Mesh geometry is chosen so the innermost axes map to ICI
+neighbours (``jax.experimental.mesh_utils`` handles TPU physical layout) and
+``data`` is outermost so its collectives can ride DCN across slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+#: Order matters: outermost (slowest-varying, DCN-friendly) first; the
+#: innermost axes land on physically adjacent chips for cheap collectives.
+CANONICAL_AXES: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+
+#: Axes over which a batch is split (each holds a distinct slice of examples).
+BATCH_AXES: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each canonical axis; unspecified axes default to 1.
+
+    ``data=-1`` (or any single axis set to -1) means "whatever is left of
+    the device count after the explicit axes", mirroring how users think
+    about scaling out: fix model/seq parallelism, let dp absorb the rest.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def sizes(self, num_devices: int) -> Dict[str, int]:
+        raw = {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EXPERT: self.expert,
+            AXIS_SEQ: self.seq,
+            AXIS_MODEL: self.model,
+        }
+        wild = [a for a, s in raw.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1; got {wild}")
+        fixed = 1
+        for a, s in raw.items():
+            if s != -1:
+                if s < 1:
+                    raise ValueError(f"mesh axis {a!r} must be >= 1 or -1, got {s}")
+                fixed *= s
+        if wild:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            raw[wild[0]] = num_devices // fixed
+        total = int(np.prod(list(raw.values())))
+        if total != num_devices:
+            raise ValueError(
+                f"mesh axes {raw} multiply to {total}, but {num_devices} devices are present"
+            )
+        return raw
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return CANONICAL_AXES
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` with the canonical axis names.
+
+    Uses ``mesh_utils.create_device_mesh`` so the logical mesh respects the
+    physical ICI torus (on CPU test backends it degrades to a reshape).
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in CANONICAL_AXES)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=np.asarray(devices))
+    except (ValueError, AssertionError, NotImplementedError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, CANONICAL_AXES)
+
+
+def batch_spec(extra_dims: int = 0) -> P:
+    """PartitionSpec splitting dim 0 over every batch axis, rest replicated."""
+    return P(BATCH_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def global_batch_divisor(mesh: Mesh) -> int:
+    """How many ways the batch dimension is split on this mesh."""
+    n = 1
+    for a in BATCH_AXES:
+        n *= mesh.shape[a]
+    return n

@@ -1,0 +1,116 @@
+"""Logical-axis sharding rules: how parameter pytrees land on the mesh.
+
+Models annotate parameters with *logical* axis names (``"embed"``,
+``"mlp"``, ``"heads"``, ``"vocab"`` …); a :class:`LogicalRules` table maps
+logical names to mesh axes. This decouples model code from parallelism
+strategy: the same BERT runs pure-dp, fsdp, or 2-way tensor-parallel by
+swapping rule tables, with XLA inserting the all-gathers/reduce-scatters.
+
+The reference has no analog (workload-internal concern, SURVEY.md §2.10);
+the design follows the public scaling-book recipe: pick a mesh, annotate
+shardings, let XLA place collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+
+MeshAxes = Union[str, Tuple[str, ...], None]
+
+
+@dataclass(frozen=True)
+class LogicalRules:
+    """Mapping from logical axis name -> mesh axis (or None = replicate)."""
+
+    rules: Tuple[Tuple[str, MeshAxes], ...]
+
+    @classmethod
+    def of(cls, **rules: MeshAxes) -> "LogicalRules":
+        return cls(tuple(rules.items()))
+
+    def mesh_axes(self, logical: str) -> MeshAxes:
+        for name, axes in self.rules:
+            if name == logical:
+                return axes
+        return None
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        return P(*(self.mesh_axes(a) if a else None for a in logical_axes))
+
+    def extended(self, **overrides: MeshAxes) -> "LogicalRules":
+        kept = tuple((n, a) for n, a in self.rules if n not in overrides)
+        return LogicalRules(kept + tuple(overrides.items()))
+
+
+#: Everything replicated — single chip or pure data parallelism.
+REPLICATED_RULES = LogicalRules.of()
+
+#: ZeRO-3: shard the largest parameter axis over the fsdp mesh axis.
+FSDP_RULES = LogicalRules.of(
+    embed=AXIS_FSDP,
+    vocab=AXIS_FSDP,
+    conv_out=AXIS_FSDP,
+)
+
+#: Megatron-style tensor parallelism for transformer blocks, composed with
+#: fsdp on the embedding axis.
+TENSOR_PARALLEL_RULES = LogicalRules.of(
+    embed=AXIS_FSDP,
+    vocab=AXIS_MODEL,
+    heads=AXIS_MODEL,
+    mlp=AXIS_MODEL,
+    conv_out=AXIS_MODEL,
+    expert=("expert",),
+)
+
+
+def logical_sharding(mesh: Mesh, rules: LogicalRules, logical_axes: Sequence[Optional[str]]) -> NamedSharding:
+    return NamedSharding(mesh, rules.spec(logical_axes))
+
+
+def _infer_logical_axes(path: Tuple[Any, ...], leaf: jax.Array) -> Tuple[Optional[str], ...]:
+    """Heuristic logical axes for an unannotated parameter, by name + rank.
+
+    Convention (matches kubeflow_tpu.models): kernels named ``*_proj``/
+    ``dense``/``conv`` get their output axis tagged; biases and norms
+    replicate. Models that need precise control pass explicit annotations.
+    """
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    name = "/".join(str(n) for n in names).lower()
+    rank = leaf.ndim
+    if rank <= 1:
+        return (None,) * rank
+    if "embedding" in name:
+        return ("vocab", "embed") + (None,) * (rank - 2)
+    if "conv" in name and rank == 4:
+        return (None, None, None, "conv_out")
+    if any(k in name for k in ("mlp", "intermediate", "wi", "up_proj", "gate")):
+        return (None,) * (rank - 1) + ("mlp",)
+    if any(k in name for k in ("query", "key", "value", "qkv", "attn")):
+        return (None,) * (rank - 1) + ("heads",)
+    if any(k in name for k in ("out_proj", "wo", "down_proj", "output")):
+        return ("mlp",) + (None,) * (rank - 1)
+    if rank == 2:
+        return ("embed", None)
+    return (None,) * rank
+
+
+def shard_pytree(params: Any, mesh: Mesh, rules: LogicalRules) -> Any:
+    """NamedShardings for a parameter pytree (heuristic logical axes)."""
+
+    def leaf_sharding(path: Tuple[Any, ...], leaf: Any) -> NamedSharding:
+        axes = _infer_logical_axes(path, leaf)
+        return logical_sharding(mesh, rules, axes)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def constrain(x: jax.Array, logical_axes: Sequence[Optional[str]], rules: LogicalRules) -> jax.Array:
+    """``with_sharding_constraint`` by logical names, for use inside jit."""
+    return jax.lax.with_sharding_constraint(x, rules.spec(logical_axes))

@@ -258,7 +258,9 @@ def mutate_pod(
         mutated = apply_pod_defaults(pod, matching, cluster_domain)
         METRICS.counter("poddefault_apply_total", result="success").inc()
         return mutated
-    except PodDefaultConflict as e:
+    except (PodDefaultConflict, ValueError, KeyError, TypeError, AttributeError) as e:
+        # A malformed PodDefault (bad tpu block, bad topology string) must not
+        # make pod CREATE fail — same pass-through-and-annotate contract.
         METRICS.counter("poddefault_apply_total", result="conflict").inc()
         log.warning("pod %s/%s: %s", apimeta.namespace_of(pod), apimeta.name_of(pod), e)
         pod = apimeta.deepcopy(pod)

@@ -1,0 +1,139 @@
+"""Tier-1 tests for kubeflow_tpu.parallel on the 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing distributed control flow on
+CPU-only CI (SURVEY.md §4): ring attention is checked for exactness against
+single-device attention, mesh construction for axis bookkeeping.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    MeshConfig,
+    make_mesh,
+)
+from kubeflow_tpu.parallel.distributed import (
+    identity_from_env,
+    ordinal_from_hostname,
+)
+from kubeflow_tpu.parallel.mesh import global_batch_divisor
+from kubeflow_tpu.parallel.ring_attention import full_attention, ring_attention
+from kubeflow_tpu.parallel.sharding import (
+    FSDP_RULES,
+    TENSOR_PARALLEL_RULES,
+    LogicalRules,
+)
+from kubeflow_tpu.tpu.env import jax_worker_env, env_list_to_dict
+from kubeflow_tpu.tpu.topology import parse_topology
+
+
+class TestMeshConfig:
+    def test_wildcard_data_axis(self):
+        sizes = MeshConfig(model=2).sizes(8)
+        assert sizes[AXIS_DATA] == 4 and sizes[AXIS_MODEL] == 2
+
+    def test_explicit_product_must_match(self):
+        with pytest.raises(ValueError):
+            MeshConfig(data=3, model=2).sizes(8)
+
+    def test_two_wildcards_rejected(self):
+        with pytest.raises(ValueError):
+            MeshConfig(data=-1, fsdp=-1).sizes(8)
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError):
+            MeshConfig(model=3).sizes(8)
+
+    def test_make_mesh_shape(self):
+        mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+        assert mesh.shape[AXIS_DATA] == 2
+        assert mesh.shape[AXIS_SEQ] == 2
+        assert mesh.shape[AXIS_MODEL] == 2
+        assert global_batch_divisor(mesh) == 2
+
+    def test_default_mesh_all_data(self):
+        mesh = make_mesh()
+        assert mesh.shape[AXIS_DATA] == len(jax.devices())
+
+
+class TestLogicalRules:
+    def test_spec_lookup_and_default_replicate(self):
+        rules = LogicalRules.of(embed="fsdp", heads="model")
+        spec = rules.spec(["embed", None, "heads"])
+        assert spec == jax.sharding.PartitionSpec("fsdp", None, "model")
+
+    def test_unknown_logical_axis_replicates(self):
+        assert FSDP_RULES.spec(["nonexistent"]) == jax.sharding.PartitionSpec(None)
+
+    def test_extended_overrides(self):
+        rules = TENSOR_PARALLEL_RULES.extended(mlp=None)
+        assert rules.mesh_axes("mlp") is None
+        assert rules.mesh_axes("heads") == AXIS_MODEL
+
+
+class TestDistributedBootstrap:
+    def test_ordinal_parsing(self):
+        assert ordinal_from_hostname("nb-train-3") == 3
+        assert ordinal_from_hostname("nb-train-3.nb-train.ns.svc") == 3
+        assert ordinal_from_hostname("plainhost") == 0
+
+    def test_identity_from_webhook_env(self):
+        topo = parse_topology("v5e", "4x4")  # 16 chips -> 4 hosts
+        env = env_list_to_dict(jax_worker_env(topo, "nb", "team-a"))
+        ident = identity_from_env(env, hostname="nb-2")
+        assert ident.num_processes == 4
+        assert ident.process_id == 2
+        assert not ident.is_coordinator
+        assert ident.coordinator_address == "nb-0.nb.team-a.svc.cluster.local:8476"
+
+    def test_ordinal_out_of_range(self):
+        with pytest.raises(ValueError):
+            identity_from_env({"JAX_NUM_PROCESSES": "2"}, hostname="nb-5")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_par", [2, 4])
+def test_ring_attention_matches_full(causal, seq_par):
+    mesh = make_mesh(MeshConfig(data=1, seq=seq_par), devices=jax.devices()[:seq_par])
+    rng = np.random.RandomState(0)
+    b, L, h, d = 2, 32, 4, 8
+    q = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    expected = full_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
+
+
+def test_ring_attention_bf16_stable():
+    mesh = make_mesh(MeshConfig(data=1, seq=4), devices=jax.devices()[:4])
+    rng = np.random.RandomState(1)
+    b, L, h, d = 1, 64, 2, 16
+    mk = lambda: jnp.asarray(rng.randn(b, L, h, d), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    out = ring_attention(q, k, v, mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+    expected = full_attention(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(expected), atol=0.1
+    )
+
+
+def test_ring_attention_under_jit_with_dp():
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    rng = np.random.RandomState(2)
+    b, L, h, d = 4, 16, 2, 8
+    q = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    fn = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh, causal=True))
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)),
+        np.asarray(full_attention(q, k, v, causal=True)),
+        atol=1e-5,
+    )
