@@ -1,0 +1,103 @@
+"""Headline benchmark: ResNet-50 training MFU on one TPU chip.
+
+The reference publishes no benchmark numbers (BASELINE.md); the driver's
+north-star is ResNet-50 at >=60% MFU on v5e. This bench runs the flagship
+training step (fwd+bwd+SGD in one jit, bf16, synthetic data — measuring the
+compute path, not input pipeline) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` = measured MFU / 0.60 target (>=1.0 beats the north-star).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BATCH_CANDIDATES = [256, 128, 64, 32]
+TIMED_STEPS = 10
+TARGET_MFU = 0.60
+
+# XLA cost-analysis fallback: ResNet-50 fwd ~8.2 GFLOP/image @224 (2*MACs),
+# train step ~3x forward.
+ANALYTIC_FWD_FLOPS_PER_IMAGE = 8.2e9
+
+
+def _bench(batch: int):
+    from kubeflow_tpu.models import ResNet50
+    from kubeflow_tpu.training import ClassifierTask, compiled_flops, mfu
+    from kubeflow_tpu.training.flops import detect_generation
+    from kubeflow_tpu.training.classifier import sgd_momentum
+
+    model = ResNet50(num_classes=1000)
+    task = ClassifierTask(model=model, optimizer=sgd_momentum(lr=0.1, total_steps=1000))
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(rng, (batch,), 0, 1000)
+    state = task.init(rng, images)
+    step = task.make_train_step()
+
+    flops = None
+    try:
+        flops = compiled_flops(step, state, images, labels)
+    except Exception:
+        pass
+    if not flops:
+        flops = 3.0 * ANALYTIC_FWD_FLOPS_PER_IMAGE * batch
+
+    # Warmup (compile + first run).
+    state, metrics = step(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / TIMED_STEPS
+
+    gen = detect_generation()
+    return {
+        "images_per_sec_per_chip": batch / dt,
+        "step_seconds": dt,
+        "mfu": mfu(flops, dt, num_chips=1, generation=gen),
+        "generation": gen,
+        "batch": batch,
+        "flops_per_step": flops,
+    }
+
+
+def main() -> int:
+    platform = jax.devices()[0].platform
+    last_err = None
+    for batch in BATCH_CANDIDATES:
+        try:
+            r = _bench(batch)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"resnet50_train_mfu_{r['generation']}_1chip",
+                        "value": round(r["mfu"] * 100, 2),
+                        "unit": "percent_mfu",
+                        "vs_baseline": round(r["mfu"] / TARGET_MFU, 4),
+                        "images_per_sec_per_chip": round(r["images_per_sec_per_chip"], 1),
+                        "batch": r["batch"],
+                        "platform": platform,
+                    }
+                )
+            )
+            return 0
+        except Exception as e:  # OOM at this batch -> try smaller
+            last_err = e
+    print(json.dumps({"metric": "resnet50_train_mfu", "value": 0.0, "unit": "percent_mfu",
+                      "vs_baseline": 0.0, "error": str(last_err)[:200]}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,313 @@
+"""Profile controller: the multi-tenancy engine, TPU-quota-aware.
+
+Re-implements the reference profile-controller
+(components/profile-controller/controllers/profile_controller.go) for the
+TPU platform:
+
+- cluster-scoped ``Profile`` CR → Namespace (owner annotation,
+  ``istio-injection: enabled`` label; adoption-conflict produces a Failed
+  condition, not a crash — reference :126-191),
+- Istio AuthorizationPolicy ``ns-owner-access-istio`` allowing the owner by
+  userid header, intra-namespace traffic, and probe paths (:340-438),
+- ServiceAccounts ``default-editor``/``default-viewer`` bound to
+  ClusterRoles ``kubeflow-edit``/``kubeflow-view`` (:201-217, 474-520),
+- owner RoleBinding ``namespaceAdmin`` → ``kubeflow-admin`` (:221-244),
+- ResourceQuota ``kf-resource-quota`` from ``spec.resourceQuotaSpec``
+  (:245-261) — **the per-namespace TPU chip quota hook**
+  (``requests.google.com/tpu``), with a platform default applied when the
+  admin configures ``default_tpu_chips``,
+- plugin apply/revoke with finalizer-gated teardown (:262-312); the cloud
+  IAM plugins (WorkloadIdentity/AwsIam) annotate ServiceAccounts; actual
+  cloud API calls are delegated to an injectable ``iam_backend`` so tests
+  (and clusters without cloud credentials) run without egress — the same
+  separation the reference tests use (plugin_iam_test.go manipulates policy
+  JSON without AWS calls).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.manager import Reconciler, Request, Result
+from ..runtime.metrics import METRICS
+from ..tpu.topology import RESOURCE_TPU
+
+log = logging.getLogger("kubeflow_tpu.profile")
+
+PROFILE_API = "kubeflow.org/v1"
+OWNER_ANNOTATION = "owner"
+FINALIZER = "profile-controller.kubeflow.org/finalizer"
+QUOTA_NAME = "kf-resource-quota"
+AUTH_POLICY_NAME = "ns-owner-access-istio"
+TPU_QUOTA_KEY = f"requests.{RESOURCE_TPU}"
+
+#: ClusterRole name ↔ workgroup role (reference kfam bindings.go:39-46).
+ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit", "view": "kubeflow-view"}
+
+
+@dataclass
+class ProfileConfig:
+    userid_header: str = "kubeflow-userid"
+    userid_prefix: str = ""
+    workload_identity: str = ""  # default GCP SA to bind, if set
+    default_tpu_chips: Optional[int] = None  # default per-namespace quota
+    # Injectable cloud-IAM backend: (action, plugin_kind, spec, namespace) -> None
+    iam_backend: Optional[Callable[[str, str, Dict[str, Any], str], None]] = None
+
+
+class ProfileReconciler(Reconciler):
+    FOR = (PROFILE_API, "Profile")
+    OWNS = [
+        ("v1", "Namespace"),
+        ("v1", "ServiceAccount"),
+        ("rbac.authorization.k8s.io/v1", "RoleBinding"),
+        ("security.istio.io/v1beta1", "AuthorizationPolicy"),
+        ("v1", "ResourceQuota"),
+    ]
+
+    def __init__(self, config: Optional[ProfileConfig] = None):
+        self.config = config or ProfileConfig()
+
+    # -- reconcile -----------------------------------------------------------
+    def reconcile(self, client: Client, req: Request) -> Result:
+        profile = client.get_opt(PROFILE_API, "Profile", req.name)
+        if profile is None:
+            return Result()
+        METRICS.counter("request_kf", kind="profile").inc()
+
+        md = profile["metadata"]
+        if md.get("deletionTimestamp"):
+            return self._finalize(client, profile)
+        if FINALIZER not in (md.get("finalizers") or []):
+            profile = apimeta.deepcopy(profile)
+            profile["metadata"].setdefault("finalizers", []).append(FINALIZER)
+            profile = client.update(profile)
+
+        try:
+            ns_ok = self._reconcile_namespace(client, profile)
+            if not ns_ok:
+                # Ownership conflict: error condition set; periodic re-check.
+                return Result(requeue_after=5.0)
+            self._reconcile_auth_policy(client, profile)
+            self._reconcile_service_accounts(client, profile)
+            self._reconcile_owner_binding(client, profile)
+            self._reconcile_quota(client, profile)
+            self._apply_plugins(client, profile)
+        except Exception as e:
+            METRICS.counter("request_kf_failure", kind="profile", severity="major").inc()
+            self._set_condition(client, profile, "Failed", str(e))
+            raise
+        self._set_condition(client, profile, "Successful", "")
+        return Result()
+
+    # -- namespace -----------------------------------------------------------
+    def _reconcile_namespace(self, client: Client, profile: Dict[str, Any]) -> bool:
+        name = apimeta.name_of(profile)
+        owner = profile.get("spec", {}).get("owner", {}).get("name", "")
+        ns = client.get_opt("v1", "Namespace", name)
+        if ns is None:
+            ns = apimeta.new_object(
+                "v1",
+                "Namespace",
+                name,
+                labels={
+                    "istio-injection": "enabled",
+                    "app.kubernetes.io/part-of": "kubeflow-profile",
+                },
+                annotations={OWNER_ANNOTATION: owner},
+            )
+            apimeta.set_owner_reference(ns, profile)
+            client.create(ns)
+            return True
+        anns = apimeta.annotations_of(ns)
+        if OWNER_ANNOTATION not in anns:
+            # Adopt: pre-existing namespace without owner (reference :166-183).
+            ns = apimeta.deepcopy(ns)
+            ns["metadata"].setdefault("annotations", {})[OWNER_ANNOTATION] = owner
+            ns["metadata"].setdefault("labels", {})["istio-injection"] = "enabled"
+            apimeta.set_owner_reference(ns, profile)
+            client.update(ns)
+            return True
+        if anns.get(OWNER_ANNOTATION) != owner:
+            self._set_condition(
+                client,
+                profile,
+                "Failed",
+                f"namespace {name} owned by {anns.get(OWNER_ANNOTATION)!r}, not {owner!r}",
+            )
+            return False
+        return True
+
+    # -- istio authz ---------------------------------------------------------
+    def _reconcile_auth_policy(self, client: Client, profile: Dict[str, Any]) -> None:
+        name = apimeta.name_of(profile)
+        owner = profile.get("spec", {}).get("owner", {}).get("name", "")
+        header = self.config.userid_header
+        principal = f"{self.config.userid_prefix}{owner}"
+        policy = apimeta.new_object(
+            "security.istio.io/v1beta1",
+            "AuthorizationPolicy",
+            AUTH_POLICY_NAME,
+            name,
+            spec={
+                "rules": [
+                    # Owner by identity header (reference :352-366).
+                    {"when": [{"key": f"request.headers[{header}]", "values": [principal]}]},
+                    # Intra-namespace traffic (reference :368-377).
+                    {"from": [{"source": {"namespaces": [name]}}]},
+                    # Health/probe paths (reference :368-383).
+                    {"to": [{"operation": {"paths": ["/healthz", "/metrics", "/wait-for-drain"]}}]},
+                ]
+            },
+        )
+        apimeta.set_owner_reference(policy, profile)
+        _create_or_update(client, policy)
+
+    # -- rbac ----------------------------------------------------------------
+    def _reconcile_service_accounts(self, client: Client, profile: Dict[str, Any]) -> None:
+        ns = apimeta.name_of(profile)
+        for sa_name, role in (("default-editor", "kubeflow-edit"), ("default-viewer", "kubeflow-view")):
+            sa = apimeta.new_object("v1", "ServiceAccount", sa_name, ns)
+            apimeta.set_owner_reference(sa, profile)
+            existing = client.get_opt("v1", "ServiceAccount", sa_name, ns)
+            if existing is None:
+                client.create(sa)
+            binding = apimeta.new_object(
+                "rbac.authorization.k8s.io/v1",
+                "RoleBinding",
+                sa_name,
+                ns,
+                roleRef={"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": role},
+                subjects=[{"kind": "ServiceAccount", "name": sa_name, "namespace": ns}],
+            )
+            apimeta.set_owner_reference(binding, profile)
+            _create_or_update(client, binding)
+
+    def _reconcile_owner_binding(self, client: Client, profile: Dict[str, Any]) -> None:
+        ns = apimeta.name_of(profile)
+        owner = profile.get("spec", {}).get("owner", {})
+        binding = apimeta.new_object(
+            "rbac.authorization.k8s.io/v1",
+            "RoleBinding",
+            "namespaceAdmin",
+            ns,
+            annotations={
+                "role": "admin",
+                "user": owner.get("name", ""),
+            },
+            roleRef={"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": ROLE_MAP["admin"]},
+            subjects=[owner or {"kind": "User", "name": ""}],
+        )
+        apimeta.set_owner_reference(binding, profile)
+        _create_or_update(client, binding)
+
+    # -- quota (the TPU hook) ------------------------------------------------
+    def _reconcile_quota(self, client: Client, profile: Dict[str, Any]) -> None:
+        ns = apimeta.name_of(profile)
+        spec = apimeta.deepcopy(profile.get("spec", {}).get("resourceQuotaSpec") or {})
+        if self.config.default_tpu_chips is not None:
+            spec.setdefault("hard", {}).setdefault(TPU_QUOTA_KEY, str(self.config.default_tpu_chips))
+        if not spec.get("hard"):
+            # No quota requested: remove a previously-applied one.
+            client.delete_opt("v1", "ResourceQuota", QUOTA_NAME, ns)
+            return
+        quota = apimeta.new_object("v1", "ResourceQuota", QUOTA_NAME, ns, spec=spec)
+        apimeta.set_owner_reference(quota, profile)
+        _create_or_update(client, quota)
+
+    # -- plugins -------------------------------------------------------------
+    def _plugins_of(self, profile: Dict[str, Any]) -> List[Dict[str, Any]]:
+        plugins = list(profile.get("spec", {}).get("plugins") or [])
+        if self.config.workload_identity and not any(
+            p.get("kind") == "WorkloadIdentity" for p in plugins
+        ):
+            # PatchDefaultPluginSpec (reference :592-615).
+            plugins.append(
+                {"kind": "WorkloadIdentity", "spec": {"gcpServiceAccount": self.config.workload_identity}}
+            )
+        return plugins
+
+    def _apply_plugins(self, client: Client, profile: Dict[str, Any]) -> None:
+        ns = apimeta.name_of(profile)
+        for plugin in self._plugins_of(profile):
+            kind = plugin.get("kind", "")
+            spec = plugin.get("spec") or {}
+            if kind == "WorkloadIdentity":
+                self._annotate_ksa(
+                    client, ns, "default-editor",
+                    {"iam.gke.io/gcp-service-account": spec.get("gcpServiceAccount", "")},
+                )
+            elif kind == "AwsIamForServiceAccount":
+                self._annotate_ksa(
+                    client, ns, "default-editor",
+                    {"eks.amazonaws.com/role-arn": spec.get("awsIamRole", "")},
+                )
+            else:
+                raise ValueError(f"unknown plugin kind {kind!r}")
+            if self.config.iam_backend:
+                self.config.iam_backend("apply", kind, spec, ns)
+
+    def _revoke_plugins(self, client: Client, profile: Dict[str, Any]) -> None:
+        ns = apimeta.name_of(profile)
+        for plugin in self._plugins_of(profile):
+            kind = plugin.get("kind", "")
+            spec = plugin.get("spec") or {}
+            if self.config.iam_backend:
+                try:
+                    self.config.iam_backend("revoke", kind, spec, ns)
+                except Exception:
+                    log.exception("plugin revoke failed (idempotent; continuing)")
+
+    def _annotate_ksa(self, client: Client, ns: str, sa_name: str, annotations: Dict[str, str]) -> None:
+        sa = client.get_opt("v1", "ServiceAccount", sa_name, ns)
+        if sa is None:
+            return
+        current = apimeta.annotations_of(sa)
+        if all(current.get(k) == v for k, v in annotations.items()):
+            return
+        sa = apimeta.deepcopy(sa)
+        sa["metadata"].setdefault("annotations", {}).update(annotations)
+        client.update(sa)
+
+    # -- teardown ------------------------------------------------------------
+    def _finalize(self, client: Client, profile: Dict[str, Any]) -> Result:
+        self._revoke_plugins(client, profile)
+        client.delete_opt("v1", "Namespace", apimeta.name_of(profile))
+        profile = apimeta.deepcopy(profile)
+        finalizers = profile["metadata"].get("finalizers") or []
+        if FINALIZER in finalizers:
+            profile["metadata"]["finalizers"] = [f for f in finalizers if f != FINALIZER]
+            client.update(profile)
+        return Result()
+
+    # -- status --------------------------------------------------------------
+    def _set_condition(self, client: Client, profile: Dict[str, Any], type_: str, message: str) -> None:
+        fresh = client.get_opt(PROFILE_API, "Profile", apimeta.name_of(profile))
+        if fresh is None:
+            return
+        conditions = [{"type": type_, "status": "True", "message": message}]
+        if (fresh.get("status") or {}).get("conditions") == conditions:
+            return
+        fresh = apimeta.deepcopy(fresh)
+        fresh["status"] = {"conditions": conditions}
+        client.update_status(fresh)
+
+
+def _create_or_update(client: Client, obj: Dict[str, Any]) -> None:
+    existing = client.get_opt(
+        apimeta.api_version_of(obj), obj["kind"], apimeta.name_of(obj), apimeta.namespace_of(obj)
+    )
+    if existing is None:
+        client.create(obj)
+        return
+    changed = any(existing.get(k) != obj.get(k) for k in ("spec", "roleRef", "subjects"))
+    if changed:
+        merged = apimeta.deepcopy(existing)
+        for k in ("spec", "roleRef", "subjects"):
+            if k in obj:
+                merged[k] = obj[k]
+        client.update(merged)

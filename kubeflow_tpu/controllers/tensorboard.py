@@ -1,0 +1,237 @@
+"""Tensorboard controller: log-visualization workloads (incl. JAX profiles).
+
+Re-implements the reference tensorboard-controller
+(components/tensorboard-controller/controllers/tensorboard_controller.go):
+``Tensorboard`` CR with ``spec.logspath`` → Deployment + Service +
+VirtualService; status from Deployment conditions (:117-140).
+
+- ``pvc://<name>[/<subpath>]`` mounts the PVC (:152-227),
+- ``gs://...`` paths mount the GCP credential secret ``user-gcp-sa``,
+- RWO co-scheduling: when ``rwo_pvc_scheduling`` is on and the PVC is
+  ReadWriteOnce, pod affinity pins the viewer onto the node where the pod
+  already mounting it runs (:190-215, 437-447).
+
+TPU addition: the deployment serves TensorBoard with the profile plugin so
+JAX/XLA device traces captured by ``kubeflow_tpu.training`` land here — the
+platform's tracing story (SURVEY.md §5 'tracing: green-field').
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.manager import Reconciler, Request, Result
+from ..runtime import reconcile as rh
+
+TB_API = "tensorboard.kubeflow.org/v1alpha1"
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.5.1"
+
+
+@dataclass
+class TensorboardConfig:
+    use_istio: bool = True
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
+    cluster_domain: str = "cluster.local"
+    rwo_pvc_scheduling: bool = False
+    image: str = DEFAULT_IMAGE
+
+
+def parse_logspath(logspath: str) -> Tuple[str, Dict[str, Any]]:
+    """Classify a logspath: ("pvc", {name, subpath}) or ("cloud", {uri})."""
+    if logspath.startswith("pvc://"):
+        rest = logspath[len("pvc://"):]
+        name, _, subpath = rest.partition("/")
+        if not name:
+            raise ValueError(f"bad logspath {logspath!r}: missing PVC name")
+        return "pvc", {"name": name, "subpath": subpath}
+    if not logspath:
+        raise ValueError("empty logspath")
+    return "cloud", {"uri": logspath}
+
+
+class TensorboardReconciler(Reconciler):
+    FOR = (TB_API, "Tensorboard")
+    OWNS = [
+        ("apps/v1", "Deployment"),
+        ("v1", "Service"),
+        ("networking.istio.io/v1beta1", "VirtualService"),
+    ]
+
+    def __init__(self, config: Optional[TensorboardConfig] = None):
+        self.config = config or TensorboardConfig()
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        tb = client.get_opt(*self.FOR, req.name, req.namespace)
+        if tb is None:
+            return Result()
+        try:
+            dep = self._generate_deployment(client, tb)
+        except (ValueError, KeyError, TypeError) as e:
+            fresh = apimeta.deepcopy(tb)
+            fresh["status"] = {
+                "conditions": [
+                    {"type": "Failed", "status": "True", "reason": "InvalidSpec", "message": str(e)}
+                ]
+            }
+            client.update_status(fresh)
+            return Result()
+        rh.reconcile_object(client, dep, tb)
+        rh.reconcile_object(client, self._generate_service(tb), tb)
+        if self.config.use_istio:
+            rh.reconcile_object(client, self._generate_virtual_service(tb), tb)
+        self._update_status(client, tb)
+        return Result()
+
+    def _generate_deployment(self, client: Client, tb: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(tb), apimeta.namespace_of(tb)
+        logspath = tb.get("spec", {}).get("logspath", "")
+        kind, info = parse_logspath(logspath)
+
+        volumes, mounts, env, logdir = [], [], [], logspath
+        affinity: Dict[str, Any] = {}
+        if kind == "pvc":
+            volumes.append(
+                {"name": "tb-logs", "persistentVolumeClaim": {"claimName": info["name"]}}
+            )
+            mounts.append({"name": "tb-logs", "mountPath": "/tb-logs", "subPath": info["subpath"] or None})
+            mounts[-1] = {k: v for k, v in mounts[-1].items() if v is not None}
+            logdir = "/tb-logs"
+            if self.config.rwo_pvc_scheduling:
+                node = self._rwo_pvc_node(client, ns, info["name"])
+                if node:
+                    affinity = {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": "kubernetes.io/hostname",
+                                                "operator": "In",
+                                                "values": [node],
+                                            }
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    }
+        else:
+            # Cloud path: mount GCP SA secret (reference :213-227).
+            volumes.append({"name": "gcp-creds", "secret": {"secretName": "user-gcp-sa"}})
+            mounts.append({"name": "gcp-creds", "mountPath": "/secret/gcp", "readOnly": True})
+            env.append(
+                {"name": "GOOGLE_APPLICATION_CREDENTIALS", "value": "/secret/gcp/user-gcp-sa.json"}
+            )
+
+        pod_spec: Dict[str, Any] = {
+            "containers": [
+                {
+                    "name": "tensorboard",
+                    "image": self.config.image,
+                    "command": ["/usr/local/bin/tensorboard"],
+                    "args": [
+                        f"--logdir={logdir}",
+                        "--bind_all",
+                        "--port=6006",
+                        # JAX/XLA profile plugin traces live under plugins/profile
+                        # inside the logdir; no extra flags needed, listed here
+                        # for operator discoverability.
+                    ],
+                    "ports": [{"containerPort": 6006, "name": "http"}],
+                    "volumeMounts": mounts,
+                    "env": env,
+                }
+            ],
+            "volumes": volumes,
+        }
+        if affinity:
+            pod_spec["affinity"] = affinity
+
+        return apimeta.new_object(
+            "apps/v1",
+            "Deployment",
+            name,
+            ns,
+            spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": "tensorboard", "tb-name": name}},
+                "template": {
+                    "metadata": {"labels": {"app": "tensorboard", "tb-name": name}},
+                    "spec": pod_spec,
+                },
+            },
+        )
+
+    def _rwo_pvc_node(self, client: Client, ns: str, pvc_name: str) -> Optional[str]:
+        """Node already mounting the RWO PVC (reference :437-447)."""
+        pvc = client.get_opt("v1", "PersistentVolumeClaim", pvc_name, ns)
+        if pvc is None:
+            return None
+        modes = pvc.get("spec", {}).get("accessModes") or []
+        if "ReadWriteOnce" not in modes:
+            return None
+        for pod in client.list("v1", "Pod", ns):
+            if pod.get("status", {}).get("phase") != "Running":
+                continue
+            for vol in pod.get("spec", {}).get("volumes", []) or []:
+                claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+                if claim == pvc_name and pod.get("spec", {}).get("nodeName"):
+                    return pod["spec"]["nodeName"]
+        return None
+
+    def _generate_service(self, tb: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(tb), apimeta.namespace_of(tb)
+        return apimeta.new_object(
+            "v1",
+            "Service",
+            name,
+            ns,
+            spec={
+                "selector": {"app": "tensorboard", "tb-name": name},
+                "ports": [{"name": f"http-{name}", "port": 80, "targetPort": 6006}],
+            },
+        )
+
+    def _generate_virtual_service(self, tb: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(tb), apimeta.namespace_of(tb)
+        prefix = f"/tensorboard/{ns}/{name}/"
+        return apimeta.new_object(
+            "networking.istio.io/v1beta1",
+            "VirtualService",
+            f"tensorboard-{ns}-{name}",
+            ns,
+            spec={
+                "hosts": [self.config.istio_host],
+                "gateways": [self.config.istio_gateway],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}.{ns}.svc.{self.config.cluster_domain}",
+                                    "port": {"number": 80},
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        )
+
+    def _update_status(self, client: Client, tb: Dict[str, Any]) -> None:
+        name, ns = apimeta.name_of(tb), apimeta.namespace_of(tb)
+        dep = client.get_opt("apps/v1", "Deployment", name, ns)
+        conditions = (dep or {}).get("status", {}).get("conditions", [])
+        ready = (dep or {}).get("status", {}).get("readyReplicas", 0)
+        status = {"conditions": conditions, "readyReplicas": ready}
+        if tb.get("status") != status:
+            fresh = apimeta.deepcopy(tb)
+            fresh["status"] = status
+            client.update_status(fresh)

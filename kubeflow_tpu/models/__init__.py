@@ -1,0 +1,14 @@
+"""Model zoo for the platform's workload side.
+
+The reference platform ships no models — training is delegated to workload
+pods (SURVEY.md §2.10). BASELINE.json's north-star configs name three:
+ResNet-50 (the MFU benchmark), BERT-base (the serving path), and MNIST (the
+Katib HPO trial). All are flax modules with bf16 compute / f32 params and
+parameter names chosen to match ``kubeflow_tpu.parallel.sharding``'s logical
+axis heuristics, so the same model runs replicated, fsdp, or tensor-parallel
+by swapping rule tables.
+"""
+
+from kubeflow_tpu.models.resnet import ResNet50, ResNet18  # noqa: F401
+from kubeflow_tpu.models.bert import BertConfig, BertEncoder, BertForMaskedLM  # noqa: F401
+from kubeflow_tpu.models.mnist import MnistCNN  # noqa: F401

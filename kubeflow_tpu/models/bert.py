@@ -1,0 +1,143 @@
+"""BERT encoder for TPU: serving-path model (BASELINE: BERT-base inference)
+and the tensor/sequence-parallel exemplar.
+
+TPU-first choices:
+- bf16 compute / f32 params; attention softmax accumulates in f32,
+- the attention primitive is injectable: ``full_attention`` (one chip,
+  short sequences) or ``ring_attention`` (seq-parallel long context) from
+  kubeflow_tpu.parallel.ring_attention — the module code is identical,
+- parameter names (query/key/value, out_proj, mlp_wi/mlp_wo, embedding)
+  line up with kubeflow_tpu.parallel.sharding's logical-axis heuristics so
+  TENSOR_PARALLEL_RULES shards heads/mlp over the ``model`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.ring_attention import full_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        """For tests and HPO trials on CPU."""
+        return cls(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                   intermediate_size=128, max_position_embeddings=128)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, hidden, mask=None):
+        cfg = self.config
+        dense = lambda name: nn.DenseGeneral(
+            features=(cfg.num_heads, cfg.head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        q, k, v = dense("query")(hidden), dense("key")(hidden), dense("value")(hidden)
+        ctx = self.attention_fn(q, k, v)  # [b, L, heads, head_dim]
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="out_proj",
+        )(ctx)
+        return out
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, hidden, mask=None):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       param_dtype=jnp.float32, name=name)
+        attn_out = BertSelfAttention(cfg, self.attention_fn, name="attention")(hidden, mask)
+        hidden = ln("attention_ln")(hidden + attn_out)
+        mlp = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="mlp_wi")(hidden)
+        mlp = nn.gelu(mlp, approximate=True)
+        mlp = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="mlp_wo")(mlp)
+        return ln("output_ln")(hidden + mlp)
+
+
+class BertEncoder(nn.Module):
+    """Token ids -> contextual embeddings [b, L, hidden]."""
+
+    config: BertConfig
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if position_ids is None:
+            position_ids = jnp.arange(input_ids.shape[-1])[None, :]
+        embed = lambda num, name: nn.Embed(
+            num, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name=name
+        )
+        hidden = (
+            embed(cfg.vocab_size, "word_embedding")(input_ids)
+            + embed(cfg.max_position_embeddings, "position_embedding")(position_ids)
+            + embed(cfg.type_vocab_size, "type_embedding")(token_type_ids)
+        )
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="embedding_ln")(hidden)
+        for i in range(cfg.num_layers):
+            hidden = BertLayer(cfg, self.attention_fn, name=f"layer_{i}")(hidden)
+        return hidden
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head for pretraining-style benchmarks + serving logits."""
+
+    config: BertConfig
+    attention_fn: Callable = full_attention
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.config
+        hidden = BertEncoder(cfg, self.attention_fn, name="encoder")(input_ids, token_type_ids)
+        hidden = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                          name="mlm_transform")(hidden)
+        hidden = nn.gelu(hidden, approximate=True)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="mlm_ln")(hidden)
+        # Logits in f32 for a stable softmax-xent.
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="mlm_head")(hidden.astype(jnp.float32))
+        return logits

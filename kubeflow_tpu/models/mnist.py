@@ -1,0 +1,39 @@
+"""MNIST CNN: the Katib HPO trial workload.
+
+The reference's Katib e2e launches an MNIST StudyJob and only asserts the CR
+reaches Running (testing/katib_studyjob_test.py:128-193). Here the trial is a
+real JAX model small enough for CPU CI, with the hyperparameters Katib-style
+suggestions tune (lr, dropout, width) exposed as constructor fields.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    width: int = 32
+    dropout_rate: float = 0.1
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (3, 3), dtype=self.dtype, param_dtype=jnp.float32, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.width * 2, (3, 3), dtype=self.dtype, param_dtype=jnp.float32, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype, param_dtype=jnp.float32, name="dense")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="classifier")(
+            x.astype(jnp.float32)
+        )
+        return x

@@ -55,6 +55,10 @@ def ordinal_from_hostname(hostname: Optional[str] = None) -> int:
 def identity_from_env(environ: Optional[dict] = None, hostname: Optional[str] = None) -> WorkerIdentity:
     env = os.environ if environ is None else environ
     num = int(env.get(ENV_NUM_PROCESSES, "1"))
+    if num <= 1:
+        # Single-process: hostname ordinals are meaningless ('tpu-vm-1' is not
+        # worker 1 of anything) — always process 0.
+        return WorkerIdentity(process_id=0, num_processes=1, coordinator_address=None)
     explicit = env.get(ENV_PROCESS_ID)
     pid = int(explicit) if explicit is not None else ordinal_from_hostname(hostname)
     coord = env.get(ENV_COORDINATOR_ADDRESS)

@@ -25,7 +25,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from kubeflow_tpu.parallel.mesh import AXIS_SEQ, BATCH_AXES
+from kubeflow_tpu.parallel.mesh import AXIS_MODEL, AXIS_SEQ, BATCH_AXES
 
 _NEG_BIG = -1e30
 
@@ -38,6 +38,7 @@ def _ring_attention_local(
     axis_name: str,
     causal: bool,
     scale: Optional[float],
+    vary_axes: tuple = (),
 ) -> jax.Array:
     """Per-device body. q/k/v: [batch, seq_local, heads, head_dim]."""
     orig_dtype = q.dtype
@@ -53,7 +54,7 @@ def _ring_attention_local(
     # Accumulators in f32 regardless of input dtype (bf16-safe softmax).
     # pvary marks them device-varying over the ring axis so the fori_loop
     # carry type stays fixed once ppermute'd blocks mix in.
-    vary = BATCH_AXES + (axis_name,)
+    vary = vary_axes or (BATCH_AXES + (axis_name,))
     o = lax.pvary(jnp.zeros((b, h, lq, d), jnp.float32), vary)
     m = lax.pvary(jnp.full((b, h, lq), _NEG_BIG, jnp.float32), vary)
     l = lax.pvary(jnp.zeros((b, h, lq), jnp.float32), vary)
@@ -100,11 +101,21 @@ def ring_attention(
     Inputs are globally [batch, seq, heads, head_dim] with seq sharded over
     ``axis_name`` and batch over the batch axes; output matches q's layout.
     Works with seq axis size 1 (degrades to one local softmax pass).
+
+    When the mesh has a non-trivial ``model`` axis the heads dimension is
+    sharded over it too (heads are independent in attention), composing
+    tensor parallelism with the ring; head count must then divide the axis.
     """
-    spec = P(BATCH_AXES, axis_name, None, None)
+    head_axes = AXIS_MODEL if mesh.shape.get(AXIS_MODEL, 1) > 1 else None
+    spec = P(BATCH_AXES, axis_name, head_axes, None)
+    vary_axes = BATCH_AXES + (axis_name,) + ((head_axes,) if head_axes else ())
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+            _ring_attention_local,
+            axis_name=axis_name,
+            causal=causal,
+            scale=scale,
+            vary_axes=vary_axes,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

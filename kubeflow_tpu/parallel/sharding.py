@@ -19,7 +19,7 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+from kubeflow_tpu.parallel.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL
 
 MeshAxes = Union[str, Tuple[str, ...], None]
 
@@ -66,7 +66,7 @@ TENSOR_PARALLEL_RULES = LogicalRules.of(
     heads=AXIS_MODEL,
     mlp=AXIS_MODEL,
     conv_out=AXIS_MODEL,
-    expert=("expert",),
+    expert=AXIS_EXPERT,
 )
 
 
@@ -75,30 +75,53 @@ def logical_sharding(mesh: Mesh, rules: LogicalRules, logical_axes: Sequence[Opt
 
 
 def _infer_logical_axes(path: Tuple[Any, ...], leaf: jax.Array) -> Tuple[Optional[str], ...]:
-    """Heuristic logical axes for an unannotated parameter, by name + rank.
+    """Heuristic logical axes for an unannotated parameter.
 
-    Convention (matches kubeflow_tpu.models): kernels named ``*_proj``/
-    ``dense``/``conv`` get their output axis tagged; biases and norms
-    replicate. Models that need precise control pass explicit annotations.
+    Matches on the parameter's *owning module* name (the path component
+    before flax's leaf name ``kernel``/``bias``/``embedding``/``scale``), so
+    ``attention/out_proj/kernel`` is classified by ``out_proj``, not by the
+    enclosing ``attention``. Convention matches kubeflow_tpu.models naming;
+    biases and norms replicate.
     """
-    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
-    name = "/".join(str(n) for n in names).lower()
+    names = [str(getattr(p, "key", getattr(p, "name", p))).lower() for p in path]
+    leaf_name = names[-1] if names else ""
+    parent = names[-2] if len(names) >= 2 else leaf_name
     rank = leaf.ndim
     if rank <= 1:
         return (None,) * rank
-    if "embedding" in name:
+    if leaf_name == "embedding" or "embedding" in parent:
         return ("vocab", "embed") + (None,) * (rank - 2)
-    if "conv" in name and rank == 4:
+    if "conv" in parent and rank == 4:
         return (None, None, None, "conv_out")
-    if any(k in name for k in ("mlp", "intermediate", "wi", "up_proj", "gate")):
+    if any(k in parent for k in ("query", "key", "value", "qkv")):
+        # DenseGeneral [embed, heads, head_dim] or Dense [embed, heads*dim]
+        return ("embed", "heads", None) if rank == 3 else ("embed", "heads")
+    if any(k in parent for k in ("out_proj", "wo", "down_proj", "o_proj")):
+        # DenseGeneral [heads, head_dim, embed] or Dense [mlp, embed]
+        return ("heads", None, "embed") if rank == 3 else ("mlp", "embed")
+    if "expert" in parent and rank >= 3:
+        # MoE stacked expert kernels [num_experts, in, out].
+        return ("expert",) + (None,) * (rank - 1)
+    if any(k in parent for k in ("mlp", "intermediate", "wi", "up_proj", "gate")):
         return (None,) * (rank - 1) + ("mlp",)
-    if any(k in name for k in ("query", "key", "value", "qkv", "attn")):
-        return (None,) * (rank - 1) + ("heads",)
-    if any(k in name for k in ("out_proj", "wo", "down_proj", "output")):
-        return ("mlp",) + (None,) * (rank - 1)
     if rank == 2:
         return ("embed", None)
     return (None,) * rank
+
+
+def _divisible_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Drop mesh axes that don't divide the dimension (tiny embeddings etc.)."""
+    fixed = []
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            fixed.append(None)
+            continue
+        axes_tuple = axes if isinstance(axes, tuple) else (axes,)
+        size = 1
+        for a in axes_tuple:
+            size *= mesh.shape[a]
+        fixed.append(axes if dim % size == 0 else None)
+    return P(*fixed)
 
 
 def shard_pytree(params: Any, mesh: Mesh, rules: LogicalRules) -> Any:
@@ -106,7 +129,8 @@ def shard_pytree(params: Any, mesh: Mesh, rules: LogicalRules) -> Any:
 
     def leaf_sharding(path: Tuple[Any, ...], leaf: Any) -> NamedSharding:
         axes = _infer_logical_axes(path, leaf)
-        return logical_sharding(mesh, rules, axes)
+        spec = _divisible_spec(rules.spec(axes), tuple(leaf.shape), mesh)
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, params)
 

@@ -13,6 +13,8 @@ from typing import Optional
 from .apiserver.store import Store
 from .controllers.builtin import DeploymentReconciler, PodletReconciler, StatefulSetReconciler
 from .controllers.notebook import NotebookConfig, NotebookReconciler
+from .controllers.profile import ProfileConfig, ProfileReconciler
+from .controllers.tensorboard import TensorboardConfig, TensorboardReconciler
 from .runtime.manager import Manager
 from .webhook.poddefault import admission_hook
 
@@ -20,6 +22,8 @@ from .webhook.poddefault import admission_hook
 def build_platform(
     store: Optional[Store] = None,
     notebook_config: Optional[NotebookConfig] = None,
+    profile_config: Optional[ProfileConfig] = None,
+    tensorboard_config: Optional[TensorboardConfig] = None,
     with_substrate: bool = True,
     extra_reconcilers=(),
 ) -> Manager:
@@ -31,6 +35,8 @@ def build_platform(
         mgr.add(DeploymentReconciler())
         mgr.add(PodletReconciler())
     mgr.add(NotebookReconciler(notebook_config))
+    mgr.add(ProfileReconciler(profile_config))
+    mgr.add(TensorboardReconciler(tensorboard_config))
     for rec in extra_reconcilers:
         mgr.add(rec)
     return mgr

@@ -1,0 +1,7 @@
+"""REST services: KFAM, spawner/CRUD backends, dashboard BFF, serving.
+
+Each service is an ``App`` (kubeflow_tpu.web) over the shared store client —
+the in-process analog of the reference's separately-deployed pods behind
+Istio. All are servable over real HTTP (``app.serve()``) and callable
+in-process for tests.
+"""

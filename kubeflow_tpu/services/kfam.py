@@ -1,0 +1,194 @@
+"""KFAM: profile + contributor access management REST API.
+
+Re-implements the reference access-management service
+(components/access-management/kfam/):
+
+- routes (routers.go:32-99): POST/DELETE ``/kfam/v1/profiles[/<name>]``,
+  GET/POST/DELETE ``/kfam/v1/bindings``, GET ``/kfam/v1/role/clusteradmin``,
+- permission gate: only the profile owner or a cluster admin may manage a
+  profile's bindings (api_default.go:303-310),
+- a contributor = RoleBinding (annotations ``user``/``role``,
+  bindings.go:103-106) + per-user Istio AuthorizationPolicy (:120-138),
+- binding name mangling (getBindingName :61-78): ``user-<user>-clusterrole-
+  <role>`` with non-alphanumerics dashed,
+- role map admin/edit/view ↔ kubeflow-admin/edit/view (:39-46).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..controllers.profile import PROFILE_API, ROLE_MAP
+from ..runtime.metrics import METRICS
+from ..web.auth import AuthConfig, Authorizer, install_auth
+from ..web.http import App, HttpError, Request
+
+BINDING_ANNOTATION_USER = "user"
+BINDING_ANNOTATION_ROLE = "role"
+
+
+def binding_name(user: str, role: str) -> str:
+    mangled = re.sub(r"[^a-z0-9]", "-", user.lower())
+    return f"user-{mangled}-clusterrole-kubeflow-{role}"
+
+
+def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_header: str = "kubeflow-userid") -> App:
+    cfg = auth or AuthConfig(userid_header=userid_header)
+    authorizer = Authorizer(client, cfg)
+    app = App("kfam")
+    install_auth(app, authorizer, enable_csrf=False)
+
+    def profile_of(name: str) -> Dict[str, Any]:
+        profile = client.get_opt(PROFILE_API, "Profile", name)
+        if profile is None:
+            raise HttpError(404, f"profile {name!r} not found")
+        return profile
+
+    def ensure_owner_or_admin(user: str, profile_name: str) -> None:
+        profile = profile_of(profile_name)
+        owner = profile.get("spec", {}).get("owner", {}).get("name", "")
+        if user != owner and not authorizer.is_cluster_admin(user):
+            raise HttpError(403, f"user {user!r} is neither owner of {profile_name!r} nor cluster admin")
+
+    # -- profiles ------------------------------------------------------------
+    @app.route("/kfam/v1/profiles", methods=("POST",))
+    def create_profile(req: Request):
+        body = req.json or {}
+        name = (body.get("metadata") or {}).get("name") or body.get("name")
+        if not name:
+            raise HttpError(400, "profile name required")
+        owner = (body.get("spec") or {}).get("owner") or {
+            "kind": "User",
+            "name": req.context["user"],
+        }
+        profile = apimeta.new_object(
+            PROFILE_API,
+            "Profile",
+            name,
+            spec={"owner": owner, **{k: v for k, v in (body.get("spec") or {}).items() if k != "owner"}},
+        )
+        if client.get_opt(PROFILE_API, "Profile", name) is not None:
+            raise HttpError(409, f"profile {name!r} already exists")
+        METRICS.counter("kfam_request_total", route="create_profile").inc()
+        return client.create(profile)
+
+    @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
+    def delete_profile(req: Request):
+        ensure_owner_or_admin(req.context["user"], req.params["name"])
+        client.delete(PROFILE_API, "Profile", req.params["name"])
+        return {"status": "deleted"}
+
+    @app.route("/kfam/v1/profiles/<name>", methods=("GET",))
+    def get_profile(req: Request):
+        return profile_of(req.params["name"])
+
+    # -- bindings ------------------------------------------------------------
+    @app.route("/kfam/v1/bindings", methods=("POST",))
+    def create_binding(req: Request):
+        body = req.json or {}
+        ns = body.get("referredNamespace")
+        subject = body.get("user") or {}
+        role = ((body.get("roleRef") or {}).get("name") or "edit").lower()
+        if role not in ROLE_MAP:
+            raise HttpError(400, f"unknown role {role!r}; want one of {sorted(ROLE_MAP)}")
+        if not ns or not subject.get("name"):
+            raise HttpError(400, "referredNamespace and user.name required")
+        ensure_owner_or_admin(req.context["user"], ns)
+
+        name = binding_name(subject["name"], role)
+        if client.get_opt("rbac.authorization.k8s.io/v1", "RoleBinding", name, ns):
+            raise HttpError(409, "binding already exists")
+        rb = apimeta.new_object(
+            "rbac.authorization.k8s.io/v1",
+            "RoleBinding",
+            name,
+            ns,
+            annotations={
+                BINDING_ANNOTATION_USER: subject["name"],
+                BINDING_ANNOTATION_ROLE: role,
+            },
+            roleRef={
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": ROLE_MAP[role],
+            },
+            subjects=[{"kind": "User", "name": subject["name"]}],
+        )
+        client.create(rb)
+        policy = apimeta.new_object(
+            "security.istio.io/v1beta1",
+            "AuthorizationPolicy",
+            name,
+            ns,
+            spec={
+                "rules": [
+                    {
+                        "when": [
+                            {
+                                "key": f"request.headers[{cfg.userid_header}]",
+                                "values": [f"{cfg.userid_prefix}{subject['name']}"],
+                            }
+                        ]
+                    }
+                ]
+            },
+        )
+        client.create(policy)
+        METRICS.counter("kfam_request_total", route="create_binding").inc()
+        return {"status": "created", "binding": rb}
+
+    @app.route("/kfam/v1/bindings", methods=("DELETE",))
+    def delete_binding(req: Request):
+        body = req.json or {}
+        ns = body.get("referredNamespace")
+        subject = (body.get("user") or {}).get("name")
+        role = ((body.get("roleRef") or {}).get("name") or "edit").lower()
+        if not ns or not subject:
+            raise HttpError(400, "referredNamespace and user.name required")
+        ensure_owner_or_admin(req.context["user"], ns)
+        name = binding_name(subject, role)
+        client.delete_opt("rbac.authorization.k8s.io/v1", "RoleBinding", name, ns)
+        client.delete_opt("security.istio.io/v1beta1", "AuthorizationPolicy", name, ns)
+        return {"status": "deleted"}
+
+    @app.route("/kfam/v1/bindings", methods=("GET",))
+    def list_bindings(req: Request):
+        want_ns = req.query1("namespace")
+        want_user = req.query1("user")
+        want_role = req.query1("role")
+        bindings: List[Dict[str, Any]] = []
+        namespaces = [want_ns] if want_ns else [
+            apimeta.name_of(n) for n in client.list("v1", "Namespace")
+        ]
+        for ns in namespaces:
+            for rb in client.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns):
+                anns = apimeta.annotations_of(rb)
+                if BINDING_ANNOTATION_USER not in anns or BINDING_ANNOTATION_ROLE not in anns:
+                    continue  # not a kfam contributor binding
+                if want_user and anns[BINDING_ANNOTATION_USER] != want_user:
+                    continue
+                if want_role and anns[BINDING_ANNOTATION_ROLE] != want_role:
+                    continue
+                bindings.append(
+                    {
+                        "user": {"kind": "User", "name": anns[BINDING_ANNOTATION_USER]},
+                        "referredNamespace": ns,
+                        "roleRef": {
+                            "apiGroup": "rbac.authorization.k8s.io",
+                            "kind": "ClusterRole",
+                            "name": anns[BINDING_ANNOTATION_ROLE],
+                        },
+                    }
+                )
+        return {"bindings": bindings}
+
+    # -- cluster admin check -------------------------------------------------
+    @app.route("/kfam/v1/role/clusteradmin", methods=("GET",))
+    def cluster_admin(req: Request):
+        user = req.query1("user") or req.context["user"]
+        return authorizer.is_cluster_admin(user)
+
+    return app

@@ -1,0 +1,14 @@
+"""Training harness: sharded train steps, schedules, MFU accounting.
+
+The reference delegates training to workload CRs (SURVEY.md §2.10); this is
+the in-workload half that the BASELINE north-star measures (ResNet-50 MFU).
+Everything compiles to one XLA program per step: optimizer update included,
+donated state, shardings from kubeflow_tpu.parallel.
+"""
+
+from kubeflow_tpu.training.classifier import (  # noqa: F401
+    ClassifierTask,
+    TrainState,
+    cross_entropy_loss,
+)
+from kubeflow_tpu.training.flops import compiled_flops, mfu  # noqa: F401

@@ -1,0 +1,170 @@
+"""Image-classification training task (ResNet/MNIST): one jitted step.
+
+TPU-first structure:
+- the whole step (fwd + bwd + BatchNorm stat update + optimizer) is ONE jit
+  with donated state — no host round-trips inside the training loop,
+- batch sharded over the mesh batch axes, params placed by LogicalRules
+  (replicated / fsdp / tp) — XLA inserts the gradient reduce/all-gathers,
+- loss in f32 on bf16 activations.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import batch_spec, replicated
+from kubeflow_tpu.parallel.sharding import LogicalRules, REPLICATED_RULES, shard_pytree
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array  # base key; per-step dropout key = fold_in(rng, step)
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+@dataclass
+class ClassifierTask:
+    """Bundles a flax image model with optimizer + mesh placement.
+
+    ``model.apply`` must accept ``(variables, images, train=...)`` and use
+    BatchNorm collection ``batch_stats`` (absent is fine — MnistCNN).
+    """
+
+    model: Any
+    optimizer: optax.GradientTransformation
+    mesh: Optional[Mesh] = None
+    rules: LogicalRules = REPLICATED_RULES
+
+    # -- init ----------------------------------------------------------------
+    def init(self, rng: jax.Array, sample_batch: jax.Array) -> TrainState:
+        variables = self.model.init(rng, sample_batch, train=True)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=self.optimizer.init(params),
+            rng=jax.random.fold_in(rng, 1),
+        )
+        if self.mesh is not None:
+            state = jax.device_put(state, self.state_shardings(state))
+        return state
+
+    def state_shardings(self, state: TrainState) -> TrainState:
+        assert self.mesh is not None
+        param_sh = shard_pytree(state.params, self.mesh, self.rules)
+        rep = replicated(self.mesh)
+
+        # Optimizer moments (sgd trace, adam mu/nu) are params-shaped pytrees
+        # inside optax state; give them the params' shardings (the ZeRO-3
+        # point: moments shard wherever params do), everything else replicates.
+        params_struct = jax.tree_util.tree_structure(state.params)
+
+        def place(subtree):
+            if jax.tree_util.tree_structure(subtree) == params_struct:
+                return param_sh
+            return jax.tree_util.tree_map(lambda _: rep, subtree)
+
+        opt_sh = jax.tree_util.tree_map(
+            place, state.opt_state, is_leaf=lambda x: jax.tree_util.tree_structure(x) == params_struct
+        )
+        return TrainState(
+            step=rep,
+            params=param_sh,
+            batch_stats=jax.tree_util.tree_map(lambda _: rep, state.batch_stats),
+            opt_state=opt_sh,
+            rng=rep,
+        )
+
+    def batch_sharding(self, extra_dims: int) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, batch_spec(extra_dims))
+
+    # -- steps ---------------------------------------------------------------
+    def make_train_step(self) -> Callable[[TrainState, jax.Array, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+        model, optimizer = self.model, self.optimizer
+
+        def train_step(state: TrainState, images: jax.Array, labels: jax.Array):
+            def loss_fn(params):
+                variables = {"params": params}
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                out = model.apply(
+                    variables,
+                    images,
+                    train=True,
+                    mutable=["batch_stats"] if state.batch_stats else [],
+                    rngs={"dropout": jax.random.fold_in(state.rng, state.step)},
+                )
+                logits, mutated = out if isinstance(out, tuple) else (out, {})
+                loss = cross_entropy_loss(logits, labels)
+                return loss, (logits, mutated.get("batch_stats", state.batch_stats))
+
+            (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss,
+                "accuracy": jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32)),
+            }
+            return (
+                TrainState(
+                    step=state.step + 1,
+                    params=new_params,
+                    batch_stats=new_stats,
+                    opt_state=new_opt,
+                    rng=state.rng,
+                ),
+                metrics,
+            )
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def make_eval_step(self) -> Callable[[TrainState, jax.Array], jax.Array]:
+        model = self.model
+
+        def eval_step(state: TrainState, images: jax.Array) -> jax.Array:
+            variables = {"params": state.params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            return model.apply(variables, images, train=False)
+
+        return jax.jit(eval_step)
+
+
+def sgd_momentum(
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """The standard ResNet recipe: SGD+momentum, cosine decay, warmup."""
+    if total_steps:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=max(warmup_steps, 1),
+            decay_steps=total_steps,
+        )
+    else:
+        schedule = lambda _: lr
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(learning_rate=schedule, momentum=momentum, nesterov=True),
+    )

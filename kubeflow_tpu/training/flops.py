@@ -1,0 +1,63 @@
+"""FLOP accounting and MFU, from the compiler rather than hand math.
+
+XLA's cost analysis on the *compiled* executable counts the FLOPs actually
+scheduled (fused, rematerialized, whatever) — the honest numerator for
+MFU = flops_per_step / (step_seconds * peak_flops). Peak comes from the
+accelerator catalog (kubeflow_tpu.tpu.topology) so control plane and
+benchmark agree on the denominator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from kubeflow_tpu.tpu.topology import ACCELERATORS
+
+
+def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> Optional[float]:
+    """Total FLOPs of one invocation, from XLA cost analysis (None if the
+    backend doesn't report)."""
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not analysis:
+        return None
+    flops = analysis.get("flops")
+    return float(flops) if flops and flops > 0 else None
+
+
+def peak_flops_per_chip(generation: str = "v5e") -> float:
+    return ACCELERATORS[generation].bf16_tflops_per_chip * 1e12
+
+
+def mfu(
+    flops_per_step: float,
+    step_seconds: float,
+    num_chips: int = 1,
+    generation: str = "v5e",
+) -> float:
+    """Model FLOPs utilization in [0, 1]."""
+    return flops_per_step / (step_seconds * num_chips * peak_flops_per_chip(generation))
+
+
+def detect_generation(default: str = "v5e") -> str:
+    """Map the live JAX device to a catalog generation (bench runs)."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return default
+    for gen in ACCELERATORS:
+        if gen in kind.replace(" ", "").replace("lite", "e"):
+            return gen
+    if "v5 lite" in kind or "v5lite" in kind:
+        return "v5e"
+    if "v6" in kind:
+        return "v6e"
+    if "v4" in kind:
+        return "v4"
+    if "v5" in kind:
+        return "v5p"
+    return default

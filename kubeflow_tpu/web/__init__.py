@@ -1,0 +1,11 @@
+"""Minimal HTTP service layer for the platform's REST planes.
+
+The reference uses Express (centraldashboard), Flask (crud-web-apps), and
+net/http (KFAM). This image ships none of those; the platform instead has
+one small stdlib-only router shared by every service — KFAM, the spawner
+backends, the dashboard BFF — with the reference's cross-cutting concerns
+(identity header parsing, SubjectAccessReview-style authz, CSRF
+double-submit, probes) as middleware in kubeflow_tpu.web.auth.
+"""
+
+from kubeflow_tpu.web.http import App, HttpError, JsonResponse, Request  # noqa: F401

@@ -1,0 +1,141 @@
+"""AuthN/AuthZ/CSRF middleware: the crud_backend cross-cutting plane.
+
+Mirrors the reference's shared Flask backend
+(crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/):
+
+- identity from a trusted proxy header (``authn.py``: env ``USERID_HEADER``
+  default ``kubeflow-userid``, optional prefix strip),
+- per-call authorization (``authz.py`` SubjectAccessReview) — here resolved
+  in-process against RoleBindings/ClusterRoleBindings in the store, with
+  the kubeflow-admin/edit/view ClusterRole verb model,
+- CSRF double-submit cookie (``csrf.py``: XSRF-TOKEN cookie must equal the
+  X-XSRF-TOKEN header on unsafe methods),
+- health probes bypass (``probes.py``).
+"""
+
+from __future__ import annotations
+
+import hmac
+import secrets
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from .http import App, HttpError, JsonResponse, Request
+
+USERID_HEADER = "kubeflow-userid"
+XSRF_COOKIE = "XSRF-TOKEN"
+XSRF_HEADER = "x-xsrf-token"
+UNSAFE = {"POST", "PUT", "PATCH", "DELETE"}
+PROBE_PATHS = ("/healthz", "/metrics", "/apple-touch")
+
+#: verb sets per platform ClusterRole (reference kfam bindings.go:39-46 role
+#: model + kubeflow-edit/view RBAC manifests).
+ROLE_VERBS: Dict[str, Set[str]] = {
+    "kubeflow-admin": {"get", "list", "watch", "create", "update", "patch", "delete"},
+    "kubeflow-edit": {"get", "list", "watch", "create", "update", "patch", "delete"},
+    "kubeflow-view": {"get", "list", "watch"},
+}
+
+
+@dataclass
+class AuthConfig:
+    userid_header: str = USERID_HEADER
+    userid_prefix: str = ""
+    disable_auth: bool = False  # APP_DISABLE_AUTH analog (dev mode)
+    default_user: str = "anonymous@kubeflow.org"
+    cluster_admins: List[str] = field(default_factory=list)
+    secure_cookies: bool = False
+
+
+def user_of(req: Request, cfg: AuthConfig) -> str:
+    raw = req.header(cfg.userid_header)
+    if not raw:
+        if cfg.disable_auth:
+            return cfg.default_user
+        raise HttpError(401, f"missing identity header {cfg.userid_header!r}")
+    if cfg.userid_prefix and raw.startswith(cfg.userid_prefix):
+        raw = raw[len(cfg.userid_prefix):]
+    return raw
+
+
+class Authorizer:
+    """In-process SubjectAccessReview over store RBAC objects."""
+
+    def __init__(self, client: Client, cfg: Optional[AuthConfig] = None):
+        self.client = client
+        self.cfg = cfg or AuthConfig()
+
+    def is_cluster_admin(self, user: str) -> bool:
+        if user in self.cfg.cluster_admins:
+            return True
+        for crb in self.client.list("rbac.authorization.k8s.io/v1", "ClusterRoleBinding"):
+            if (crb.get("roleRef") or {}).get("name") not in ("cluster-admin", "kubeflow-admin"):
+                continue
+            for sub in crb.get("subjects") or []:
+                if sub.get("kind") == "User" and sub.get("name") == user:
+                    return True
+        return False
+
+    def is_authorized(self, user: str, verb: str, namespace: Optional[str]) -> bool:
+        if self.cfg.disable_auth or self.is_cluster_admin(user):
+            return True
+        if namespace is None:
+            return verb in ("get", "list", "watch")
+        for rb in self.client.list("rbac.authorization.k8s.io/v1", "RoleBinding", namespace):
+            role = (rb.get("roleRef") or {}).get("name", "")
+            verbs = ROLE_VERBS.get(role)
+            if not verbs or verb not in verbs:
+                continue
+            for sub in rb.get("subjects") or []:
+                if sub.get("kind", "User") == "User" and sub.get("name") == user:
+                    return True
+        return False
+
+    def ensure(self, user: str, verb: str, namespace: Optional[str]) -> None:
+        if not self.is_authorized(user, verb, namespace):
+            raise HttpError(
+                403, f"user {user!r} is not allowed to {verb} in namespace {namespace!r}"
+            )
+
+
+def install_auth(app: App, authorizer: Authorizer, enable_csrf: bool = True) -> None:
+    """Probes bypass + identity (+ CSRF for browser-facing apps), in order.
+
+    Server-to-server APIs (KFAM — the dashboard BFF calls it with the user's
+    forwarded identity header) skip CSRF, as the reference does: csrf.py
+    lives only in the crud_backend the browser talks to."""
+    cfg = authorizer.cfg
+
+    @app.middleware
+    def probes(req: Request) -> Optional[JsonResponse]:
+        if req.path.startswith("/healthz"):
+            return JsonResponse({"status": "ok"})
+        return None
+
+    @app.middleware
+    def authn(req: Request) -> Optional[JsonResponse]:
+        req.context["user"] = user_of(req, cfg)
+        return None
+
+    @app.middleware
+    def csrf(req: Request) -> Optional[JsonResponse]:
+        if not enable_csrf or req.method not in UNSAFE:
+            return None
+        cookie = req.cookie(XSRF_COOKIE)
+        header = req.header(XSRF_HEADER)
+        if cfg.disable_auth and not cookie and not header:
+            return None  # dev mode without a browser session
+        if not cookie or not header or not hmac.compare_digest(cookie, header):
+            raise HttpError(403, "CSRF token missing or mismatched")
+        return None
+
+
+def issue_csrf_cookie(resp: JsonResponse, cfg: AuthConfig) -> str:
+    token = secrets.token_urlsafe(32)
+    attrs = f"{XSRF_COOKIE}={token}; Path=/; SameSite=Strict"
+    if cfg.secure_cookies:
+        attrs += "; Secure"
+    resp.cookies.append(attrs)
+    return token

@@ -1,0 +1,209 @@
+"""Tiny threaded HTTP app: routing with path params, JSON bodies, middleware.
+
+Route patterns use ``<name>`` segments (``/api/namespaces/<ns>/notebooks``),
+matching the reference crud-backend URL shapes
+(crud-web-apps/jupyter/backend/apps/default/routes/post.py:11). Servers bind
+port 0 in tests and expose ``server.port``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from dataclasses import dataclass, field
+from http.cookies import SimpleCookie
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("kubeflow_tpu.web")
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, List[str]]
+    headers: Dict[str, str]
+    body: bytes
+    params: Dict[str, str] = field(default_factory=dict)
+    context: Dict[str, Any] = field(default_factory=dict)  # middleware scratch
+
+    @property
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError:
+            raise HttpError(400, "invalid JSON body") from None
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name.lower(), default)
+
+    def cookie(self, name: str) -> Optional[str]:
+        raw = self.header("cookie")
+        if not raw:
+            return None
+        jar = SimpleCookie()
+        jar.load(raw)
+        morsel = jar.get(name)
+        return morsel.value if morsel else None
+
+    def query1(self, name: str, default: str = "") -> str:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+
+@dataclass
+class JsonResponse:
+    body: Any = None
+    status: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    cookies: List[str] = field(default_factory=list)  # raw Set-Cookie values
+
+    def encode(self) -> bytes:
+        if self.body is None:
+            return b""
+        return json.dumps(self.body).encode()
+
+
+Handler = Callable[[Request], Any]
+Middleware = Callable[[Request], Optional[JsonResponse]]
+
+
+def _compile(pattern: str) -> re.Pattern:
+    out = []
+    for seg in pattern.split("/"):
+        if seg.startswith("<") and seg.endswith(">"):
+            out.append(f"(?P<{seg[1:-1]}>[^/]+)")
+        else:
+            out.append(re.escape(seg))
+    return re.compile("^" + "/".join(out) + "/?$")
+
+
+class App:
+    def __init__(self, name: str = "app"):
+        self.name = name
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._middleware: List[Middleware] = []
+
+    def route(self, pattern: str, methods: Tuple[str, ...] = ("GET",)) -> Callable[[Handler], Handler]:
+        rx = _compile(pattern)
+
+        def deco(fn: Handler) -> Handler:
+            for m in methods:
+                self._routes.append((m.upper(), rx, fn))
+            return fn
+
+        return deco
+
+    def middleware(self, fn: Middleware) -> Middleware:
+        self._middleware.append(fn)
+        return fn
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(self, req: Request) -> JsonResponse:
+        try:
+            for mw in self._middleware:
+                short = mw(req)
+                if short is not None:
+                    return short
+            for method, rx, fn in self._routes:
+                if method != req.method:
+                    continue
+                m = rx.match(req.path)
+                if m:
+                    req.params = m.groupdict()
+                    result = fn(req)
+                    if isinstance(result, JsonResponse):
+                        return result
+                    return JsonResponse(result)
+            if any(rx.match(req.path) for _, rx, _ in self._routes):
+                raise HttpError(405, f"method {req.method} not allowed")
+            raise HttpError(404, f"no route for {req.path}")
+        except HttpError as e:
+            return JsonResponse({"error": e.message, "status": e.status}, status=e.status)
+        except Exception:
+            log.exception("%s: handler error %s %s", self.name, req.method, req.path)
+            return JsonResponse({"error": "internal error", "status": 500}, status=500)
+
+    # -- in-process call (tests + service-to-service) ------------------------
+    def call(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> JsonResponse:
+        parsed = urlparse(path)
+        raw = b"" if body is None else json.dumps(body).encode()
+        req = Request(
+            method=method.upper(),
+            path=parsed.path,
+            query=parse_qs(parsed.query),
+            headers={k.lower(): v for k, v in (headers or {}).items()},
+            body=raw,
+        )
+        return self.dispatch(req)
+
+    # -- real server ---------------------------------------------------------
+    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "AppServer":
+        return AppServer(self, host, port)
+
+
+class AppServer:
+    def __init__(self, app: App, host: str, port: int):
+        self.app = app
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _handle(self) -> None:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                parsed = urlparse(self.path)
+                req = Request(
+                    method=self.command,
+                    path=parsed.path,
+                    query=parse_qs(parsed.query),
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                    body=body,
+                )
+                resp = outer.app.dispatch(req)
+                payload = resp.encode()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                for c in resp.cookies:
+                    self.send_header("Set-Cookie", c)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
+
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name=f"{app.name}-http", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -261,7 +261,8 @@ def mutate_pod(
     except (PodDefaultConflict, ValueError, KeyError, TypeError, AttributeError) as e:
         # A malformed PodDefault (bad tpu block, bad topology string) must not
         # make pod CREATE fail — same pass-through-and-annotate contract.
-        METRICS.counter("poddefault_apply_total", result="conflict").inc()
+        result = "conflict" if isinstance(e, PodDefaultConflict) else "error"
+        METRICS.counter("poddefault_apply_total", result=result).inc()
         log.warning("pod %s/%s: %s", apimeta.namespace_of(pod), apimeta.name_of(pod), e)
         pod = apimeta.deepcopy(pod)
         pod.setdefault("metadata", {}).setdefault("annotations", {})[REJECT_ANNOTATION] = str(e)

@@ -124,6 +124,21 @@ def test_ring_attention_bf16_stable():
     )
 
 
+def test_ring_attention_with_tensor_parallel_heads():
+    # heads sharded over the model axis compose with the seq ring
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    rng = np.random.RandomState(3)
+    b, L, h, d = 2, 16, 4, 8
+    q = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, L, h, d), jnp.float32)
+    for causal in (False, True):
+        got = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(full_attention(q, k, v, causal=causal)), atol=1e-5
+        )
+
+
 def test_ring_attention_under_jit_with_dp():
     mesh = make_mesh(MeshConfig(data=2, seq=4))
     rng = np.random.RandomState(2)

@@ -1,0 +1,113 @@
+"""Profile controller: namespace/RBAC/quota materialization + teardown.
+
+Mirrors the reference's profiles e2e assertions
+(py/kubeflow/kubeflow/ci/profiles_test.py:1-30: create → namespace/SAs/
+rolebindings exist; delete → gone) plus the TPU quota hook.
+"""
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.profile import (
+    PROFILE_API,
+    ProfileConfig,
+    ProfileReconciler,
+    TPU_QUOTA_KEY,
+)
+from kubeflow_tpu.platform import build_platform
+
+
+def mkprofile(name="team-a", owner="alice@example.com", quota=None, plugins=None):
+    spec = {"owner": {"kind": "User", "name": owner}}
+    if quota:
+        spec["resourceQuotaSpec"] = quota
+    if plugins:
+        spec["plugins"] = plugins
+    return new_object(PROFILE_API, "Profile", name, spec=spec)
+
+
+@pytest.fixture()
+def platform():
+    mgr = build_platform().start()
+    yield mgr
+    mgr.stop()
+
+
+def test_profile_materializes_namespace_rbac_istio(platform):
+    platform.client.create(mkprofile())
+    assert platform.wait_idle()
+    c = platform.client
+    ns = c.get("v1", "Namespace", "team-a")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+    for sa in ("default-editor", "default-viewer"):
+        assert c.get_opt("v1", "ServiceAccount", sa, "team-a") is not None
+    editor_rb = c.get("rbac.authorization.k8s.io/v1", "RoleBinding", "default-editor", "team-a")
+    assert editor_rb["roleRef"]["name"] == "kubeflow-edit"
+    owner_rb = c.get("rbac.authorization.k8s.io/v1", "RoleBinding", "namespaceAdmin", "team-a")
+    assert owner_rb["subjects"][0]["name"] == "alice@example.com"
+    policy = c.get("security.istio.io/v1beta1", "AuthorizationPolicy", "ns-owner-access-istio", "team-a")
+    rules = policy["spec"]["rules"]
+    assert any("when" in r for r in rules) and any("from" in r for r in rules)
+    profile = c.get(PROFILE_API, "Profile", "team-a")
+    assert profile["status"]["conditions"][0]["type"] == "Successful"
+
+
+def test_profile_tpu_quota(platform):
+    platform.client.create(
+        mkprofile(quota={"hard": {TPU_QUOTA_KEY: "32", "requests.cpu": "100"}})
+    )
+    assert platform.wait_idle()
+    quota = platform.client.get("v1", "ResourceQuota", "kf-resource-quota", "team-a")
+    assert quota["spec"]["hard"][TPU_QUOTA_KEY] == "32"
+
+
+def test_profile_default_tpu_quota_applied():
+    mgr = build_platform(profile_config=ProfileConfig(default_tpu_chips=8)).start()
+    try:
+        mgr.client.create(mkprofile())
+        assert mgr.wait_idle()
+        quota = mgr.client.get("v1", "ResourceQuota", "kf-resource-quota", "team-a")
+        assert quota["spec"]["hard"][TPU_QUOTA_KEY] == "8"
+    finally:
+        mgr.stop()
+
+
+def test_profile_ownership_conflict_sets_failed_condition(platform):
+    # Pre-existing namespace owned by someone else.
+    platform.client.create(
+        new_object("v1", "Namespace", "taken", annotations={"owner": "bob@example.com"})
+    )
+    platform.client.create(mkprofile(name="taken", owner="alice@example.com"))
+    assert platform.wait_idle()
+    profile = platform.client.get(PROFILE_API, "Profile", "taken")
+    conds = profile["status"]["conditions"]
+    assert conds[0]["type"] == "Failed"
+    assert "owned by" in conds[0]["message"]
+
+
+def test_profile_plugins_annotate_ksa_and_backend_called(platform):
+    calls = []
+
+    def backend(action, kind, spec, ns):
+        calls.append((action, kind, ns))
+
+    mgr = build_platform(profile_config=ProfileConfig(iam_backend=backend)).start()
+    try:
+        mgr.client.create(
+            mkprofile(
+                plugins=[{"kind": "WorkloadIdentity", "spec": {"gcpServiceAccount": "sa@proj.iam"}}]
+            )
+        )
+        assert mgr.wait_idle()
+        sa = mgr.client.get("v1", "ServiceAccount", "default-editor", "team-a")
+        assert sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"] == "sa@proj.iam"
+        assert ("apply", "WorkloadIdentity", "team-a") in calls
+        # Teardown revokes plugins then releases the namespace.
+        mgr.client.delete(PROFILE_API, "Profile", "team-a")
+        assert mgr.wait_idle()
+        assert ("revoke", "WorkloadIdentity", "team-a") in calls
+        assert mgr.client.get_opt(PROFILE_API, "Profile", "team-a") is None
+        assert mgr.client.get_opt("v1", "Namespace", "team-a") is None
+    finally:
+        mgr.stop()

@@ -1,0 +1,141 @@
+"""Tensorboard controller + KFAM service tests (SURVEY §2.3, §2.5)."""
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.profile import PROFILE_API
+from kubeflow_tpu.controllers.tensorboard import TB_API, TensorboardConfig, TensorboardReconciler, parse_logspath
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.services.kfam import binding_name, make_kfam_app
+from kubeflow_tpu.web.auth import AuthConfig
+
+
+@pytest.fixture()
+def platform():
+    mgr = build_platform().start()
+    yield mgr
+    mgr.stop()
+
+
+def mktb(name="tb", ns="team-a", logspath="pvc://logs-pvc/run1"):
+    return new_object(TB_API, "Tensorboard", name, ns, spec={"logspath": logspath})
+
+
+class TestLogsPath:
+    def test_pvc(self):
+        kind, info = parse_logspath("pvc://mypvc/sub/dir")
+        assert kind == "pvc" and info == {"name": "mypvc", "subpath": "sub/dir"}
+
+    def test_pvc_no_subpath(self):
+        assert parse_logspath("pvc://mypvc") == ("pvc", {"name": "mypvc", "subpath": ""})
+
+    def test_cloud(self):
+        assert parse_logspath("gs://bucket/logs")[0] == "cloud"
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_logspath("pvc://")
+        with pytest.raises(ValueError):
+            parse_logspath("")
+
+
+class TestTensorboardController:
+    def test_pvc_tensorboard_materializes(self, platform):
+        platform.client.create(mktb())
+        assert platform.wait_idle()
+        dep = platform.client.get("apps/v1", "Deployment", "tb", "team-a")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert "--logdir=/tb-logs" in c["args"]
+        assert c["volumeMounts"][0]["subPath"] == "run1"
+        svc = platform.client.get("v1", "Service", "tb", "team-a")
+        assert svc["spec"]["ports"][0]["targetPort"] == 6006
+        vs = platform.client.get(
+            "networking.istio.io/v1beta1", "VirtualService", "tensorboard-team-a-tb", "team-a"
+        )
+        assert vs["spec"]["http"][0]["match"][0]["uri"]["prefix"] == "/tensorboard/team-a/tb/"
+        tb = platform.client.get(TB_API, "Tensorboard", "tb", "team-a")
+        assert tb["status"]["readyReplicas"] == 1
+
+    def test_cloud_tensorboard_mounts_gcp_secret(self, platform):
+        platform.client.create(mktb(name="tb2", logspath="gs://bucket/run"))
+        assert platform.wait_idle()
+        dep = platform.client.get("apps/v1", "Deployment", "tb2", "team-a")
+        spec = dep["spec"]["template"]["spec"]
+        assert any(v.get("secret", {}).get("secretName") == "user-gcp-sa" for v in spec["volumes"])
+        env = spec["containers"][0]["env"]
+        assert any(e["name"] == "GOOGLE_APPLICATION_CREDENTIALS" for e in env)
+
+    def test_invalid_logspath_is_terminal(self, platform):
+        platform.client.create(new_object(TB_API, "Tensorboard", "bad", "team-a", spec={}))
+        assert platform.wait_idle()
+        tb = platform.client.get(TB_API, "Tensorboard", "bad", "team-a")
+        assert tb["status"]["conditions"][0]["reason"] == "InvalidSpec"
+
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+BOB = {"kubeflow-userid": "bob@example.com"}
+ADMIN = {"kubeflow-userid": "root@example.com"}
+
+
+class TestKfam:
+    @pytest.fixture()
+    def kfam(self, platform):
+        app = make_kfam_app(
+            platform.client, AuthConfig(cluster_admins=["root@example.com"])
+        )
+        return app
+
+    def test_profile_lifecycle_and_owner_gate(self, platform, kfam):
+        r = kfam.call("POST", "/kfam/v1/profiles", {"name": "team-a"}, ALICE)
+        assert r.status == 200, r.body
+        assert platform.wait_idle()
+        assert platform.client.get("v1", "Namespace", "team-a")["metadata"]["annotations"]["owner"] == "alice@example.com"
+        # duplicate
+        assert kfam.call("POST", "/kfam/v1/profiles", {"name": "team-a"}, ALICE).status == 409
+        # non-owner cannot delete
+        assert kfam.call("DELETE", "/kfam/v1/profiles/team-a", None, BOB).status == 403
+        # admin can
+        assert kfam.call("DELETE", "/kfam/v1/profiles/team-a", None, ADMIN).status == 200
+
+    def test_binding_lifecycle(self, platform, kfam):
+        kfam.call("POST", "/kfam/v1/profiles", {"name": "team-a"}, ALICE)
+        body = {
+            "user": {"kind": "User", "name": "bob@example.com"},
+            "referredNamespace": "team-a",
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        }
+        # stranger cannot add contributors
+        assert kfam.call("POST", "/kfam/v1/bindings", body, BOB).status == 403
+        # owner can
+        assert kfam.call("POST", "/kfam/v1/bindings", body, ALICE).status == 200
+        name = binding_name("bob@example.com", "edit")
+        rb = platform.client.get("rbac.authorization.k8s.io/v1", "RoleBinding", name, "team-a")
+        assert rb["roleRef"]["name"] == "kubeflow-edit"
+        assert platform.client.get_opt(
+            "security.istio.io/v1beta1", "AuthorizationPolicy", name, "team-a"
+        ) is not None
+        listing = kfam.call("GET", "/kfam/v1/bindings?namespace=team-a", None, ALICE)
+        users = [b["user"]["name"] for b in listing.body["bindings"]]
+        assert "bob@example.com" in users
+        assert kfam.call("DELETE", "/kfam/v1/bindings", body, ALICE).status == 200
+        assert platform.client.get_opt("rbac.authorization.k8s.io/v1", "RoleBinding", name, "team-a") is None
+
+    def test_clusteradmin_route_and_missing_identity(self, kfam):
+        assert kfam.call("GET", "/kfam/v1/role/clusteradmin", None, ADMIN).body is True
+        assert kfam.call("GET", "/kfam/v1/role/clusteradmin", None, ALICE).body is False
+        assert kfam.call("GET", "/kfam/v1/role/clusteradmin", None, {}).status == 401
+
+    def test_served_over_real_http(self, platform, kfam):
+        import json
+        import urllib.request
+
+        server = kfam.serve()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/kfam/v1/role/clusteradmin",
+                headers=ADMIN,
+            )
+            with urllib.request.urlopen(req) as resp:
+                assert json.loads(resp.read()) is True
+        finally:
+            server.close()
