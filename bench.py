@@ -13,6 +13,7 @@ compute path, not input pipeline) and prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -20,9 +21,22 @@ import jax
 import jax.numpy as jnp
 import optax
 
-BATCH_CANDIDATES = [256, 128, 64, 32]
-TIMED_STEPS = 10
 TARGET_MFU = 0.60
+
+
+def _batch_candidates() -> list:
+    try:
+        override = os.environ.get("BENCH_BATCH")
+        return [int(override)] if override else [256, 128, 64, 32]
+    except ValueError:
+        return [256, 128, 64, 32]
+
+
+def _timed_steps() -> int:
+    try:
+        return int(os.environ.get("BENCH_STEPS", "10"))
+    except ValueError:
+        return 10
 
 # XLA cost-analysis fallback: ResNet-50 fwd ~8.2 GFLOP/image @224 (2*MACs),
 # train step ~3x forward.
@@ -55,11 +69,12 @@ def _bench(batch: int):
     state, metrics = step(state, images, labels)
     jax.block_until_ready(metrics["loss"])
 
+    timed_steps = _timed_steps()
     t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
+    for _ in range(timed_steps):
         state, metrics = step(state, images, labels)
     jax.block_until_ready(metrics["loss"])
-    dt = (time.perf_counter() - t0) / TIMED_STEPS
+    dt = (time.perf_counter() - t0) / timed_steps
 
     gen = detect_generation()
     return {
@@ -75,7 +90,7 @@ def _bench(batch: int):
 def main() -> int:
     platform = jax.devices()[0].platform
     last_err = None
-    for batch in BATCH_CANDIDATES:
+    for batch in _batch_candidates():
         try:
             r = _bench(batch)
             print(

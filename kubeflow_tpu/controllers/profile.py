@@ -34,6 +34,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
+from ..runtime import reconcile as rh
 from ..tpu.topology import RESOURCE_TPU
 
 log = logging.getLogger("kubeflow_tpu.profile")
@@ -165,7 +166,7 @@ class ProfileReconciler(Reconciler):
             },
         )
         apimeta.set_owner_reference(policy, profile)
-        _create_or_update(client, policy)
+        rh.reconcile_object(client, policy, profile)
 
     # -- rbac ----------------------------------------------------------------
     def _reconcile_service_accounts(self, client: Client, profile: Dict[str, Any]) -> None:
@@ -184,8 +185,7 @@ class ProfileReconciler(Reconciler):
                 roleRef={"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": role},
                 subjects=[{"kind": "ServiceAccount", "name": sa_name, "namespace": ns}],
             )
-            apimeta.set_owner_reference(binding, profile)
-            _create_or_update(client, binding)
+            rh.reconcile_object(client, binding, profile)
 
     def _reconcile_owner_binding(self, client: Client, profile: Dict[str, Any]) -> None:
         ns = apimeta.name_of(profile)
@@ -202,8 +202,7 @@ class ProfileReconciler(Reconciler):
             roleRef={"apiGroup": "rbac.authorization.k8s.io", "kind": "ClusterRole", "name": ROLE_MAP["admin"]},
             subjects=[owner or {"kind": "User", "name": ""}],
         )
-        apimeta.set_owner_reference(binding, profile)
-        _create_or_update(client, binding)
+        rh.reconcile_object(client, binding, profile)
 
     # -- quota (the TPU hook) ------------------------------------------------
     def _reconcile_quota(self, client: Client, profile: Dict[str, Any]) -> None:
@@ -216,8 +215,7 @@ class ProfileReconciler(Reconciler):
             client.delete_opt("v1", "ResourceQuota", QUOTA_NAME, ns)
             return
         quota = apimeta.new_object("v1", "ResourceQuota", QUOTA_NAME, ns, spec=spec)
-        apimeta.set_owner_reference(quota, profile)
-        _create_or_update(client, quota)
+        rh.reconcile_object(client, quota, profile)
 
     # -- plugins -------------------------------------------------------------
     def _plugins_of(self, profile: Dict[str, Any]) -> List[Dict[str, Any]]:
@@ -295,19 +293,3 @@ class ProfileReconciler(Reconciler):
         fresh = apimeta.deepcopy(fresh)
         fresh["status"] = {"conditions": conditions}
         client.update_status(fresh)
-
-
-def _create_or_update(client: Client, obj: Dict[str, Any]) -> None:
-    existing = client.get_opt(
-        apimeta.api_version_of(obj), obj["kind"], apimeta.name_of(obj), apimeta.namespace_of(obj)
-    )
-    if existing is None:
-        client.create(obj)
-        return
-    changed = any(existing.get(k) != obj.get(k) for k in ("spec", "roleRef", "subjects"))
-    if changed:
-        merged = apimeta.deepcopy(existing)
-        for k in ("spec", "roleRef", "subjects"):
-            if k in obj:
-                merged[k] = obj[k]
-        client.update(merged)

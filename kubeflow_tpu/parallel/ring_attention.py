@@ -106,7 +106,9 @@ def ring_attention(
     sharded over it too (heads are independent in attention), composing
     tensor parallelism with the ring; head count must then divide the axis.
     """
-    head_axes = AXIS_MODEL if mesh.shape.get(AXIS_MODEL, 1) > 1 else None
+    model_size = mesh.shape.get(AXIS_MODEL, 1)
+    heads = q.shape[2]
+    head_axes = AXIS_MODEL if model_size > 1 and heads % model_size == 0 else None
     spec = P(BATCH_AXES, axis_name, head_axes, None)
     vary_axes = BATCH_AXES + (axis_name,) + ((head_axes,) if head_axes else ())
     fn = shard_map(

@@ -70,10 +70,22 @@ def copy_spec_fields(desired: Dict[str, Any], found: Dict[str, Any]) -> bool:
     return changed
 
 
+def copy_rolebinding_fields(desired: Dict[str, Any], found: Dict[str, Any]) -> bool:
+    """RBAC objects carry top-level roleRef/subjects rather than a spec."""
+    changed = _copy_meta_fields(desired, found)
+    for field in ("roleRef", "subjects"):
+        if field in desired and found.get(field) != desired[field]:
+            found[field] = desired[field]
+            changed = True
+    return changed
+
+
 _COPIERS = {
     "StatefulSet": copy_statefulset_fields,
     "Deployment": copy_deployment_fields,
     "Service": copy_service_fields,
+    "RoleBinding": copy_rolebinding_fields,
+    "ClusterRoleBinding": copy_rolebinding_fields,
 }
 
 

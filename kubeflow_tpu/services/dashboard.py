@@ -1,0 +1,252 @@
+"""Central dashboard BFF: namespaces, activities, metrics, workgroup flow.
+
+Re-implements the reference centraldashboard server (components/
+centraldashboard/app/): the Express BFF's API surface (api.ts:29-102),
+the registration/workgroup flow (api_workgroup.ts), settings/links from a
+ConfigMap (k8s_service.ts:81-89), platform inference from node providerID
+(:138-150), and the pluggable MetricsService interface
+(metrics_service.ts:20-41) — implemented here by a TPU metrics provider
+(chips allocated vs capacity per node/namespace) instead of Stackdriver.
+
+Workgroup routes proxy to KFAM exactly as the reference's DefaultApi client
+does (app/clients/profile_controller.ts), here via in-process dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..controllers.profile import PROFILE_API
+from ..tpu.topology import RESOURCE_TPU
+from ..web.auth import AuthConfig, Authorizer, install_auth
+from ..web.http import App, HttpError, JsonResponse, Request
+
+SETTINGS_CONFIGMAP = "centraldashboard-config"
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"type": "item", "link": "/jupyter/", "text": "Notebooks", "icon": "book"},
+        {"type": "item", "link": "/tensorboards/", "text": "Tensorboards", "icon": "assessment"},
+        {"type": "item", "link": "/volumes/", "text": "Volumes", "icon": "device:storage"},
+        {"type": "item", "link": "/katib/", "text": "Experiments (HPO)", "icon": "kubeflow:katib"},
+        {"type": "item", "link": "/serving/", "text": "Model Serving", "icon": "kubeflow:models"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"text": "Create a new Notebook server", "desc": "Jupyter on TPU slices", "link": "/jupyter/new"},
+    ],
+}
+
+
+class TpuMetricsService:
+    """MetricsService impl (interface: metrics_service.ts:20-41) reporting
+    TPU chip allocation — the platform's duty-cycle stand-in until node
+    agents export real utilization."""
+
+    def __init__(self, client: Client):
+        self.client = client
+
+    def node_tpu_utilization(self) -> List[Dict[str, Any]]:
+        out = []
+        pods = self.client.list("v1", "Pod")
+        for node in self.client.list("v1", "Node"):
+            name = apimeta.name_of(node)
+            capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
+            if capacity <= 0:
+                continue
+            used = 0
+            for p in pods:
+                if p.get("spec", {}).get("nodeName") != name:
+                    continue
+                for c in p.get("spec", {}).get("containers", []):
+                    used += int(((c.get("resources") or {}).get("limits") or {}).get(RESOURCE_TPU, 0))
+            out.append({"node": name, "capacityChips": capacity, "allocatedChips": used,
+                        "utilization": used / capacity})
+        return out
+
+    def namespace_tpu_usage(self, namespace: str) -> Dict[str, Any]:
+        used = 0
+        for p in self.client.list("v1", "Pod", namespace):
+            for c in p.get("spec", {}).get("containers", []):
+                used += int(((c.get("resources") or {}).get("limits") or {}).get(RESOURCE_TPU, 0))
+        return {"namespace": namespace, "allocatedChips": used}
+
+
+def make_dashboard_app(
+    client: Client,
+    kfam_app: Optional[App] = None,
+    auth: Optional[AuthConfig] = None,
+) -> App:
+    cfg = auth or AuthConfig()
+    authorizer = Authorizer(client, cfg)
+    metrics = TpuMetricsService(client)
+    app = App("centraldashboard")
+    install_auth(app, authorizer, enable_csrf=False)
+
+    def user(req: Request) -> str:
+        return req.context["user"]
+
+    def kfam(req: Request, method: str, path: str, body: Any = None) -> JsonResponse:
+        if kfam_app is None:
+            raise HttpError(503, "KFAM not wired")
+        resp = kfam_app.call(method, path, body, {cfg.userid_header: user(req)})
+        if resp.status >= 400:
+            raise HttpError(resp.status, (resp.body or {}).get("error", "kfam error"))
+        return resp
+
+    # -- cluster views -------------------------------------------------------
+    @app.route("/api/namespaces")
+    def namespaces(req: Request):
+        return [apimeta.name_of(n) for n in client.list("v1", "Namespace")]
+
+    @app.route("/api/activities/<ns>")
+    def activities(req: Request):
+        authorizer.ensure(user(req), "list", req.params["ns"])
+        events = client.list("v1", "Event", req.params["ns"])
+        return sorted(events, key=lambda e: e.get("lastTimestamp", ""), reverse=True)[:50]
+
+    @app.route("/api/metrics/<kind>")
+    def metric(req: Request):
+        kind = req.params["kind"]
+        if kind == "node":
+            return metrics.node_tpu_utilization()
+        if kind == "namespace":
+            ns = req.query1("namespace")
+            if not ns:
+                raise HttpError(400, "namespace query param required")
+            return metrics.namespace_tpu_usage(ns)
+        raise HttpError(400, f"unknown metric {kind!r} (node|namespace)")
+
+    @app.route("/api/dashboard-links")
+    def links(req: Request):
+        cm = client.get_opt("v1", "ConfigMap", SETTINGS_CONFIGMAP, "kubeflow")
+        if cm and "links" in (cm.get("data") or {}):
+            import json
+
+            return json.loads(cm["data"]["links"])
+        return DEFAULT_LINKS
+
+    @app.route("/api/dashboard-settings")
+    def settings(req: Request):
+        cm = client.get_opt("v1", "ConfigMap", SETTINGS_CONFIGMAP, "kubeflow")
+        if cm and "settings" in (cm.get("data") or {}):
+            import json
+
+            return json.loads(cm["data"]["settings"])
+        return {"DASHBOARD_FORCE_IFRAME": True}
+
+    @app.route("/api/platform-info")
+    def platform_info(req: Request):
+        provider = "other"
+        for node in client.list("v1", "Node"):
+            pid = node.get("spec", {}).get("providerID", "")
+            if pid.startswith("gce://"):
+                provider = "gce"
+                break
+            if pid.startswith("aws://"):
+                provider = "aws"
+                break
+        return {"provider": provider, "kubeflowVersion": "tpu-native-dev"}
+
+    # -- workgroup / registration flow --------------------------------------
+    @app.route("/api/workgroup/exists")
+    def exists(req: Request):
+        u = user(req)
+        owned = [
+            apimeta.name_of(p)
+            for p in client.list(PROFILE_API, "Profile")
+            if p.get("spec", {}).get("owner", {}).get("name") == u
+        ]
+        return {"hasWorkgroup": bool(owned), "user": u, "namespaces": owned,
+                "hasAuth": not cfg.disable_auth, "registrationFlowAllowed": True}
+
+    @app.route("/api/workgroup/create", methods=("POST",))
+    def create(req: Request):
+        body = req.json or {}
+        name = body.get("namespace") or user(req).split("@")[0].replace(".", "-")
+        kfam(req, "POST", "/kfam/v1/profiles", {"name": name})
+        return {"message": f"profile {name} created"}
+
+    @app.route("/api/workgroup/env-info")
+    def env_info(req: Request):
+        u = user(req)
+        profiles = client.list(PROFILE_API, "Profile")
+        namespaces = []
+        for p in profiles:
+            ns = apimeta.name_of(p)
+            owner = p.get("spec", {}).get("owner", {}).get("name")
+            role = "owner" if owner == u else None
+            if role is None:
+                resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={ns}&user={u}")
+                if (resp.body or {}).get("bindings"):
+                    role = "contributor"
+            if role:
+                namespaces.append({"namespace": ns, "role": role})
+        return {
+            "user": u,
+            "platform": app.call("GET", "/api/platform-info", None, {cfg.userid_header: u}).body,
+            "namespaces": namespaces,
+            "isClusterAdmin": authorizer.is_cluster_admin(u),
+        }
+
+    @app.route("/api/workgroup/nuke-self", methods=("DELETE",))
+    def nuke_self(req: Request):
+        u = user(req)
+        nuked = []
+        for p in client.list(PROFILE_API, "Profile"):
+            if p.get("spec", {}).get("owner", {}).get("name") == u:
+                kfam(req, "DELETE", f"/kfam/v1/profiles/{apimeta.name_of(p)}")
+                nuked.append(apimeta.name_of(p))
+        return {"message": f"removed profiles {nuked}"}
+
+    @app.route("/api/workgroup/get-all-namespaces")
+    def all_namespaces(req: Request):
+        if not authorizer.is_cluster_admin(user(req)):
+            raise HttpError(403, "cluster admin only")
+        out = []
+        for p in client.list(PROFILE_API, "Profile"):
+            ns = apimeta.name_of(p)
+            resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={ns}")
+            contributors = [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]
+            out.append([ns, contributors])
+        return out
+
+    @app.route("/api/workgroup/get-contributors/<ns>")
+    def contributors(req: Request):
+        # contributor ↔ edit role (api_workgroup.ts:40-48); the owner's admin
+        # binding is not a contributor.
+        resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={req.params['ns']}&role=edit")
+        return [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]
+
+    @app.route("/api/workgroup/add-contributor/<ns>", methods=("POST",))
+    def add_contributor(req: Request):
+        body = req.json or {}
+        kfam(
+            req,
+            "POST",
+            "/kfam/v1/bindings",
+            {
+                "user": {"kind": "User", "name": body.get("contributor", "")},
+                "referredNamespace": req.params["ns"],
+                "roleRef": {"kind": "ClusterRole", "name": "edit"},
+            },
+        )
+        return contributors(req)
+
+    @app.route("/api/workgroup/remove-contributor/<ns>", methods=("DELETE",))
+    def remove_contributor(req: Request):
+        body = req.json or {}
+        kfam(
+            req,
+            "DELETE",
+            "/kfam/v1/bindings",
+            {
+                "user": {"kind": "User", "name": body.get("contributor", "")},
+                "referredNamespace": req.params["ns"],
+                "roleRef": {"kind": "ClusterRole", "name": "edit"},
+            },
+        )
+        return contributors(req)
+
+    return app

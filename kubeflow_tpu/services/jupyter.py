@@ -1,0 +1,270 @@
+"""Jupyter web app backend: the notebook spawner REST API.
+
+Re-implements the reference JWA backend (crud-web-apps/jupyter/backend/):
+
+- the spawn path (apps/default/routes/post.py:11-74): form → workspace/data
+  PVCs → Notebook CR, honoring admin readOnly config,
+- GET routes (apps/common/routes/get.py): /api/config, per-namespace
+  notebooks/pvcs/poddefaults, and accelerator discovery — the reference's
+  ``/api/gpus`` intersects config vendor limit-keys with node capacity
+  (get.py:50-71); here ``/api/tpus`` reports TPU generations/topologies
+  actually present on nodes by the GKE labels,
+- start/stop (apps/common/routes/patch.py): toggle the
+  ``kubeflow-resource-stopped`` annotation,
+- status derivation from CR status/events (apps/common/status.py),
+- per-call authorization + CSRF (crud_backend semantics).
+
+TPU specifics: the form's ``tpus`` selection lands in ``spec.tpu`` of the
+Notebook CR — sizing the StatefulSet to the slice's host count — and a
+``configurations`` label selects TPU PodDefaults for env/limits injection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..apiserver.store import Conflict
+from ..controllers.notebook import STOP_ANNOTATION
+from ..tpu.topology import (
+    ACCELERATORS,
+    NODE_LABEL_ACCELERATOR,
+    NODE_LABEL_TOPOLOGY,
+    RESOURCE_TPU,
+)
+from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
+from ..web.http import App, HttpError, JsonResponse, Request
+from .spawner_config import SpawnerConfig
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+def notebook_status(nb: Dict[str, Any], events: List[Dict[str, Any]]) -> Dict[str, str]:
+    """UI status from CR state (apps/common/status.py:1-99)."""
+    if STOP_ANNOTATION in apimeta.annotations_of(nb):
+        return {"phase": "stopped", "message": "Notebook is stopped"}
+    status = nb.get("status") or {}
+    conditions = status.get("conditions") or []
+    for c in conditions:
+        if c.get("type") == "Failed" and c.get("status") == "True":
+            return {"phase": "error", "message": c.get("message", "failed")}
+    tpu = status.get("tpu")
+    want = tpu["numHosts"] if tpu else 1
+    ready = status.get("readyReplicas", 0)
+    if ready >= want:
+        return {"phase": "ready", "message": "Running"}
+    warnings = [e for e in events if e.get("type") == "Warning"]
+    if warnings:
+        return {"phase": "warning", "message": warnings[-1].get("message", "")}
+    return {"phase": "waiting", "message": f"{ready}/{want} hosts ready"}
+
+
+def make_jupyter_app(
+    client: Client,
+    auth: Optional[AuthConfig] = None,
+    spawner: Optional[SpawnerConfig] = None,
+) -> App:
+    cfg = auth or AuthConfig()
+    spawner = spawner or SpawnerConfig()
+    authorizer = Authorizer(client, cfg)
+    app = App("jupyter-web-app")
+    install_auth(app, authorizer)
+
+    def user(req: Request) -> str:
+        return req.context["user"]
+
+    # -- config + discovery --------------------------------------------------
+    @app.route("/api/config")
+    def get_config(req: Request):
+        resp = JsonResponse({"config": spawner.config})
+        issue_csrf_cookie(resp, cfg)
+        return resp
+
+    @app.route("/api/tpus")
+    def get_tpus(req: Request):
+        """TPU discovery: generations/topologies present in node capacity
+        (the reference's vendor discovery reshaped for slices)."""
+        found: Dict[str, Dict[str, Any]] = {}
+        for node in client.list("v1", "Node"):
+            labels = apimeta.labels_of(node)
+            gke_name = labels.get(NODE_LABEL_ACCELERATOR)
+            capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
+            if not gke_name or capacity <= 0:
+                continue
+            gen = next((g for g, a in ACCELERATORS.items() if a.gke_name == gke_name), None)
+            if gen is None:
+                continue
+            entry = found.setdefault(gen, {"generation": gen, "topologies": set(), "chipsPerNode": capacity})
+            topo = labels.get(NODE_LABEL_TOPOLOGY)
+            if topo:
+                entry["topologies"].add(topo)
+        return {
+            "tpus": [
+                {**e, "topologies": sorted(e["topologies"])} for e in found.values()
+            ]
+        }
+
+    # -- listings ------------------------------------------------------------
+    @app.route("/api/namespaces/<ns>/notebooks")
+    def list_notebooks(req: Request):
+        authorizer.ensure(user(req), "list", req.params["ns"])
+        ns = req.params["ns"]
+        out = []
+        all_events = client.list("v1", "Event", ns)
+        for nb in client.list(NOTEBOOK_API, "Notebook", ns):
+            name = apimeta.name_of(nb)
+            events = [
+                e for e in all_events
+                if e.get("involvedObject", {}).get("name") == name
+            ]
+            tpu = nb.get("spec", {}).get("tpu")
+            out.append(
+                {
+                    "name": name,
+                    "namespace": ns,
+                    "image": _first_container(nb).get("image", ""),
+                    "tpu": tpu,
+                    "status": notebook_status(nb, events),
+                    "serverType": "jupyter",
+                }
+            )
+        return {"notebooks": out}
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>")
+    def get_notebook(req: Request):
+        authorizer.ensure(user(req), "get", req.params["ns"])
+        nb = client.get_opt(NOTEBOOK_API, "Notebook", req.params["name"], req.params["ns"])
+        if nb is None:
+            raise HttpError(404, "notebook not found")
+        return {"notebook": nb}
+
+    @app.route("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(req: Request):
+        authorizer.ensure(user(req), "list", req.params["ns"])
+        return {"pvcs": client.list("v1", "PersistentVolumeClaim", req.params["ns"])}
+
+    @app.route("/api/namespaces/<ns>/poddefaults")
+    def list_poddefaults(req: Request):
+        authorizer.ensure(user(req), "list", req.params["ns"])
+        pds = client.list("kubeflow.org/v1alpha1", "PodDefault", req.params["ns"])
+        return {
+            "poddefaults": [
+                {
+                    "label": next(iter((pd["spec"].get("selector") or {}).get("matchLabels") or {}), ""),
+                    "desc": pd["spec"].get("desc", apimeta.name_of(pd)),
+                    "name": apimeta.name_of(pd),
+                }
+                for pd in pds
+            ]
+        }
+
+    # -- spawn ---------------------------------------------------------------
+    @app.route("/api/namespaces/<ns>/notebooks", methods=("POST",))
+    def create_notebook(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(user(req), "create", ns)
+        form = req.json or {}
+        name = form.get("name")
+        if not name:
+            raise HttpError(400, "notebook name required")
+        image = spawner.form_value(form, "image")
+        if isinstance(image, dict):
+            image = image.get("value", "")
+        cpu = str(spawner.form_value(form, "cpu"))
+        memory = str(spawner.form_value(form, "memory"))
+        tpu = spawner.tpu_of_form(form)
+
+        volumes, mounts = [], []
+        workspace = spawner.form_value(form, "workspaceVolume")
+        for vol in ([workspace] if workspace else []) + list(spawner.form_value(form, "dataVolumes") or []):
+            pvc_info = _ensure_pvc(client, ns, name, vol)
+            if pvc_info:
+                volumes.append({"name": pvc_info["name"], "persistentVolumeClaim": {"claimName": pvc_info["name"]}})
+                mounts.append({"name": pvc_info["name"], "mountPath": vol.get("mount", "/data")})
+
+        labels = {}
+        for conf in spawner.form_value(form, "configurations") or []:
+            labels[conf] = "true"
+
+        container: Dict[str, Any] = {
+            "name": name,
+            "image": image,
+            "resources": {"requests": {"cpu": cpu, "memory": memory}},
+            "volumeMounts": mounts,
+        }
+        if spawner.form_value(form, "shm"):
+            volumes.append({"name": "dshm", "emptyDir": {"medium": "Memory"}})
+            container["volumeMounts"] = mounts + [{"name": "dshm", "mountPath": "/dev/shm"}]
+
+        spec: Dict[str, Any] = {
+            "template": {"spec": {"containers": [container], "volumes": volumes}}
+        }
+        if tpu:
+            spec["tpu"] = tpu
+
+        nb = apimeta.new_object(NOTEBOOK_API, "Notebook", name, ns, labels=labels, spec=spec)
+        try:
+            client.create(nb)
+        except Conflict:
+            raise HttpError(409, f"notebook {name!r} exists") from None
+        return {"status": "created", "notebook": name}
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=("PATCH",))
+    def patch_notebook(req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        authorizer.ensure(user(req), "update", ns)
+        body = req.json or {}
+        stopped = body.get("stopped")
+        nb = client.get_opt(NOTEBOOK_API, "Notebook", name, ns)
+        if nb is None:
+            raise HttpError(404, "notebook not found")
+        nb = apimeta.deepcopy(nb)
+        anns = nb["metadata"].setdefault("annotations", {})
+        if stopped:
+            anns[STOP_ANNOTATION] = client.store.now()
+        else:
+            anns.pop(STOP_ANNOTATION, None)
+        client.update(nb)
+        return {"status": "stopped" if stopped else "started"}
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=("DELETE",))
+    def delete_notebook(req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        authorizer.ensure(user(req), "delete", ns)
+        client.delete(NOTEBOOK_API, "Notebook", name, ns)
+        return {"status": "deleted"}
+
+    return app
+
+
+def _first_container(nb: Dict[str, Any]) -> Dict[str, Any]:
+    containers = nb.get("spec", {}).get("template", {}).get("spec", {}).get("containers") or [{}]
+    return containers[0]
+
+
+def _ensure_pvc(client: Client, ns: str, nb_name: str, vol: Dict[str, Any]) -> Optional[Dict[str, str]]:
+    """Create the PVC for a 'new' volume; reference existing ones as-is."""
+    if not isinstance(vol, dict):
+        return None
+    if "existingSource" in vol or "existing" in vol:
+        name = vol.get("existing") or (vol.get("existingSource") or {}).get(
+            "persistentVolumeClaim", {}
+        ).get("claimName")
+        return {"name": name} if name else None
+    new = vol.get("newPvc")
+    if not new:
+        return None
+    pvc_name = (new.get("metadata") or {}).get("name", f"{nb_name}-vol")
+    pvc_name = pvc_name.replace("{notebook-name}", nb_name)
+    if client.get_opt("v1", "PersistentVolumeClaim", pvc_name, ns) is None:
+        pvc_spec = apimeta.deepcopy(new.get("spec") or {})
+        storage_class = pvc_spec.get("storageClassName")
+        # Storage-class sentinels (volumes webapp form.py:4-19).
+        if storage_class == "{none}":
+            pvc_spec["storageClassName"] = None
+        elif storage_class == "{empty}":
+            pvc_spec.pop("storageClassName", None)
+        pvc = apimeta.new_object("v1", "PersistentVolumeClaim", pvc_name, ns, spec=pvc_spec)
+        client.create(pvc)
+    return {"name": pvc_name}

@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
+from ..apiserver.store import Conflict
 from ..controllers.profile import PROFILE_API, ROLE_MAP
 from ..runtime.metrics import METRICS
 from ..web.auth import AuthConfig, Authorizer, install_auth
@@ -70,10 +71,11 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
             name,
             spec={"owner": owner, **{k: v for k, v in (body.get("spec") or {}).items() if k != "owner"}},
         )
-        if client.get_opt(PROFILE_API, "Profile", name) is not None:
-            raise HttpError(409, f"profile {name!r} already exists")
         METRICS.counter("kfam_request_total", route="create_profile").inc()
-        return client.create(profile)
+        try:
+            return client.create(profile)
+        except Conflict:
+            raise HttpError(409, f"profile {name!r} already exists") from None
 
     @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
     def delete_profile(req: Request):
@@ -99,8 +101,6 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
         ensure_owner_or_admin(req.context["user"], ns)
 
         name = binding_name(subject["name"], role)
-        if client.get_opt("rbac.authorization.k8s.io/v1", "RoleBinding", name, ns):
-            raise HttpError(409, "binding already exists")
         rb = apimeta.new_object(
             "rbac.authorization.k8s.io/v1",
             "RoleBinding",
@@ -117,7 +117,10 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
             },
             subjects=[{"kind": "User", "name": subject["name"]}],
         )
-        client.create(rb)
+        try:
+            client.create(rb)
+        except Conflict:
+            raise HttpError(409, "binding already exists") from None
         policy = apimeta.new_object(
             "security.istio.io/v1beta1",
             "AuthorizationPolicy",
@@ -136,7 +139,10 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
                 ]
             },
         )
-        client.create(policy)
+        try:
+            client.create(policy)
+        except Conflict:
+            pass  # leftover from a half-completed earlier create; rb is the gate
         METRICS.counter("kfam_request_total", route="create_binding").inc()
         return {"status": "created", "binding": rb}
 

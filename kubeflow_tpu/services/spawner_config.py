@@ -1,0 +1,98 @@
+"""Spawner (admin) configuration with value/readOnly semantics.
+
+The reference drives its notebook-spawn form from an admin YAML
+(crud-web-apps/jupyter/backend/apps/common/yaml/spawner_ui_config.yaml) where
+every field carries ``value`` (default) and ``readOnly`` (users may not
+override — enforced server-side at form.py:16-48). This module keeps those
+semantics and replaces the GPU-era ``gpus.vendors`` block
+(spawner_ui_config.yaml:141-154) with a first-class ``tpus`` section:
+accelerator generations + slice topology picker, validated against the
+platform topology catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from ..tpu.topology import ACCELERATORS, parse_topology
+from ..web.http import HttpError
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "spawnerFormDefaults": {
+        "image": {
+            "value": "kubeflow-tpu/jupyter-jax-tpu:latest",
+            "options": [
+                "kubeflow-tpu/jupyter-jax-tpu:latest",
+                "kubeflow-tpu/jupyter-jax-tpu-full:latest",
+                "kubeflow-tpu/jupyter-scipy:latest",
+                "kubeflow-tpu/codeserver-jax-tpu:latest",
+                "kubeflow-tpu/rstudio-tidyverse:latest",
+            ],
+            "readOnly": False,
+        },
+        "cpu": {"value": "4.0", "limitFactor": "1.2", "readOnly": False},
+        "memory": {"value": "8.0Gi", "limitFactor": "1.2", "readOnly": False},
+        "workspaceVolume": {
+            "value": {
+                "mount": "/home/jovyan",
+                "newPvc": {
+                    "metadata": {"name": "{notebook-name}-workspace"},
+                    "spec": {
+                        "resources": {"requests": {"storage": "10Gi"}},
+                        "accessModes": ["ReadWriteOnce"],
+                    },
+                },
+            },
+            "readOnly": False,
+        },
+        "dataVolumes": {"value": [], "readOnly": False},
+        # The TPU block (replaces `gpus`): generation + topology, validated
+        # against the catalog; num=none means CPU-only notebook.
+        "tpus": {
+            "value": {"generation": "none", "topology": ""},
+            "generations": sorted(ACCELERATORS),
+            "readOnly": False,
+        },
+        "configurations": {"value": [], "readOnly": False},  # PodDefault labels
+        "affinityConfig": {"value": "", "options": [], "readOnly": False},
+        "tolerationGroup": {"value": "", "options": [], "readOnly": False},
+        "shm": {"value": True, "readOnly": False},
+    }
+}
+
+
+class SpawnerConfig:
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = config or DEFAULT_CONFIG
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "SpawnerConfig":
+        return cls(yaml.safe_load(text))
+
+    @property
+    def defaults(self) -> Dict[str, Any]:
+        return self.config.get("spawnerFormDefaults", {})
+
+    def form_value(self, form: Dict[str, Any], field: str) -> Any:
+        """User value unless the field is admin-locked (form.py:16-48)."""
+        cfg = self.defaults.get(field, {})
+        if cfg.get("readOnly"):
+            return cfg.get("value")
+        if field in form:
+            return form[field]
+        return cfg.get("value")
+
+    def tpu_of_form(self, form: Dict[str, Any]) -> Optional[Dict[str, str]]:
+        """Validated {generation, topology} or None for CPU-only."""
+        tpu = self.form_value(form, "tpus") or {}
+        generation = tpu.get("generation", "none")
+        if generation in ("none", "", None):
+            return None
+        topology = tpu.get("topology", "")
+        try:
+            parse_topology(generation, topology)
+        except ValueError as e:
+            raise HttpError(400, f"invalid TPU selection: {e}") from None
+        return {"generation": generation, "topology": topology}

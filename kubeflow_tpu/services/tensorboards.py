@@ -1,0 +1,73 @@
+"""Tensorboards web app backend: CRUD over Tensorboard CRs.
+
+Re-implements the reference TWA backend (crud-web-apps/tensorboards/backend/
+app/routes/: post.py:14-38 creates the CR from {name, logspath}; get/delete
+are generic CR CRUD via the shared crud_backend).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..apiserver.store import Conflict
+from ..controllers.tensorboard import TB_API, parse_logspath
+from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
+from ..web.http import App, HttpError, JsonResponse, Request
+
+
+def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
+    cfg = auth or AuthConfig()
+    authorizer = Authorizer(client, cfg)
+    app = App("tensorboards-web-app")
+    install_auth(app, authorizer)
+
+    @app.route("/api/config")
+    def config(req: Request):
+        resp = JsonResponse({"config": {}})
+        issue_csrf_cookie(resp, cfg)
+        return resp
+
+    @app.route("/api/namespaces/<ns>/tensorboards")
+    def list_tbs(req: Request):
+        authorizer.ensure(req.context["user"], "list", req.params["ns"])
+        out = []
+        for tb in client.list(TB_API, "Tensorboard", req.params["ns"]):
+            status = tb.get("status") or {}
+            out.append(
+                {
+                    "name": apimeta.name_of(tb),
+                    "namespace": req.params["ns"],
+                    "logspath": tb.get("spec", {}).get("logspath", ""),
+                    "ready": status.get("readyReplicas", 0) > 0,
+                    "conditions": status.get("conditions", []),
+                }
+            )
+        return {"tensorboards": out}
+
+    @app.route("/api/namespaces/<ns>/tensorboards", methods=("POST",))
+    def create_tb(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "create", ns)
+        body = req.json or {}
+        name, logspath = body.get("name"), body.get("logspath", "")
+        if not name:
+            raise HttpError(400, "name required")
+        try:
+            parse_logspath(logspath)
+        except ValueError as e:
+            raise HttpError(400, str(e)) from None
+        try:
+            client.create(apimeta.new_object(TB_API, "Tensorboard", name, ns, spec={"logspath": logspath}))
+        except Conflict:
+            raise HttpError(409, f"tensorboard {name!r} exists") from None
+        return {"status": "created"}
+
+    @app.route("/api/namespaces/<ns>/tensorboards/<name>", methods=("DELETE",))
+    def delete_tb(req: Request):
+        authorizer.ensure(req.context["user"], "delete", req.params["ns"])
+        client.delete(TB_API, "Tensorboard", req.params["name"], req.params["ns"])
+        return {"status": "deleted"}
+
+    return app

@@ -1,0 +1,95 @@
+"""Volumes web app backend: PVC CRUD.
+
+Re-implements the reference VWA backend (crud-web-apps/volumes/backend/apps/
+common/form.py:22-38 pvc_from_dict; storage-class sentinels {none}/{empty}
+form.py:4-19). Deletion is refused while a pod mounts the PVC — the UI-level
+guard the reference implements client-side.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..apiserver.store import Conflict
+from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
+from ..web.http import App, HttpError, JsonResponse, Request
+
+
+def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
+    cfg = auth or AuthConfig()
+    authorizer = Authorizer(client, cfg)
+    app = App("volumes-web-app")
+    install_auth(app, authorizer)
+
+    @app.route("/api/config")
+    def config(req: Request):
+        resp = JsonResponse({"config": {}})
+        issue_csrf_cookie(resp, cfg)
+        return resp
+
+    @app.route("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "list", ns)
+        mounted = _mounted_pvcs(client, ns)
+        return {
+            "pvcs": [
+                {
+                    "name": apimeta.name_of(p),
+                    "namespace": ns,
+                    "capacity": (p.get("spec", {}).get("resources", {}).get("requests") or {}).get("storage", ""),
+                    "modes": p.get("spec", {}).get("accessModes", []),
+                    "class": p.get("spec", {}).get("storageClassName"),
+                    "inUse": apimeta.name_of(p) in mounted,
+                }
+                for p in client.list("v1", "PersistentVolumeClaim", ns)
+            ]
+        }
+
+    @app.route("/api/namespaces/<ns>/pvcs", methods=("POST",))
+    def create_pvc(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "create", ns)
+        body = req.json or {}
+        name = body.get("name")
+        if not name:
+            raise HttpError(400, "name required")
+        size = body.get("size", "10Gi")
+        mode = body.get("mode", "ReadWriteOnce")
+        storage_class = body.get("class", "{empty}")
+        spec = {
+            "accessModes": [mode],
+            "resources": {"requests": {"storage": size}},
+        }
+        if storage_class == "{none}":
+            spec["storageClassName"] = None
+        elif storage_class != "{empty}":
+            spec["storageClassName"] = storage_class
+        try:
+            client.create(apimeta.new_object("v1", "PersistentVolumeClaim", name, ns, spec=spec))
+        except Conflict:
+            raise HttpError(409, f"pvc {name!r} exists") from None
+        return {"status": "created"}
+
+    @app.route("/api/namespaces/<ns>/pvcs/<name>", methods=("DELETE",))
+    def delete_pvc(req: Request):
+        ns, name = req.params["ns"], req.params["name"]
+        authorizer.ensure(req.context["user"], "delete", ns)
+        if name in _mounted_pvcs(client, ns):
+            raise HttpError(409, f"pvc {name!r} is mounted by a pod")
+        client.delete("v1", "PersistentVolumeClaim", name, ns)
+        return {"status": "deleted"}
+
+    return app
+
+
+def _mounted_pvcs(client: Client, ns: str) -> set:
+    used = set()
+    for pod in client.list("v1", "Pod", ns):
+        for vol in pod.get("spec", {}).get("volumes", []) or []:
+            claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+            if claim:
+                used.add(claim)
+    return used

@@ -28,7 +28,7 @@ USERID_HEADER = "kubeflow-userid"
 XSRF_COOKIE = "XSRF-TOKEN"
 XSRF_HEADER = "x-xsrf-token"
 UNSAFE = {"POST", "PUT", "PATCH", "DELETE"}
-PROBE_PATHS = ("/healthz", "/metrics", "/apple-touch")
+PROBE_PATHS = ("/healthz",)  # auth-free; /metrics stays authenticated
 
 #: verb sets per platform ClusterRole (reference kfam bindings.go:39-46 role
 #: model + kubeflow-edit/view RBAC manifests).
@@ -110,7 +110,7 @@ def install_auth(app: App, authorizer: Authorizer, enable_csrf: bool = True) -> 
 
     @app.middleware
     def probes(req: Request) -> Optional[JsonResponse]:
-        if req.path.startswith("/healthz"):
+        if req.path.startswith(PROBE_PATHS):
             return JsonResponse({"status": "ok"})
         return None
 

@@ -1,0 +1,246 @@
+"""CRUD web apps + dashboard BFF tests (SURVEY §2.6, §2.7).
+
+Drives the spawn path end to end: form → PVC + Notebook CR → controller →
+StatefulSet → webhook TPU injection → running pods — the reference's
+'create notebook' call stack (SURVEY §3.1) in-process.
+"""
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.builtin import make_tpu_node
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.services.dashboard import make_dashboard_app
+from kubeflow_tpu.services.jupyter import make_jupyter_app, notebook_status
+from kubeflow_tpu.services.kfam import make_kfam_app
+from kubeflow_tpu.services.spawner_config import SpawnerConfig
+from kubeflow_tpu.services.tensorboards import make_tensorboards_app
+from kubeflow_tpu.services.volumes import make_volumes_app
+from kubeflow_tpu.tpu.env import env_list_to_dict
+from kubeflow_tpu.web.auth import AuthConfig
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+ADMIN = {"kubeflow-userid": "root@example.com"}
+
+
+@pytest.fixture()
+def platform():
+    mgr = build_platform().start()
+    yield mgr
+    mgr.stop()
+
+
+@pytest.fixture()
+def auth():
+    return AuthConfig(cluster_admins=["root@example.com"], disable_auth=False)
+
+
+@pytest.fixture()
+def team_a(platform, auth):
+    """Profile owned by alice, reconciled."""
+    kfam = make_kfam_app(platform.client, auth)
+    assert kfam.call("POST", "/kfam/v1/profiles", {"name": "team-a"}, ALICE).status == 200
+    assert platform.wait_idle()
+    return kfam
+
+
+def csrf_headers(app, base_headers):
+    """GET /api/config to obtain the CSRF cookie, echo it as header+cookie."""
+    resp = app.call("GET", "/api/config", None, base_headers)
+    cookie = next(c for c in resp.cookies if c.startswith("XSRF-TOKEN="))
+    token = cookie.split(";")[0].split("=", 1)[1]
+    return {**base_headers, "cookie": f"XSRF-TOKEN={token}", "x-xsrf-token": token}
+
+
+class TestJupyterSpawnPath:
+    def test_spawn_tpu_notebook_end_to_end(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        headers = csrf_headers(jwa, ALICE)
+        form = {
+            "name": "trainer",
+            "image": "kubeflow-tpu/jupyter-jax-tpu:latest",
+            "cpu": "2",
+            "memory": "4Gi",
+            "tpus": {"generation": "v5e", "topology": "2x4"},
+            "workspaceVolume": {
+                "mount": "/home/jovyan",
+                "newPvc": {
+                    "metadata": {"name": "{notebook-name}-workspace"},
+                    "spec": {"resources": {"requests": {"storage": "5Gi"}},
+                             "accessModes": ["ReadWriteOnce"]},
+                },
+            },
+        }
+        r = jwa.call("POST", "/api/namespaces/team-a/notebooks", form, headers)
+        assert r.status == 200, r.body
+        assert platform.wait_idle()
+        # PVC created
+        pvc = platform.client.get("v1", "PersistentVolumeClaim", "trainer-workspace", "team-a")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "5Gi"
+        # Notebook CR carries the tpu spec; controller sized the slice: 8 chips = 2 hosts
+        sts = platform.client.get("apps/v1", "StatefulSet", "trainer", "team-a")
+        assert sts["spec"]["replicas"] == 2
+        # listing shows status
+        listing = jwa.call("GET", "/api/namespaces/team-a/notebooks", None, headers)
+        nb = listing.body["notebooks"][0]
+        assert nb["name"] == "trainer"
+        assert nb["tpu"] == {"generation": "v5e", "topology": "2x4"}
+        assert nb["status"]["phase"] == "ready"
+
+    def test_invalid_tpu_selection_rejected(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        headers = csrf_headers(jwa, ALICE)
+        r = jwa.call(
+            "POST",
+            "/api/namespaces/team-a/notebooks",
+            {"name": "bad", "tpus": {"generation": "v5e", "topology": "3x5"}},
+            headers,
+        )
+        assert r.status == 400
+        assert "invalid TPU selection" in r.body["error"]
+
+    def test_stop_start_cycle(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        headers = csrf_headers(jwa, ALICE)
+        jwa.call("POST", "/api/namespaces/team-a/notebooks", {"name": "nb1"}, headers)
+        assert platform.wait_idle()
+        r = jwa.call("PATCH", "/api/namespaces/team-a/notebooks/nb1", {"stopped": True}, headers)
+        assert r.status == 200
+        assert platform.wait_idle()
+        sts = platform.client.get("apps/v1", "StatefulSet", "nb1", "team-a")
+        assert sts["spec"]["replicas"] == 0
+        listing = jwa.call("GET", "/api/namespaces/team-a/notebooks", None, headers)
+        assert listing.body["notebooks"][0]["status"]["phase"] == "stopped"
+        jwa.call("PATCH", "/api/namespaces/team-a/notebooks/nb1", {"stopped": False}, headers)
+        assert platform.wait_idle()
+        assert platform.client.get("apps/v1", "StatefulSet", "nb1", "team-a")["spec"]["replicas"] == 1
+
+    def test_csrf_enforced(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        r = jwa.call("POST", "/api/namespaces/team-a/notebooks", {"name": "x"}, ALICE)
+        assert r.status == 403 and "CSRF" in r.body["error"]
+
+    def test_authz_enforced(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        bob = {"kubeflow-userid": "bob@example.com"}
+        headers = csrf_headers(jwa, bob)
+        r = jwa.call("POST", "/api/namespaces/team-a/notebooks", {"name": "x"}, headers)
+        assert r.status == 403
+
+    def test_tpu_discovery(self, platform, team_a, auth):
+        platform.client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+        platform.client.create(make_tpu_node("tpu-node-2", "v5e", "4x4", 4))
+        jwa = make_jupyter_app(platform.client, auth)
+        r = jwa.call("GET", "/api/tpus", None, ALICE)
+        tpus = r.body["tpus"]
+        assert len(tpus) == 1
+        assert tpus[0]["generation"] == "v5e"
+        assert tpus[0]["topologies"] == ["2x4", "4x4"]
+
+    def test_readonly_admin_config_wins(self, platform, team_a, auth):
+        cfg = SpawnerConfig()
+        cfg.defaults["image"]["readOnly"] = True
+        cfg.defaults["image"]["value"] = "locked-image:1"
+        jwa = make_jupyter_app(platform.client, auth, cfg)
+        headers = csrf_headers(jwa, ALICE)
+        jwa.call("POST", "/api/namespaces/team-a/notebooks",
+                 {"name": "nb2", "image": "evil:latest"}, headers)
+        assert platform.wait_idle()
+        nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "nb2", "team-a")
+        assert nb["spec"]["template"]["spec"]["containers"][0]["image"] == "locked-image:1"
+
+
+class TestTensorboardsAndVolumes:
+    def test_tensorboards_crud(self, platform, team_a, auth):
+        twa = make_tensorboards_app(platform.client, auth)
+        headers = csrf_headers(twa, ALICE)
+        assert twa.call("POST", "/api/namespaces/team-a/tensorboards",
+                        {"name": "tb", "logspath": "pvc://logs/x"}, headers).status == 200
+        assert platform.wait_idle()
+        listing = twa.call("GET", "/api/namespaces/team-a/tensorboards", None, headers)
+        assert listing.body["tensorboards"][0]["ready"] is True
+        assert twa.call("POST", "/api/namespaces/team-a/tensorboards",
+                        {"name": "bad", "logspath": ""}, headers).status == 400
+        assert twa.call("DELETE", "/api/namespaces/team-a/tensorboards/tb", None, headers).status == 200
+
+    def test_volumes_crud_and_in_use_guard(self, platform, team_a, auth):
+        vwa = make_volumes_app(platform.client, auth)
+        headers = csrf_headers(vwa, ALICE)
+        assert vwa.call("POST", "/api/namespaces/team-a/pvcs",
+                        {"name": "data", "size": "20Gi"}, headers).status == 200
+        listing = vwa.call("GET", "/api/namespaces/team-a/pvcs", None, headers)
+        assert listing.body["pvcs"][0]["capacity"] == "20Gi"
+        # mount it from a pod -> delete refused
+        pod = new_object("v1", "Pod", "user-pod", "team-a", spec={
+            "containers": [{"name": "c", "image": "x"}],
+            "volumes": [{"name": "v", "persistentVolumeClaim": {"claimName": "data"}}],
+        })
+        platform.client.create(pod)
+        r = vwa.call("DELETE", "/api/namespaces/team-a/pvcs/data", None, headers)
+        assert r.status == 409
+        platform.client.delete("v1", "Pod", "user-pod", "team-a")
+        platform.store.collect_garbage()
+        assert vwa.call("DELETE", "/api/namespaces/team-a/pvcs/data", None, headers).status == 200
+
+
+class TestDashboard:
+    def test_workgroup_flow(self, platform, auth):
+        kfam = make_kfam_app(platform.client, auth)
+        dash = make_dashboard_app(platform.client, kfam, auth)
+        # registration
+        r = dash.call("GET", "/api/workgroup/exists", None, ALICE)
+        assert r.body["hasWorkgroup"] is False
+        assert dash.call("POST", "/api/workgroup/create", {"namespace": "team-a"}, ALICE).status == 200
+        assert platform.wait_idle()
+        assert dash.call("GET", "/api/workgroup/exists", None, ALICE).body["hasWorkgroup"] is True
+        # contributors via dashboard -> kfam
+        r = dash.call("POST", "/api/workgroup/add-contributor/team-a",
+                      {"contributor": "bob@example.com"}, ALICE)
+        assert r.status == 200 and r.body == ["bob@example.com"]
+        env = dash.call("GET", "/api/workgroup/env-info", None,
+                        {"kubeflow-userid": "bob@example.com"})
+        assert {"namespace": "team-a", "role": "contributor"} in env.body["namespaces"]
+        r = dash.call("DELETE", "/api/workgroup/remove-contributor/team-a",
+                      {"contributor": "bob@example.com"}, ALICE)
+        assert r.body == []
+        # nuke-self
+        assert dash.call("DELETE", "/api/workgroup/nuke-self", None, ALICE).status == 200
+        assert platform.wait_idle()
+        assert platform.client.get_opt("kubeflow.org/v1", "Profile", "team-a") is None
+
+    def test_tpu_metrics_and_activities(self, platform, auth):
+        platform.client.create(make_tpu_node("tpu-node-1", "v5e", "2x2", 4))
+        dash = make_dashboard_app(platform.client, None, auth)
+        pod = new_object("v1", "Pod", "worker", "default", spec={
+            "nodeName": "tpu-node-1",
+            "containers": [{"name": "c", "image": "x",
+                            "resources": {"limits": {"google.com/tpu": "4"}}}],
+        })
+        platform.client.create(pod)
+        assert platform.wait_idle()
+        r = dash.call("GET", "/api/metrics/node", None, ALICE)
+        node = r.body[0]
+        assert node["capacityChips"] == 4 and node["utilization"] == 1.0
+        r = dash.call("GET", "/api/metrics/namespace?namespace=default", None, ALICE)
+        assert r.body["allocatedChips"] == 4
+        # platform inference from providerID
+        assert dash.call("GET", "/api/platform-info", None, ALICE).body["provider"] == "gce"
+
+    def test_all_namespaces_admin_only(self, platform, auth):
+        kfam = make_kfam_app(platform.client, auth)
+        dash = make_dashboard_app(platform.client, kfam, auth)
+        assert dash.call("GET", "/api/workgroup/get-all-namespaces", None, ALICE).status == 403
+        assert dash.call("GET", "/api/workgroup/get-all-namespaces", None, ADMIN).status == 200
+
+
+def test_notebook_status_derivation():
+    nb = {"metadata": {"annotations": {"kubeflow-resource-stopped": "now"}}}
+    assert notebook_status(nb, [])["phase"] == "stopped"
+    nb = {"metadata": {}, "status": {"readyReplicas": 1}}
+    assert notebook_status(nb, [])["phase"] == "ready"
+    nb = {"metadata": {}, "status": {"readyReplicas": 0,
+          "tpu": {"numHosts": 2}}}
+    s = notebook_status(nb, [{"type": "Warning", "message": "scheduling failed"}])
+    assert s["phase"] == "warning" and "scheduling" in s["message"]
+    nb = {"metadata": {}, "status": {"conditions": [{"type": "Failed", "status": "True", "message": "bad"}]}}
+    assert notebook_status(nb, [])["phase"] == "error"
