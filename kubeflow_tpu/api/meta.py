@@ -118,6 +118,7 @@ for _res in [
     Resource("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensorboards"),
     Resource("kubeflow.org", "v1alpha1", "PodDefault", "poddefaults"),
     Resource("katib.kubeflow.org", "v1alpha1", "StudyJob", "studyjobs"),
+    Resource("katib.kubeflow.org", "v1alpha1", "Trial", "trials"),
     Resource("serving.kubeflow.org", "v1alpha1", "InferenceService", "inferenceservices"),
 ]:
     REGISTRY.register(_res)

@@ -165,7 +165,6 @@ class ProfileReconciler(Reconciler):
                 ]
             },
         )
-        apimeta.set_owner_reference(policy, profile)
         rh.reconcile_object(client, policy, profile)
 
     # -- rbac ----------------------------------------------------------------

@@ -1,0 +1,16 @@
+"""Hyperparameter optimization: the platform's Katib-class subsystem.
+
+The reference only *drives* Katib from e2e tests (testing/
+katib_studyjob_test.py launches an external StudyJob controller and waits
+for Running). Here the StudyJob subsystem is in-tree: suggestion algorithms
+(random/grid/bayesian), a StudyJob controller materializing trial pods on
+TPU slices, and an in-process trial executor for CPU CI.
+"""
+
+from kubeflow_tpu.hpo.suggest import (  # noqa: F401
+    BayesianSuggester,
+    GridSuggester,
+    ParamSpec,
+    RandomSuggester,
+    make_suggester,
+)

@@ -1,0 +1,60 @@
+"""Trial workloads: the objective functions StudyJobs optimize.
+
+Each is a short real JAX training run returning {metric: value}. On TPU
+pods these run under the injected slice env (the trial pod path); in CPU CI
+the InProcessTrialRunner calls them directly — mirroring how the
+reference's katib e2e uses an MNIST job it only ever runs on CPU
+(katib_studyjob_test.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def mnist_objective(params: Dict[str, Any], steps: int = 30, batch: int = 64) -> Dict[str, float]:
+    """Train MnistCNN briefly on synthetic data; returns final accuracy/loss.
+
+    Tunable params: lr (double), dropout (double), width (int).
+    Synthetic labels are a deterministic function of the input so the task
+    is learnable and hyperparameters matter.
+    """
+    from kubeflow_tpu.models import MnistCNN
+    from kubeflow_tpu.training import ClassifierTask
+
+    lr = float(params.get("lr", 1e-3))
+    dropout = float(params.get("dropout", 0.1))
+    width = int(params.get("width", 16))
+
+    rng = jax.random.PRNGKey(0)
+    model = MnistCNN(width=width, dropout_rate=dropout, dtype=jnp.float32)
+    task = ClassifierTask(model=model, optimizer=optax.adam(lr))
+
+    imgs = jax.random.normal(rng, (batch, 28, 28, 1))
+    labels = (jnp.abs(imgs).sum((1, 2, 3)) * 7).astype(jnp.int32) % 10
+    state = task.init(rng, imgs)
+    step = task.make_train_step()
+    metrics = {}
+    for _ in range(steps):
+        state, metrics = step(state, imgs, labels)
+    return {
+        "accuracy": float(metrics["accuracy"]),
+        "loss": float(metrics["loss"]),
+    }
+
+
+def quadratic_objective(params: Dict[str, Any]) -> Dict[str, float]:
+    """Cheap analytic objective for suggester/controller tests:
+    max at lr=0.1, width=32."""
+    import math
+
+    lr = float(params.get("lr", 0.0))
+    width = float(params.get("width", 0))
+    score = math.exp(-((math.log10(max(lr, 1e-9)) + 1) ** 2)) * math.exp(
+        -(((width - 32) / 32) ** 2)
+    )
+    return {"accuracy": score}

@@ -14,8 +14,10 @@ from .apiserver.store import Store
 from .controllers.builtin import DeploymentReconciler, PodletReconciler, StatefulSetReconciler
 from .controllers.notebook import NotebookConfig, NotebookReconciler
 from .controllers.profile import ProfileConfig, ProfileReconciler
+from .controllers.studyjob import StudyJobReconciler, TrialPodRunner
 from .controllers.tensorboard import TensorboardConfig, TensorboardReconciler
-from .runtime.manager import Manager
+from .runtime.manager import Manager, Reconciler
+from .serving.controller import InferenceServiceReconciler, ServingConfig
 from .webhook.poddefault import admission_hook
 
 
@@ -24,6 +26,8 @@ def build_platform(
     notebook_config: Optional[NotebookConfig] = None,
     profile_config: Optional[ProfileConfig] = None,
     tensorboard_config: Optional[TensorboardConfig] = None,
+    serving_config: Optional[ServingConfig] = None,
+    trial_runner: Optional[Reconciler] = None,
     with_substrate: bool = True,
     extra_reconcilers=(),
 ) -> Manager:
@@ -37,6 +41,9 @@ def build_platform(
     mgr.add(NotebookReconciler(notebook_config))
     mgr.add(ProfileReconciler(profile_config))
     mgr.add(TensorboardReconciler(tensorboard_config))
+    mgr.add(StudyJobReconciler())
+    mgr.add(trial_runner if trial_runner is not None else TrialPodRunner())
+    mgr.add(InferenceServiceReconciler(serving_config))
     for rec in extra_reconcilers:
         mgr.add(rec)
     return mgr

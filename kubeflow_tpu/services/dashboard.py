@@ -115,6 +115,7 @@ def make_dashboard_app(
             ns = req.query1("namespace")
             if not ns:
                 raise HttpError(400, "namespace query param required")
+            authorizer.ensure(user(req), "list", ns)
             return metrics.namespace_tpu_usage(ns)
         raise HttpError(400, f"unknown metric {kind!r} (node|namespace)")
 

@@ -257,14 +257,16 @@ def _ensure_pvc(client: Client, ns: str, nb_name: str, vol: Dict[str, Any]) -> O
         return None
     pvc_name = (new.get("metadata") or {}).get("name", f"{nb_name}-vol")
     pvc_name = pvc_name.replace("{notebook-name}", nb_name)
-    if client.get_opt("v1", "PersistentVolumeClaim", pvc_name, ns) is None:
-        pvc_spec = apimeta.deepcopy(new.get("spec") or {})
-        storage_class = pvc_spec.get("storageClassName")
-        # Storage-class sentinels (volumes webapp form.py:4-19).
-        if storage_class == "{none}":
-            pvc_spec["storageClassName"] = None
-        elif storage_class == "{empty}":
-            pvc_spec.pop("storageClassName", None)
-        pvc = apimeta.new_object("v1", "PersistentVolumeClaim", pvc_name, ns, spec=pvc_spec)
+    pvc_spec = apimeta.deepcopy(new.get("spec") or {})
+    storage_class = pvc_spec.get("storageClassName")
+    # Storage-class sentinels (volumes webapp form.py:4-19).
+    if storage_class == "{none}":
+        pvc_spec["storageClassName"] = None
+    elif storage_class == "{empty}":
+        pvc_spec.pop("storageClassName", None)
+    pvc = apimeta.new_object("v1", "PersistentVolumeClaim", pvc_name, ns, spec=pvc_spec)
+    try:
         client.create(pvc)
+    except Conflict:
+        pass  # already exists (concurrent spawn or reused workspace) — mount it
     return {"name": pvc_name}

@@ -65,7 +65,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 
 class SpawnerConfig:
     def __init__(self, config: Optional[Dict[str, Any]] = None):
-        self.config = config or DEFAULT_CONFIG
+        import copy
+
+        # Deep-copy: instances are mutable (admins/tests override fields) and
+        # must not alias the module-level defaults.
+        self.config = copy.deepcopy(config) if config else copy.deepcopy(DEFAULT_CONFIG)
 
     @classmethod
     def from_yaml(cls, text: str) -> "SpawnerConfig":

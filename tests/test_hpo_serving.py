@@ -1,0 +1,267 @@
+"""HPO (StudyJob) + serving tests — the two BASELINE e2e targets.
+
+Mirrors the reference e2e drivers on CPU:
+- katib_studyjob_test.py: create StudyJob, wait for Running then Completed
+  within a timeout,
+- test_tf_serving.py: POST /v1/models/<name>:predict, compare with
+  tolerance, retries.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.studyjob import STUDY_API, InProcessTrialRunner
+from kubeflow_tpu.hpo.suggest import (
+    BayesianSuggester,
+    GridSuggester,
+    ParamSpec,
+    RandomSuggester,
+    make_suggester,
+)
+from kubeflow_tpu.hpo.trials import mnist_objective, quadratic_objective
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.serving.controller import SERVING_API
+from kubeflow_tpu.serving.server import ModelServer, ServedModel, bert_served_model
+
+SPECS = [
+    ParamSpec("lr", "double", min=1e-4, max=1.0, log_scale=True),
+    ParamSpec("width", "int", min=8, max=64),
+]
+
+
+class TestSuggesters:
+    def test_random_within_bounds(self):
+        s = RandomSuggester(SPECS, seed=1)
+        for params in s.ask(20):
+            assert 1e-4 <= params["lr"] <= 1.0
+            assert 8 <= params["width"] <= 64 and isinstance(params["width"], int)
+
+    def test_grid_covers_space(self):
+        s = GridSuggester(SPECS, resolution=3)
+        points = s.ask(100)
+        assert len(points) == 9 and s.exhausted
+        assert len({json.dumps(p, sort_keys=True) for p in points}) == 9
+
+    def test_bayesian_beats_random_on_smooth_objective(self):
+        def run(suggester, rounds=14):
+            for _ in range(rounds):
+                (params,) = suggester.ask(1)
+                suggester.tell(params, quadratic_objective(params)["accuracy"])
+            return suggester.best().objective
+
+        bayes = sum(run(BayesianSuggester(SPECS, seed=s)) for s in range(3)) / 3
+        rand = sum(run(RandomSuggester(SPECS, seed=s)) for s in range(3)) / 3
+        assert bayes >= rand - 0.05, (bayes, rand)  # at minimum competitive
+
+    def test_liar_strategy_diversifies_parallel_asks(self):
+        s = BayesianSuggester(SPECS, seed=0, n_startup=2)
+        s.tell({"lr": 0.1, "width": 32}, 1.0)
+        s.tell({"lr": 0.001, "width": 8}, 0.1)
+        batch = s.ask(4)
+        assert len({json.dumps(p, sort_keys=True) for p in batch}) == 4
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ParamSpec("bad", "double", min=2, max=1).validate()
+        with pytest.raises(ValueError):
+            make_suggester("nope", SPECS, True)
+
+
+def mkstudy(name="study", ns="team-a", algorithm="random", max_trials=6, parallel=3,
+            goal=None, metric="accuracy"):
+    objective = {"type": "maximize", "objectiveMetricName": metric}
+    if goal is not None:
+        objective["goal"] = goal
+    return new_object(
+        STUDY_API, "StudyJob", name, ns,
+        spec={
+            "objective": objective,
+            "algorithm": {"algorithmName": algorithm},
+            "parallelTrialCount": parallel,
+            "maxTrialCount": max_trials,
+            "parameters": [
+                {"name": "lr", "parameterType": "double",
+                 "feasibleSpace": {"min": "1e-4", "max": "1.0", "logScale": True}},
+                {"name": "width", "parameterType": "int",
+                 "feasibleSpace": {"min": "8", "max": "64"}},
+            ],
+            "trialTemplate": {"image": "kubeflow-tpu/trial-jax:latest"},
+        },
+    )
+
+
+class TestStudyJobController:
+    def test_studyjob_completes_with_inprocess_trials(self):
+        mgr = build_platform(trial_runner=InProcessTrialRunner(quadratic_objective)).start()
+        try:
+            mgr.client.create(mkstudy(max_trials=6, parallel=2))
+            deadline = time.time() + 30
+            study = None
+            while time.time() < deadline:
+                study = mgr.client.get(STUDY_API, "StudyJob", "study", "team-a")
+                if (study.get("status") or {}).get("phase") == "Completed":
+                    break
+                time.sleep(0.1)
+            status = study["status"]
+            assert status["phase"] == "Completed", status
+            assert status["trialsSucceeded"] == 6
+            optimal = status["currentOptimalTrial"]
+            assert 0 < optimal["observation"]["accuracy"] <= 1.0
+            trials = mgr.client.list(STUDY_API, "Trial", "team-a")
+            assert len(trials) == 6
+        finally:
+            mgr.stop()
+
+    def test_studyjob_goal_short_circuits(self):
+        mgr = build_platform(
+            trial_runner=InProcessTrialRunner(lambda p: {"accuracy": 0.95})
+        ).start()
+        try:
+            mgr.client.create(mkstudy(max_trials=50, parallel=2, goal=0.9))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                study = mgr.client.get(STUDY_API, "StudyJob", "study", "team-a")
+                if (study.get("status") or {}).get("phase") == "Completed":
+                    break
+                time.sleep(0.1)
+            status = study["status"]
+            assert status["phase"] == "Completed"
+            assert status["goalReached"] is True
+            assert status["trialsTotal"] < 50  # goal stopped it early
+        finally:
+            mgr.stop()
+
+    def test_invalid_study_fails_terminally(self):
+        mgr = build_platform().start()
+        try:
+            bad = new_object(STUDY_API, "StudyJob", "bad", "team-a",
+                             spec={"algorithm": {"algorithmName": "random"}, "parameters": []})
+            mgr.client.create(bad)
+            assert mgr.wait_idle()
+            study = mgr.client.get(STUDY_API, "StudyJob", "bad", "team-a")
+            assert study["status"]["phase"] == "Failed"
+            assert study["status"]["reason"] == "InvalidSpec"
+        finally:
+            mgr.stop()
+
+    def test_trial_pods_carry_params_and_labels(self):
+        mgr = build_platform().start()  # default TrialPodRunner
+        try:
+            mgr.client.create(mkstudy(name="podstudy", max_trials=2, parallel=2))
+            assert mgr.wait_idle(15)
+            pods = [p for p in mgr.client.list("v1", "Pod", "team-a")
+                    if p["metadata"]["name"].startswith("podstudy-trial-")]
+            assert len(pods) == 2
+            env = {e["name"]: e["value"] for e in pods[0]["spec"]["containers"][0]["env"]}
+            params = json.loads(env["TRIAL_PARAMETERS"])
+            assert "lr" in params and "PARAM_LR" in env
+            assert pods[0]["metadata"]["labels"]["studyjob-name"] == "podstudy"
+            # pod Succeeded (podlet marks Running; simulate completion)
+            pod = mgr.client.get("v1", "Pod", pods[0]["metadata"]["name"], "team-a")
+            pod["status"]["phase"] = "Succeeded"
+            mgr.client.update_status(pod)
+            assert mgr.wait_idle(15)
+            trial = mgr.client.get(STUDY_API, "Trial", pods[0]["metadata"]["name"], "team-a")
+            assert trial["status"]["phase"] == "Succeeded"
+        finally:
+            mgr.stop()
+
+    def test_mnist_trial_objective_runs(self):
+        metrics = mnist_objective({"lr": 1e-2, "dropout": 0.1, "width": 8}, steps=5, batch=16)
+        assert 0.0 <= metrics["accuracy"] <= 1.0
+        assert np.isfinite(metrics["loss"])
+
+
+class TestServing:
+    def test_predict_shape_and_determinism(self):
+        server = ModelServer().add(bert_served_model("bert"))
+        ids = [[1, 2, 3, 4], [5, 6, 7, 8]]
+        r = server.app.call("POST", "/v1/models/bert:predict", {"instances": ids})
+        assert r.status == 200
+        preds = r.body["predictions"]
+        assert len(preds) == 2
+        r2 = server.app.call("POST", "/v1/models/bert:predict", {"instances": ids})
+        np.testing.assert_allclose(preds, r2.body["predictions"], atol=1e-3)
+
+    def test_batch_padding_buckets(self):
+        served = bert_served_model("bert")
+        server = ModelServer().add(served)
+        # 3 instances -> padded to bucket 4; results identical to per-instance
+        ids = [[1, 2], [3, 4], [5, 6]]
+        r = server.app.call("POST", "/v1/models/bert:predict", {"instances": ids})
+        single = server.app.call("POST", "/v1/models/bert:predict", {"instances": ids[:1]})
+        np.testing.assert_allclose(
+            np.asarray(r.body["predictions"][0]), np.asarray(single.body["predictions"][0]),
+            atol=1e-3,
+        )
+
+    def test_unknown_model_404_and_bad_body_400(self):
+        server = ModelServer()
+        assert server.app.call("POST", "/v1/models/none:predict", {"instances": []}).status == 404
+        server.add(bert_served_model("b"))
+        assert server.app.call("POST", "/v1/models/b:predict", {"nope": 1}).status == 400
+
+    def test_tf_serving_shaped_e2e_over_http(self):
+        """The test_tf_serving.py analog: retries + tolerance compare."""
+        server = ModelServer().add(bert_served_model("mnist"))
+        http = server.serve()
+        try:
+            url = f"http://127.0.0.1:{http.port}/v1/models/mnist:predict"
+            payload = json.dumps({"instances": [[1, 2, 3]]}).encode()
+            expected = None
+            for attempt in range(10):
+                try:
+                    req = urllib.request.Request(
+                        url, data=payload, headers={"Content-Type": "application/json"}
+                    )
+                    with urllib.request.urlopen(req) as resp:
+                        result = json.loads(resp.read())["predictions"]
+                    if expected is None:
+                        expected = result
+                    else:
+                        np.testing.assert_allclose(result, expected, atol=1e-3)
+                        break
+                except urllib.error.URLError:
+                    time.sleep(0.2)
+            else:
+                pytest.fail("never matched")
+            # status route
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/v1/models/mnist"
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["model_version_status"][0]["state"] == "AVAILABLE"
+        finally:
+            http.close()
+
+    def test_inference_service_controller(self):
+        mgr = build_platform().start()
+        try:
+            mgr.client.create(new_object(
+                SERVING_API, "InferenceService", "bert", "team-a",
+                spec={"model": "bert-base", "tpu": {"generation": "v5e", "topology": "2x2"}},
+            ))
+            assert mgr.wait_idle(15)
+            dep = mgr.client.get("apps/v1", "Deployment", "bert", "team-a")
+            c = dep["spec"]["template"]["spec"]["containers"][0]
+            assert c["resources"]["limits"]["google.com/tpu"] == "4"
+            assert dep["spec"]["template"]["spec"]["nodeSelector"][
+                "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+            isvc = mgr.client.get(SERVING_API, "InferenceService", "bert", "team-a")
+            assert isvc["status"]["conditions"][0]["status"] == "True"
+            assert "bert-base" in isvc["status"]["url"]
+            # multi-host topology rejected terminally
+            mgr.client.create(new_object(
+                SERVING_API, "InferenceService", "big", "team-a",
+                spec={"tpu": {"generation": "v5e", "topology": "4x4"}},
+            ))
+            assert mgr.wait_idle(15)
+            bad = mgr.client.get(SERVING_API, "InferenceService", "big", "team-a")
+            assert bad["status"]["conditions"][0]["reason"] == "InvalidSpec"
+        finally:
+            mgr.stop()
