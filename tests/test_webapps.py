@@ -221,7 +221,10 @@ class TestDashboard:
         r = dash.call("GET", "/api/metrics/node", None, ALICE)
         node = r.body[0]
         assert node["capacityChips"] == 4 and node["utilization"] == 1.0
-        r = dash.call("GET", "/api/metrics/namespace?namespace=default", None, ALICE)
+        # namespace metrics are authorized: alice (no binding in default) is
+        # denied; the cluster admin sees them
+        assert dash.call("GET", "/api/metrics/namespace?namespace=default", None, ALICE).status == 403
+        r = dash.call("GET", "/api/metrics/namespace?namespace=default", None, ADMIN)
         assert r.body["allocatedChips"] == 4
         # platform inference from providerID
         assert dash.call("GET", "/api/platform-info", None, ALICE).body["provider"] == "gce"
