@@ -1,0 +1,12 @@
+"""TPU-native hot-path kernels (Pallas).
+
+The reference control plane has no compute kernels (SURVEY.md §2.10 — it
+schedules pods); these are the in-workload compute half of the TPU-first
+build: fused attention for the notebook/serving/training recipes, used by
+``kubeflow_tpu.models`` and composed with the ring in
+``kubeflow_tpu.parallel.ring_attention``.
+"""
+
+from kubeflow_tpu.ops.flash_attention import auto_attention, flash_attention
+
+__all__ = ["auto_attention", "flash_attention"]
