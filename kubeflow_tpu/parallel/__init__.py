@@ -4,8 +4,9 @@ The reference delegates all distribution to workload pods (SURVEY.md §2.10 —
 no in-tree DP/TP/PP/SP code; CUDA images imply NCCL). Here the workload side
 is first-class: a canonical mesh axis vocabulary shared by every model and by
 the control plane's topology math (``kubeflow_tpu.tpu.topology``), sharding
-via ``jax.sharding`` + XLA collectives over ICI/DCN, and ring attention for
-sequence parallelism.
+via ``jax.sharding`` + XLA collectives over ICI/DCN, ring attention for
+sequence parallelism, microbatch-streaming pipeline parallelism, and
+expert-parallel MoE.
 """
 
 from kubeflow_tpu.parallel.mesh import (  # noqa: F401
@@ -13,6 +14,7 @@ from kubeflow_tpu.parallel.mesh import (  # noqa: F401
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
+    AXIS_PIPE,
     AXIS_SEQ,
     MeshConfig,
     batch_sharding,
@@ -23,4 +25,10 @@ from kubeflow_tpu.parallel.sharding import (  # noqa: F401
     LogicalRules,
     logical_sharding,
     shard_pytree,
+)
+from kubeflow_tpu.parallel.moe import MoEMlp, top_k_routing  # noqa: F401
+from kubeflow_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+    stage_param_spec,
 )

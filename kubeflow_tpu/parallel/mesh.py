@@ -8,7 +8,9 @@ One mesh axis vocabulary is used across the framework:
   (ZeRO-3 style; params all-gathered per layer, grads reduce-scattered),
 - ``model``  — tensor parallelism (activations/weights split over ICI),
 - ``seq``    — sequence/context parallelism (ring attention),
-- ``expert`` — expert parallelism for MoE layers.
+- ``pipe``   — pipeline parallelism (stage-partitioned layers, microbatch
+  streaming via ``ppermute`` — parallel/pipeline.py),
+- ``expert`` — expert parallelism for MoE layers (parallel/moe.py).
 
 The reference control plane never builds meshes (SURVEY.md §2.10 — pod-level
 delegation only); this module is the in-workload half the reference left to
@@ -31,11 +33,12 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
+AXIS_PIPE = "pipe"
 AXIS_EXPERT = "expert"
 
 #: Order matters: outermost (slowest-varying, DCN-friendly) first; the
 #: innermost axes land on physically adjacent chips for cheap collectives.
-CANONICAL_AXES: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+CANONICAL_AXES: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
 
 #: Axes over which a batch is split (each holds a distinct slice of examples).
 BATCH_AXES: Tuple[str, ...] = (AXIS_DATA, AXIS_FSDP)
@@ -52,6 +55,7 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     expert: int = 1
     seq: int = 1
     model: int = 1
@@ -60,6 +64,7 @@ class MeshConfig:
         raw = {
             AXIS_DATA: self.data,
             AXIS_FSDP: self.fsdp,
+            AXIS_PIPE: self.pipe,
             AXIS_EXPERT: self.expert,
             AXIS_SEQ: self.seq,
             AXIS_MODEL: self.model,

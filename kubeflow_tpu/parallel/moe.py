@@ -1,0 +1,126 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Switch/top-k routing in the TPU-native style: dense dispatch/combine
+einsums with *static* capacity (no dynamic shapes under jit — XLA tiles
+them straight onto the MXU), expert FFN weights stacked [E, ...] and
+sharded over the ``expert`` axis, expert inputs sharding-constrained to the
+same axis so XLA inserts the all-to-all between data and expert layouts.
+Load-balance auxiliary loss follows the Switch Transformer formulation.
+
+The reference has no MoE/parallelism code at all (SURVEY.md §2.10); this
+module is part of the in-workload compute path of the TPU-native build.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_EXPERT, BATCH_AXES
+
+
+def _constrain(x: jax.Array, mesh: Optional[Mesh], spec: P) -> jax.Array:
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def top_k_routing(
+    router_logits: jax.Array, num_experts: int, capacity: int, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Position-based top-k token->expert assignment with static capacity.
+
+    router_logits: [tokens, E]. Returns (dispatch [tokens, E, C] one-hot,
+    combine [tokens, E, C] gate-weighted, aux_loss scalar). Tokens beyond an
+    expert's capacity are dropped (their combine weights are zero), the
+    standard Switch behavior; earlier positions win, matching the
+    sequential-priority formulation.
+    """
+    tokens = router_logits.shape[0]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [tokens, k]
+    # expert_mask[t, j, e] — token t's j-th choice is expert e.
+    expert_mask = jax.nn.one_hot(gate_idx, num_experts, dtype=jnp.float32)
+
+    # Position of each token in its chosen expert's queue, counting all
+    # higher-priority (choice-major, then position) assignments.
+    flat_mask = expert_mask.transpose(1, 0, 2).reshape(k * tokens, num_experts)
+    pos_in_expert = jnp.cumsum(flat_mask, axis=0) - flat_mask  # [k*tokens, E]
+    pos = (pos_in_expert * flat_mask).sum(-1).reshape(k, tokens).T  # [tokens, k]
+    keep = (pos < capacity) & (gate_vals > 0)
+
+    # aux loss: mean fraction of tokens routed to e * mean router prob for e
+    # (computed over first choices, Switch eq. 4), scaled by E.
+    first_choice = expert_mask[:, 0, :]
+    density = first_choice.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux_loss = num_experts * jnp.sum(density * density_proxy)
+
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity, dtype=jnp.float32)
+    # dispatch[t, e, c] = token t occupies slot c of expert e.
+    dispatch = jnp.einsum("tke,tkc->tec", expert_mask, pos_oh)
+    combine = jnp.einsum("tke,tkc,tk->tec", expert_mask, pos_oh, gate_vals.astype(jnp.float32))
+    return dispatch, combine, aux_loss
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel FFN block: route -> all-to-all -> expert MLP -> return.
+
+    Drop-in for a dense transformer MLP ([..., d_model] -> [..., d_model]).
+    Stacked expert kernels are named ``experts_wi``/``experts_wo`` so the
+    sharding heuristic (parallel/sharding.py ``expert`` rule) places their
+    leading dim on the ``expert`` mesh axis. Pass ``mesh`` to add activation
+    sharding constraints; aux loss is sown under ``("losses", "moe_aux")``.
+    """
+
+    num_experts: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    mesh: Optional[Mesh] = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig_shape = x.shape
+        d_model = x.shape[-1]
+        x2 = x.reshape(-1, d_model)
+        tokens = x2.shape[0]
+        capacity = max(1, int(self.capacity_factor * self.k * tokens / self.num_experts))
+
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d_model, self.num_experts), jnp.float32
+        )
+        logits = x2.astype(jnp.float32) @ router
+        dispatch, combine, aux = top_k_routing(logits, self.num_experts, capacity, self.k)
+        self.sow("losses", "moe_aux", aux)
+
+        wi = self.param(
+            "experts_wi",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.num_experts, d_model, self.d_ff),
+            jnp.float32,
+        )
+        wo = self.param(
+            "experts_wo",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (self.num_experts, self.d_ff, d_model),
+            jnp.float32,
+        )
+
+        # [tokens, d] -> [E, C, d]: XLA lowers this resharding to all-to-all
+        # when tokens are batch-sharded and expert tensors expert-sharded.
+        expert_in = jnp.einsum("td,tec->ecd", x2.astype(self.dtype), dispatch.astype(self.dtype))
+        expert_in = _constrain(expert_in, self.mesh, P(AXIS_EXPERT, None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+        out = _constrain(out, self.mesh, P(AXIS_EXPERT, None, None))
+        y = jnp.einsum("ecd,tec->td", out, combine.astype(self.dtype))
+        y = _constrain(y.reshape(orig_shape), self.mesh, P(BATCH_AXES, *([None] * (len(orig_shape) - 1))))
+        return y.astype(x.dtype)

@@ -1,0 +1,129 @@
+"""Pipeline parallelism: stage-partitioned layers, microbatch streaming.
+
+GPipe-style schedule expressed the TPU way: every pipeline stage is the
+*same* SPMD program under ``shard_map`` over the ``pipe`` mesh axis; stage
+weights live stacked with the stage dimension sharded over that axis, and
+activations hop stage->stage+1 once per step via ``lax.ppermute`` (one ICI
+hop). Autodiff through the forward schedule yields the reverse-order
+backward schedule automatically — ``ppermute`` differentiates into the
+inverse permutation — so there is no hand-written backward pipeline.
+
+With M microbatches and S stages the loop runs M+S-1 steps; bubble fraction
+(S-1)/(M+S-1) shrinks as M grows. Per-device parameter memory is 1/S of the
+stacked stack, the usual reason to pick ``pipe`` over pure fsdp when layers
+are deep and ICI hops are cheap.
+
+The reference control plane has no in-tree parallelism (SURVEY.md §2.10);
+this is part of the in-workload half of the TPU-native build.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from kubeflow_tpu.parallel.mesh import AXIS_PIPE
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack S per-stage pytrees into one pytree with a leading stage dim.
+
+    The result is what :func:`pipeline_apply` consumes; shard its leading
+    dim over the ``pipe`` mesh axis (``stage_param_spec``).
+    """
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def stage_param_spec(leaf: jax.Array) -> P:
+    """PartitionSpec for stacked stage params: stage dim over ``pipe``."""
+    return P(AXIS_PIPE, *([None] * (leaf.ndim - 1)))
+
+
+def _local_pipeline(
+    params: Any,
+    x: jax.Array,
+    *,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str,
+) -> jax.Array:
+    """Per-device body. params: stage-local (leading dim 1); x: [M, mb, ...]."""
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+    params = jax.tree_util.tree_map(lambda p: p[0], params)
+    num_micro = x.shape[0]
+    total_steps = num_micro + n_stages - 1
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def step(carry, t):
+        recv, out = carry
+        # Stage 0 reads microbatch t from the input stream (clamped index —
+        # past-M reads feed bubble steps whose results are discarded);
+        # later stages consume what the previous stage sent last step.
+        x_t = lax.dynamic_index_in_dim(x, jnp.clip(t, 0, num_micro - 1), keepdims=False)
+        inp = jnp.where(is_first, x_t, recv)
+        y = stage_fn(params, inp)
+        # Last stage banks microbatch t-(S-1) once the pipeline is full.
+        out_idx = jnp.clip(t - (n_stages - 1), 0, num_micro - 1)
+        bank = jnp.logical_and(is_last, t >= n_stages - 1)
+        cur = lax.dynamic_index_in_dim(out, out_idx, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(bank, y, cur), out_idx, axis=0
+        )
+        recv = lax.ppermute(y, axis_name, fwd_perm)
+        return (recv, out), None
+
+    probe = jax.eval_shape(stage_fn, params, x[0])
+    out0 = jnp.zeros(x.shape[:1] + probe.shape, probe.dtype)
+    recv0 = jnp.zeros(probe.shape, probe.dtype)
+    (_, out), _ = lax.scan(step, (recv0, out0), jnp.arange(total_steps))
+    # Results live on the last stage only; psum broadcasts them (every other
+    # stage contributes zeros) so the caller sees a replicated [M, mb, ...].
+    return lax.psum(jnp.where(is_last, out, jnp.zeros_like(out)), axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPE,
+) -> jax.Array:
+    """Run x through S pipelined stages of ``stage_fn`` over ``mesh``.
+
+    - ``stage_fn(params_i, h) -> h'`` — one stage; output shape/dtype must
+      equal input (homogeneous inter-stage activations, the GPipe contract).
+    - ``stage_params`` — pytree with leading stage dim S (see
+      :func:`stack_stage_params`), sharded over ``axis_name``.
+    - ``x`` — [num_microbatches, microbatch, ...] input stream, replicated
+      over ``axis_name`` (batch axes may shard its microbatch dim).
+
+    Returns [num_microbatches, microbatch, ...] outputs, replicated over the
+    pipe axis. Differentiable end-to-end.
+    """
+    if mesh.shape[axis_name] > x.shape[0]:
+        raise ValueError(
+            f"need at least as many microbatches as stages: "
+            f"{x.shape[0]} microbatches < {mesh.shape[axis_name]} stages"
+        )
+    param_specs = jax.tree_util.tree_map(stage_param_spec, stage_params)
+    fn = shard_map(
+        functools.partial(_local_pipeline, stage_fn=stage_fn, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return fn(stage_params, x)
