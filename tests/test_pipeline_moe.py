@@ -119,7 +119,7 @@ class TestMoELayer:
         mesh = make_mesh(MeshConfig(data=2, expert=4))
         m = MoEMlp(num_experts=4, d_ff=32, k=2, mesh=mesh, dtype=jnp.float32)
         x = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 16))
-        variables = m.init(jax.random.PRNGKey(3), x)
+        variables = {"params": m.init(jax.random.PRNGKey(3), x)["params"]}
 
         def loss(v):
             y, state = m.apply(v, x, mutable=["losses"])
