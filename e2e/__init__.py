@@ -1,0 +1,26 @@
+"""End-to-end test harness — the analog of the reference's ``testing/`` tree.
+
+The reference drives e2e against a live CI cluster (SURVEY.md §4 tier 4):
+Katib StudyJob runs (testing/katib_studyjob_test.py), TF Serving predict
+checks (testing/test_tf_serving.py), a Selenium spawner flow
+(testing/test_jwa.py), with deploy/wait/retry utilities and junit XML
+results shipped to gubernator (test_tf_serving.py:139-143).
+
+Here the "cluster" is the in-process platform (kubeflow_tpu.platform) plus
+fake TPU nodes, so the same flows run hermetically on CPU; against a real
+deployment the drivers work unchanged by pointing their base URLs at live
+services. Each driver module has a ``main()`` and writes junit XML.
+"""
+
+from .cluster import E2ECluster, unique_namespace, wait_for_condition
+from .junit import TestCaseResult, write_junit
+from .retry import run_with_retry
+
+__all__ = [
+    "E2ECluster",
+    "TestCaseResult",
+    "run_with_retry",
+    "unique_namespace",
+    "wait_for_condition",
+    "write_junit",
+]

@@ -1,0 +1,171 @@
+"""In-process e2e cluster: platform + fake TPU node pool + HTTP services.
+
+The deploy/wait utility layer of the harness (the analog of
+testing/deploy_utils.py:25-80 namespace-per-run fixtures,
+testing/wait_for_deployment.py, and testing/gcp_util.py readiness polls).
+Everything runs over real localhost HTTP so the drivers exercise the same
+surfaces a browser or CI job would.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.controllers.builtin import make_tpu_node
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.runtime.manager import Reconciler
+from kubeflow_tpu.services.jupyter import make_jupyter_app
+from kubeflow_tpu.services.kfam import make_kfam_app
+from kubeflow_tpu.web.auth import AuthConfig
+
+#: default fake node pool: one v5e 2x4 slice (8 chips = 2 hosts x 4 chips)
+#: plus a spare single-host 2x2 — enough for multi-host spawn + an HPO trial.
+DEFAULT_NODES: List[Tuple[str, str, int, int]] = [
+    # (generation, topology label, chips per node, node count)
+    ("v5e", "2x4", 4, 2),
+    ("v5e", "2x2", 4, 1),
+]
+
+
+def unique_namespace(prefix: str = "e2e") -> str:
+    """Namespace-per-run isolation (deploy_utils.py:25-43 pattern)."""
+    return f"{prefix}-{uuid.uuid4().hex[:8]}"
+
+
+def wait_for_condition(
+    fn: Callable[[], Any],
+    timeout: float = 30.0,
+    interval: float = 0.1,
+    desc: str = "condition",
+) -> Any:
+    """Poll fn() until it returns truthy — the katib e2e wait loop
+    (testing/katib_studyjob_test.py:128-193: poll CR status under a
+    deadline, raise on timeout). Returns fn()'s final value."""
+    deadline = time.monotonic() + timeout
+    last: Any = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    raise TimeoutError(f"timed out after {timeout}s waiting for {desc} (last={last!r})")
+
+
+def http_json(
+    method: str,
+    url: str,
+    body: Optional[Dict[str, Any]] = None,
+    headers: Optional[Dict[str, str]] = None,
+) -> Dict[str, Any]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("content-type", "application/json")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        payload = resp.read()
+    return json.loads(payload) if payload else {}
+
+
+def csrf_headers(base_url: str, identity: Dict[str, str]) -> Dict[str, str]:
+    """Fetch the double-submit CSRF cookie the way a browser would
+    (crud_backend csrf.py: cookie issued on GET, echoed in X-XSRF-TOKEN)."""
+    req = urllib.request.Request(base_url + "/api/config")
+    for k, v in identity.items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        cookies = resp.headers.get_all("Set-Cookie") or []
+    token = ""
+    for c in cookies:
+        if c.startswith("XSRF-TOKEN="):
+            token = c.split(";", 1)[0].split("=", 1)[1]
+    if not token:
+        raise RuntimeError(f"no XSRF-TOKEN cookie from {base_url}/api/config")
+    return {**identity, "cookie": f"XSRF-TOKEN={token}", "x-xsrf-token": token}
+
+
+class E2ECluster:
+    """One hermetic 'cluster': control plane + fake TPU nodes + web services.
+
+    Usage:
+        with E2ECluster() as cluster:
+            ns = cluster.create_profile("alice@example.com")
+            ...
+    """
+
+    def __init__(
+        self,
+        nodes: Optional[List[Tuple[str, str, int, int]]] = None,
+        trial_runner: Optional[Reconciler] = None,
+        cluster_admins: Tuple[str, ...] = ("admin@example.com",),
+    ):
+        self.mgr = build_platform(trial_runner=trial_runner)
+        self.client = self.mgr.client
+        self.auth = AuthConfig(cluster_admins=list(cluster_admins))
+        self._servers: List[Any] = []
+        node_specs = DEFAULT_NODES if nodes is None else nodes
+        for generation, topo, chips, count in node_specs:
+            for i in range(count):
+                self.client.create(
+                    make_tpu_node(f"tpu-{generation}-{topo}-{i}", generation, topo, chips)
+                )
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "E2ECluster":
+        self.mgr.start()
+        return self
+
+    def stop(self) -> None:
+        for server in self._servers:
+            server.close()
+        self._servers.clear()
+        self.mgr.stop()
+
+    def __enter__(self) -> "E2ECluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- services ------------------------------------------------------------
+    def serve_jupyter(self) -> str:
+        server = make_jupyter_app(self.client, auth=self.auth).serve(0)
+        self._servers.append(server)
+        return f"http://127.0.0.1:{server.port}"
+
+    def serve_kfam(self) -> str:
+        server = make_kfam_app(self.client, auth=self.auth).serve(0)
+        self._servers.append(server)
+        return f"http://127.0.0.1:{server.port}"
+
+    # -- fixtures ------------------------------------------------------------
+    def create_profile(self, owner: str, name: Optional[str] = None, timeout: float = 30.0) -> str:
+        """Create a Profile CR and wait until its namespace + RBAC exist —
+        the per-run fixture the reference builds with deploy_utils +
+        profiles_test assertions (py/kubeflow/kubeflow/ci/profiles_test.py)."""
+        ns = name or unique_namespace()
+        self.client.create(
+            {
+                "apiVersion": "kubeflow.org/v1",
+                "kind": "Profile",
+                "metadata": {"name": ns},
+                "spec": {"owner": {"kind": "User", "name": owner}},
+            }
+        )
+        wait_for_condition(
+            lambda: self.client.get_opt("v1", "Namespace", ns) is not None
+            and any(
+                (rb.get("roleRef") or {}).get("name") == "kubeflow-admin"
+                for rb in self.client.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns)
+            ),
+            timeout=timeout,
+            desc=f"profile namespace {ns} ready",
+        )
+        return ns
+
+    def wait_idle(self, timeout: float = 30.0) -> None:
+        self.mgr.wait_idle(timeout=timeout)

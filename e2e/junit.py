@@ -1,0 +1,74 @@
+"""junit XML results — the reference ships these to gubernator
+(testing/test_tf_serving.py:139-143 builds TestCase objects and calls
+test_util.create_junit_xml_file)."""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+from xml.sax.saxutils import escape, quoteattr
+
+
+@dataclass
+class TestCaseResult:
+    __test__ = False  # not a pytest collectable
+
+    class_name: str
+    name: str
+    time_seconds: float = 0.0
+    failure: Optional[str] = None  # failure text, None = pass
+
+    @property
+    def passed(self) -> bool:
+        return self.failure is None
+
+
+@dataclass
+class TestSuite:
+    __test__ = False  # not a pytest collectable
+
+    name: str
+    cases: List[TestCaseResult] = field(default_factory=list)
+
+    def run(self, class_name: str, name: str, fn) -> TestCaseResult:
+        """Execute fn() as one junit case, recording time and failure."""
+        t0 = time.perf_counter()
+        failure = None
+        try:
+            fn()
+        except Exception as e:  # record, don't raise — suites report all cases
+            failure = f"{type(e).__name__}: {e}"
+        case = TestCaseResult(class_name, name, time.perf_counter() - t0, failure)
+        self.cases.append(case)
+        return case
+
+    @property
+    def passed(self) -> bool:
+        return all(c.passed for c in self.cases)
+
+
+def junit_xml(suite: TestSuite) -> str:
+    lines = [
+        '<?xml version="1.0" encoding="utf-8"?>',
+        f"<testsuite name={quoteattr(suite.name)} tests=\"{len(suite.cases)}\" "
+        f"failures=\"{sum(1 for c in suite.cases if not c.passed)}\">",
+    ]
+    for c in suite.cases:
+        open_tag = (
+            f"  <testcase classname={quoteattr(c.class_name)} "
+            f"name={quoteattr(c.name)} time=\"{c.time_seconds:.3f}\""
+        )
+        if c.passed:
+            lines.append(open_tag + "/>")
+        else:
+            lines.append(open_tag + ">")
+            lines.append(f"    <failure>{escape(c.failure or '')}</failure>")
+            lines.append("  </testcase>")
+    lines.append("</testsuite>")
+    return "\n".join(lines) + "\n"
+
+
+def write_junit(suite: TestSuite, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(junit_xml(suite))

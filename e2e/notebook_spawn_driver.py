@@ -1,0 +1,166 @@
+"""Notebook spawn e2e driver — the HTTP-level analog of testing/test_jwa.py.
+
+The reference drives the Jupyter web app through Selenium (test_jwa.py +
+auth.py: log in, click spawn, wait for the notebook). This driver exercises
+the same product flow over the real HTTP API, end to end through every
+layer the platform owns (SURVEY.md §3.1 call stack):
+
+  spawner POST (CSRF + identity headers)
+    → Notebook CR → notebook-controller → StatefulSet(hosts) + Services
+    → PodDefault webhook injects google.com/tpu limits + JAX env
+    → fake scheduler binds pods to TPU nodes → Running
+  then stop (annotation → replicas 0), restart, delete (GC cascade).
+
+Run standalone:  python -m e2e.notebook_spawn_driver
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any, Dict
+
+from kubeflow_tpu.tpu.env import (
+    ENV_COORDINATOR_ADDRESS,
+    ENV_NUM_PROCESSES,
+    ENV_WORKER_HOSTNAMES,
+    env_list_to_dict,
+)
+from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+
+from .cluster import E2ECluster, csrf_headers, http_json, unique_namespace, wait_for_condition
+from .junit import TestSuite, write_junit
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+OWNER = "spawn-e2e@example.com"
+IDENTITY = {"kubeflow-userid": OWNER}
+
+
+def tpu_poddefault(ns: str, name: str, generation: str, topology: str) -> Dict[str, Any]:
+    """The per-namespace TPU configuration an admin publishes; the spawner's
+    ``configurations`` field selects it by label (SURVEY.md §7 step 2)."""
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "desc": f"TPU {generation} {topology} slice",
+            "selector": {"matchLabels": {name: "true"}},
+            "tpu": {"generation": generation, "topology": topology},
+        },
+    }
+
+
+def run_notebook_spawn_e2e(timeout: float = 60.0) -> Dict[str, Any]:
+    with E2ECluster() as cluster:
+        ns = cluster.create_profile(OWNER, unique_namespace("spawn"))
+        config_name = "tpu-v5e-2x4"
+        cluster.client.create(tpu_poddefault(ns, config_name, "v5e", "2x4"))
+
+        base = cluster.serve_jupyter()
+        headers = csrf_headers(base, IDENTITY)
+
+        # Discovery: the spawner sees the fake node pool's generations and
+        # topologies (the reference's /api/gpus vendor discovery, get.py:50-71).
+        tpus = http_json("GET", f"{base}/api/tpus", headers=IDENTITY)["tpus"]
+        v5e = next(t for t in tpus if t["generation"] == "v5e")
+        assert "2x4" in v5e["topologies"], v5e
+        pds = http_json("GET", f"{base}/api/namespaces/{ns}/poddefaults", headers=IDENTITY)
+        assert any(pd["name"] == config_name for pd in pds["poddefaults"]), pds
+
+        # Spawn: TPU topology + the PodDefault configuration label.
+        http_json(
+            "POST",
+            f"{base}/api/namespaces/{ns}/notebooks",
+            {
+                "name": "nb-e2e",
+                "tpus": {"generation": "v5e", "topology": "2x4"},
+                "configurations": [config_name],
+            },
+            headers,
+        )
+
+        def notebook_phase() -> str:
+            nbs = http_json("GET", f"{base}/api/namespaces/{ns}/notebooks", headers=IDENTITY)
+            for nb in nbs.get("notebooks", []):
+                if nb["name"] == "nb-e2e":
+                    return nb["status"]["phase"]
+            return ""
+
+        wait_for_condition(lambda: notebook_phase() == "ready", timeout, desc="notebook ready")
+
+        # One pod per slice host, each with chips + deterministic JAX env.
+        pods = [
+            p
+            for p in cluster.client.list("v1", "Pod", ns)
+            if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
+        ]
+        assert len(pods) == 2, f"2x4 v5e slice = 2 hosts, got {len(pods)} pods"
+        hostnames = set()
+        for pod in pods:
+            container = pod["spec"]["containers"][0]
+            assert container["resources"]["limits"][RESOURCE_TPU] == "4", container
+            # Injected env is identical on every host (webhook determinism);
+            # worker ids derive from the StatefulSet ordinal at runtime.
+            env = env_list_to_dict(container["env"])
+            assert env[ENV_COORDINATOR_ADDRESS].startswith("nb-e2e-0.nb-e2e."), env
+            assert env[ENV_NUM_PROCESSES] == "2", env
+            assert len(env[ENV_WORKER_HOSTNAMES].split(",")) == 2, env
+            hostnames.add(pod["spec"].get("hostname", ""))
+            assert pod["spec"].get("nodeName", "").startswith("tpu-v5e-2x4-"), pod["spec"]
+        assert hostnames == {"nb-e2e-0", "nb-e2e-1"}, hostnames
+
+        # Stop: annotation scales the whole slice to zero (culler.go:37 path).
+        http_json(
+            "PATCH", f"{base}/api/namespaces/{ns}/notebooks/nb-e2e", {"stopped": True}, headers
+        )
+        wait_for_condition(lambda: notebook_phase() == "stopped", timeout, desc="notebook stopped")
+        wait_for_condition(
+            lambda: not [
+                p
+                for p in cluster.client.list("v1", "Pod", ns)
+                if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
+            ],
+            timeout,
+            desc="slice released",
+        )
+
+        # Restart: chips reacquired, back to ready.
+        http_json(
+            "PATCH", f"{base}/api/namespaces/{ns}/notebooks/nb-e2e", {"stopped": False}, headers
+        )
+        wait_for_condition(lambda: notebook_phase() == "ready", timeout, desc="notebook restarted")
+
+        # Delete: CR gone and children garbage-collected.
+        http_json("DELETE", f"{base}/api/namespaces/{ns}/notebooks/nb-e2e", headers=headers)
+        wait_for_condition(lambda: notebook_phase() == "", timeout, desc="notebook deleted")
+        wait_for_condition(
+            lambda: not cluster.client.list("apps/v1", "StatefulSet", ns)
+            and not [
+                p
+                for p in cluster.client.list("v1", "Pod", ns)
+                if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
+            ],
+            timeout,
+            desc="children garbage-collected",
+        )
+        return {"namespace": ns, "hosts": 2}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--junit", default="junit_notebook_spawn.xml")
+    args = parser.parse_args(argv)
+
+    suite = TestSuite("e2e-notebook-spawn")
+    case = suite.run(
+        "NotebookSpawnE2E", "spawn-stop-restart-delete", lambda: run_notebook_spawn_e2e(args.timeout)
+    )
+    write_junit(suite, args.junit)
+    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
+    return 0 if suite.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

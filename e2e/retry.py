@@ -1,0 +1,31 @@
+"""Retry helper — the analog of testing/run_with_retry.py:1-30 and the
+request retry loop in testing/test_tf_serving.py:108-127 (10 attempts,
+fixed sleep, last error re-raised)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+log = logging.getLogger("kubeflow_tpu.e2e")
+
+T = TypeVar("T")
+
+
+def run_with_retry(
+    fn: Callable[[], T],
+    retries: int = 10,
+    delay: float = 0.5,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+) -> T:
+    last: BaseException = RuntimeError("run_with_retry: zero attempts")
+    for attempt in range(retries):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            log.debug("attempt %d/%d failed: %s", attempt + 1, retries, e)
+            if attempt < retries - 1:
+                time.sleep(delay)
+    raise last

@@ -1,0 +1,93 @@
+"""Serving e2e driver — the analog of testing/test_tf_serving.py.
+
+The reference POSTs ``/v1/models/mnist:predict`` at a Service IP, retrying
+up to 10 times, and compares predictions against a golden JSON within 1e-3
+(test_tf_serving.py:40-57,108-133). Here the served model is the JAX BERT
+MLM server (the BASELINE serving config); the golden values come from a
+direct in-process apply of the same params, so the check validates the
+whole HTTP + batching + padding + jit path bit-for-bit-ish (±1e-3, same
+tolerance the reference uses for float comparisons).
+
+Run standalone:  python -m e2e.serving_driver
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.error
+from typing import Any, Dict, List
+
+import numpy as np
+
+from kubeflow_tpu.serving.server import ModelServer, bert_served_model
+
+from .cluster import http_json
+from .junit import TestSuite, write_junit
+from .retry import run_with_retry
+
+TOLERANCE = 1e-3  # test_tf_serving.py:40-57 almost_equal tolerance
+
+
+def almost_equal(a: Any, b: Any, tol: float = TOLERANCE) -> bool:
+    return bool(np.allclose(np.asarray(a), np.asarray(b), atol=tol))
+
+
+def run_serving_e2e(retries: int = 10) -> Dict[str, Any]:
+    model = bert_served_model("bert", tiny=True)
+    server = ModelServer().add(model)
+    http = server.serve(0)
+    base = f"http://127.0.0.1:{http.port}"
+    try:
+        # Golden predictions: direct apply, bypassing HTTP (the reference's
+        # result.json analog, computed instead of checked in).
+        rng = np.random.default_rng(0)
+        instances: List[List[int]] = rng.integers(0, 1000, size=(3, 16)).tolist()
+        expected = model.predict(instances)
+
+        # Model status endpoint (GET /v1/models/<name>).
+        status = run_with_retry(
+            lambda: http_json("GET", f"{base}/v1/models/bert"),
+            retries=retries,
+            retry_on=(urllib.error.URLError, ConnectionError),
+        )
+        assert status["model_version_status"][0]["state"] == "AVAILABLE", status
+
+        # Predict with retries (test_tf_serving.py:108-127).
+        resp = run_with_retry(
+            lambda: http_json("POST", f"{base}/v1/models/bert:predict", {"instances": instances}),
+            retries=retries,
+            retry_on=(urllib.error.URLError, ConnectionError),
+        )
+        predictions = resp["predictions"]
+        assert len(predictions) == len(instances), (len(predictions), len(instances))
+        assert almost_equal(predictions, expected), "served predictions diverge from direct apply"
+
+        # Ragged batch: a second request at a different size must agree
+        # (exercises the bucket-padding path). Different batch buckets are
+        # separate XLA compilations; on TPU their bf16 MXU tilings differ
+        # legitimately, so this cross-shape check uses a relative tolerance
+        # (the strict 1e-3 above compares same-shape, same-executable runs).
+        resp1 = http_json("POST", f"{base}/v1/models/bert:predict", {"instances": instances[:1]})
+        assert np.allclose(
+            np.asarray(resp1["predictions"][0]), np.asarray(expected[0]), rtol=5e-2, atol=5e-2
+        ), "padding changed predictions beyond accelerator numerics"
+        return {"predictions": len(predictions), "model": "bert"}
+    finally:
+        http.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--junit", default="junit_serving.xml")
+    args = parser.parse_args(argv)
+
+    suite = TestSuite("e2e-serving")
+    case = suite.run("ServingE2E", "bert-predict", run_serving_e2e)
+    write_junit(suite, args.junit)
+    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
+    return 0 if suite.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

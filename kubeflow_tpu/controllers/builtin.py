@@ -176,7 +176,10 @@ class PodletReconciler(Reconciler):
 
     def reconcile(self, client: Client, req: Request) -> Result:
         pod = client.get_opt("v1", "Pod", req.name, req.namespace)
-        if pod is None or pod.get("status", {}).get("phase") == "Running":
+        # Running is steady-state; Succeeded/Failed are terminal — a kubelet
+        # never restarts a completed restartPolicy=Never pod (trial pods
+        # signal completion exactly this way).
+        if pod is None or pod.get("status", {}).get("phase") in ("Running", "Succeeded", "Failed"):
             return Result()
         nodes = client.list("v1", "Node")
         node_name = None
@@ -240,6 +243,10 @@ class PodletReconciler(Reconciler):
         total = 0
         for p in client.list("v1", "Pod"):
             if p.get("spec", {}).get("nodeName") != node_name or apimeta.uid_of(p) == exclude:
+                continue
+            # Terminal pods release their chips (kube-scheduler likewise
+            # excludes Succeeded/Failed pods from resource accounting).
+            if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
                 continue
             for c in p.get("spec", {}).get("containers", []):
                 limits = (c.get("resources") or {}).get("limits") or {}

@@ -1,0 +1,61 @@
+"""e2e harness suite: runs each driver in-process (SURVEY.md §4 tier 4,
+made hermetic — the reference runs these against a live CI cluster)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.junit import TestCaseResult, TestSuite, junit_xml  # noqa: E402
+from e2e.notebook_spawn_driver import run_notebook_spawn_e2e  # noqa: E402
+from e2e.retry import run_with_retry  # noqa: E402
+from e2e.serving_driver import run_serving_e2e  # noqa: E402
+from e2e.studyjob_driver import run_studyjob_e2e  # noqa: E402
+
+
+class TestDrivers:
+    def test_studyjob_e2e(self):
+        status = run_studyjob_e2e(objective="quadratic", max_trials=6, parallel=2)
+        assert status["phase"] == "Completed"
+        assert 0 < status["currentOptimalTrial"]["observation"]["accuracy"] <= 1.0
+
+    def test_serving_e2e(self):
+        result = run_serving_e2e()
+        assert result["predictions"] == 3
+
+    def test_notebook_spawn_e2e(self):
+        result = run_notebook_spawn_e2e()
+        assert result["hosts"] == 2
+
+
+class TestHarnessUtilities:
+    def test_junit_xml_shape(self):
+        suite = TestSuite("s")
+        suite.run("C", "ok", lambda: None)
+        suite.run("C", "boom", lambda: (_ for _ in ()).throw(RuntimeError("x & y")))
+        xml = junit_xml(suite)
+        assert 'tests="2"' in xml and 'failures="1"' in xml
+        assert "x &amp; y" in xml  # escaping
+        assert not suite.passed
+        assert isinstance(suite.cases[0], TestCaseResult)
+
+    def test_run_with_retry_eventually_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("not yet")
+            return "ok"
+
+        assert run_with_retry(flaky, retries=5, delay=0.0) == "ok"
+        assert len(calls) == 3
+
+    def test_run_with_retry_exhausts(self):
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            run_with_retry(always, retries=3, delay=0.0)

@@ -151,6 +151,45 @@ def test_tpu_pod_unschedulable_without_nodes_stays_pending(platform):
     assert pod["status"]["conditions"][0]["reason"] == "Unschedulable"
 
 
+def test_terminal_pods_release_tpu_capacity(platform):
+    """A Succeeded pod frees its chips for the next workload (HPO trials
+    complete-then-schedule on the same node pool); kube-scheduler likewise
+    excludes terminal pods from resource accounting."""
+    platform.client.create(make_tpu_node("tpu-node-0", "v5e", "2x2", 4))
+
+    def tpu_pod(name):
+        return new_object(
+            "v1", "Pod", name, "team-a",
+            spec={
+                "containers": [
+                    {"name": "trial", "resources": {"limits": {"google.com/tpu": 4}}}
+                ],
+                "restartPolicy": "Never",
+            },
+        )
+
+    platform.client.create(tpu_pod("trial-a"))
+    assert platform.wait_idle()
+    pod_a = platform.client.get("v1", "Pod", "trial-a", "team-a")
+    assert pod_a["status"]["phase"] == "Running"
+    pod_a["status"]["phase"] = "Succeeded"
+    platform.client.update_status(pod_a)
+    assert platform.wait_idle()
+    # Terminal phase sticks (podlet must not resurrect completed pods)...
+    assert platform.client.get("v1", "Pod", "trial-a", "team-a")["status"]["phase"] == "Succeeded"
+
+    # ...and its chips are schedulable again.
+    platform.client.create(tpu_pod("trial-b"))
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pod_b = platform.client.get("v1", "Pod", "trial-b", "team-a")
+        if pod_b.get("status", {}).get("phase") == "Running":
+            break
+        time.sleep(0.05)
+    assert pod_b["status"]["phase"] == "Running", pod_b.get("status")
+    assert pod_b["spec"]["nodeName"] == "tpu-node-0"
+
+
 def test_stop_annotation_scales_to_zero_and_restart(platform):
     platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
     assert platform.wait_idle()
