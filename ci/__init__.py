@@ -1,0 +1,18 @@
+"""CI/CD workflow builders — the analog of ``py/kubeflow/kubeflow/{ci,cd}``.
+
+The reference builds Argo Workflow specs programmatically per component
+(workflow_utils.py:30-120 ``ArgoTestBuilder``: shared NFS volume, an e2e DAG
+plus an exit-handler DAG, kaniko image-build tasks, per-language lint/test
+tasks; see ci/jwa_tests.py:13-59 for a complete instance), and Prow triggers
+them from ``prow_config.yaml``.
+
+Here the same model: ``argo.py`` is the workflow object model + validation,
+``workflow_utils.py`` the builder, ``workflows.py`` the per-component
+definitions, ``prow_config.yaml`` the trigger map. Specs are plain dicts in
+Argo wire shape so a real Argo can run them unmodified.
+"""
+
+from .argo import DagTask, Workflow, WorkflowValidationError
+from .workflow_utils import WorkflowBuilder
+
+__all__ = ["DagTask", "Workflow", "WorkflowBuilder", "WorkflowValidationError"]

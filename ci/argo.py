@@ -1,0 +1,120 @@
+"""Argo Workflow object model: DAG templates, tasks, validation.
+
+Wire-shape compatible with argoproj.io/v1alpha1 Workflow (the reference
+emits these dicts from ArgoTestBuilder and applies them with ksonnet/kubectl;
+the e2e harness here validates them statically instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class WorkflowValidationError(Exception):
+    pass
+
+
+@dataclass
+class DagTask:
+    name: str
+    template: str
+    dependencies: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "template": self.template}
+        if self.dependencies:
+            d["dependencies"] = list(self.dependencies)
+        return d
+
+
+@dataclass
+class Workflow:
+    name: str
+    entrypoint: str = "e2e"
+    on_exit: Optional[str] = "exit-handler"
+    labels: Dict[str, str] = field(default_factory=dict)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    templates: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    dags: Dict[str, List[DagTask]] = field(default_factory=dict)
+
+    # -- construction --------------------------------------------------------
+    def add_container_template(
+        self,
+        name: str,
+        image: str,
+        command: List[str],
+        env: Optional[Dict[str, str]] = None,
+        working_dir: Optional[str] = None,
+    ) -> str:
+        if name in self.templates or name in self.dags:
+            raise WorkflowValidationError(f"duplicate template {name!r}")
+        container: Dict[str, Any] = {"image": image, "command": command}
+        if env:
+            container["env"] = [{"name": k, "value": v} for k, v in sorted(env.items())]
+        if working_dir:
+            container["workingDir"] = working_dir
+        if self.volumes:
+            container["volumeMounts"] = [
+                {"name": v["name"], "mountPath": f"/mnt/{v['name']}"} for v in self.volumes
+            ]
+        self.templates[name] = {"name": name, "container": container}
+        return name
+
+    def add_task(self, dag: str, task: DagTask) -> DagTask:
+        self.dags.setdefault(dag, []).append(task)
+        return task
+
+    # -- validation + serialization -----------------------------------------
+    def validate(self) -> None:
+        if self.entrypoint not in self.dags:
+            raise WorkflowValidationError(f"entrypoint {self.entrypoint!r} is not a DAG")
+        if self.on_exit and self.on_exit not in self.dags:
+            raise WorkflowValidationError(f"onExit {self.on_exit!r} is not a DAG")
+        for dag_name, tasks in self.dags.items():
+            names = [t.name for t in tasks]
+            if len(names) != len(set(names)):
+                raise WorkflowValidationError(f"dag {dag_name!r}: duplicate task names")
+            known = set(names)
+            for t in tasks:
+                if t.template not in self.templates and t.template not in self.dags:
+                    raise WorkflowValidationError(
+                        f"dag {dag_name!r} task {t.name!r}: unknown template {t.template!r}"
+                    )
+                for dep in t.dependencies:
+                    if dep not in known:
+                        raise WorkflowValidationError(
+                            f"dag {dag_name!r} task {t.name!r}: unknown dependency {dep!r}"
+                        )
+            self._check_acyclic(dag_name, tasks)
+
+    @staticmethod
+    def _check_acyclic(dag_name: str, tasks: List[DagTask]) -> None:
+        deps = {t.name: set(t.dependencies) for t in tasks}
+        resolved: set = set()
+        while deps:
+            ready = [n for n, d in deps.items() if d <= resolved]
+            if not ready:
+                raise WorkflowValidationError(f"dag {dag_name!r}: dependency cycle among {sorted(deps)}")
+            for n in ready:
+                resolved.add(n)
+                del deps[n]
+
+    def to_dict(self) -> Dict[str, Any]:
+        self.validate()
+        templates: List[Dict[str, Any]] = list(self.templates.values())
+        for dag_name, tasks in self.dags.items():
+            templates.append(
+                {"name": dag_name, "dag": {"tasks": [t.to_dict() for t in tasks]}}
+            )
+        spec: Dict[str, Any] = {"entrypoint": self.entrypoint, "templates": templates}
+        if self.on_exit:
+            spec["onExit"] = self.on_exit
+        if self.volumes:
+            spec["volumes"] = self.volumes
+        return {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {"generateName": f"{self.name}-", "labels": dict(self.labels)},
+            "spec": spec,
+        }

@@ -1,0 +1,101 @@
+"""WorkflowBuilder — the ArgoTestBuilder analog (workflow_utils.py:30-120).
+
+Builds per-component CI workflows with the reference's structure:
+- a shared results volume (the reference's ``nfs-external`` NFS volume
+  :9-11 — junit XML lands there and ships to gubernator),
+- a ``checkout`` task everything depends on,
+- kaniko-shaped image build tasks (the reference builds with kaniko in-CI),
+- per-language lint/format/test tasks,
+- an exit-handler DAG that always copies artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .argo import DagTask, Workflow
+
+TEST_IMAGE = "kubeflow-tpu/test-worker:latest"
+KANIKO_IMAGE = "gcr.io/kaniko-project/executor:latest"
+RESULTS_VOLUME = "results"
+REPO_DIR = "/mnt/results/src"
+
+
+class WorkflowBuilder:
+    def __init__(self, name: str, component: Optional[str] = None, registry: str = "registry.local/kubeflow-tpu"):
+        self.component = component
+        self.registry = registry
+        self.workflow = Workflow(
+            name=name,
+            labels={"workflow": name, **({"component": component} if component else {})},
+            volumes=[{"name": RESULTS_VOLUME, "emptyDir": {}}],
+        )
+        self._init_skeleton()
+
+    # -- skeleton ------------------------------------------------------------
+    def _init_skeleton(self) -> None:
+        wf = self.workflow
+        wf.add_container_template(
+            "checkout",
+            TEST_IMAGE,
+            ["git", "clone", "--depth=1", "$(REPO_URL)", REPO_DIR],
+            env={"REPO_URL": "https://example.invalid/kubeflow-tpu.git"},
+        )
+        wf.add_task("e2e", DagTask("checkout", "checkout"))
+        wf.add_container_template(
+            "copy-artifacts",
+            TEST_IMAGE,
+            ["python", "-m", "e2e.junit"],  # collects junit XML from the results volume
+            working_dir=REPO_DIR,
+        )
+        wf.add_task("exit-handler", DagTask("copy-artifacts", "copy-artifacts"))
+
+    # -- task factories (each returns the DagTask for dependency chaining) ---
+    def build_image(self, image: str, dockerfile_dir: str, deps: Optional[List[str]] = None) -> DagTask:
+        """Kaniko build task (the reference's create_kaniko_task)."""
+        name = f"build-{image}"
+        self.workflow.add_container_template(
+            name,
+            KANIKO_IMAGE,
+            [
+                "/kaniko/executor",
+                f"--dockerfile={REPO_DIR}/images/{dockerfile_dir}/Dockerfile",
+                f"--context={REPO_DIR}",
+                f"--destination={self.registry}/{image}:$(COMMIT)",
+            ],
+            env={"COMMIT": "{{workflow.uid}}"},
+        )
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def pytest(self, name: str, target: str, deps: Optional[List[str]] = None) -> DagTask:
+        self.workflow.add_container_template(
+            name,
+            TEST_IMAGE,
+            ["python", "-m", "pytest", target, "-q", "--junitxml", f"/mnt/{RESULTS_VOLUME}/{name}.xml"],
+            working_dir=REPO_DIR,
+        )
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def e2e_driver(self, name: str, module: str, deps: Optional[List[str]] = None) -> DagTask:
+        self.workflow.add_container_template(
+            name,
+            TEST_IMAGE,
+            ["python", "-m", module, "--junit", f"/mnt/{RESULTS_VOLUME}/{name}.xml"],
+            working_dir=REPO_DIR,
+        )
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def lint(self, name: str, command: List[str], deps: Optional[List[str]] = None) -> DagTask:
+        self.workflow.add_container_template(name, TEST_IMAGE, command, working_dir=REPO_DIR)
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def bench(self, name: str = "bench", deps: Optional[List[str]] = None) -> DagTask:
+        """TPU benchmark task — runs on a node with chips (nodeSelector added
+        by the deployer overlay; CI validates shape only)."""
+        self.workflow.add_container_template(
+            name, TEST_IMAGE, ["python", "bench.py"], working_dir=REPO_DIR
+        )
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def build(self) -> Dict:
+        return self.workflow.to_dict()

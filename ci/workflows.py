@@ -1,0 +1,103 @@
+"""Per-component CI workflow definitions (the ci/jwa_tests.py pattern:
+one module instantiates the builder per component with its build, lint,
+unit-test, and e2e tasks)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from .workflow_utils import WorkflowBuilder
+
+#: component name → (test targets, images it builds)
+COMPONENTS: Dict[str, Dict[str, List[str]]] = {
+    "notebook-controller": {
+        "tests": ["tests/test_notebook_controller.py"],
+        "images": ["controlplane"],
+    },
+    "profile-controller": {
+        "tests": ["tests/test_profile_controller.py"],
+        "images": ["controlplane"],
+    },
+    "tensorboard-controller": {
+        "tests": ["tests/test_tensorboard_kfam.py"],
+        "images": ["controlplane"],
+    },
+    "admission-webhook": {
+        "tests": ["tests/test_webhook.py"],
+        "images": ["controlplane"],
+    },
+    "access-management": {
+        "tests": ["tests/test_tensorboard_kfam.py"],
+        "images": ["controlplane"],
+    },
+    "web-apps": {
+        "tests": ["tests/test_webapps.py"],
+        "images": ["controlplane"],
+    },
+    "studyjob": {
+        "tests": ["tests/test_hpo_serving.py"],
+        "images": ["controlplane", "trial-jax-tpu"],
+    },
+    "serving": {
+        "tests": ["tests/test_hpo_serving.py"],
+        "images": ["controlplane", "model-server"],
+    },
+    "notebook-images": {
+        "tests": ["tests/test_images.py"],
+        "images": ["base", "jupyter", "jupyter-jax-tpu", "jupyter-jax-tpu-full"],
+    },
+    "compute": {
+        "tests": [
+            "tests/test_parallel.py",
+            "tests/test_ops.py",
+            "tests/test_models_training.py",
+            "tests/test_pipeline_moe.py",
+        ],
+        "images": [],
+    },
+    "runtime": {
+        "tests": ["tests/test_store.py", "tests/test_runtime.py", "tests/test_topology.py"],
+        "images": [],
+    },
+    "manifests": {
+        "tests": ["tests/test_manifests.py"],
+        "images": [],
+    },
+}
+
+
+def component_presubmit(component: str) -> Dict:
+    """Unit/lint/build workflow for one component (presubmit shape)."""
+    spec = COMPONENTS[component]
+    b = WorkflowBuilder(f"{component}-presubmit", component=component)
+    b.lint("flake8", ["python", "-m", "flake8", "kubeflow_tpu", "e2e", "ci", "tests"])
+    for i, target in enumerate(spec["tests"]):
+        b.pytest(f"unit-{i}", target)
+    for image in spec["images"]:
+        b.build_image(image, image)
+    return b.build()
+
+
+def platform_e2e() -> Dict:
+    """The whole-platform e2e workflow (postsubmit/periodic shape): build
+    images, then run the three e2e drivers against them, then bench."""
+    b = WorkflowBuilder("platform-e2e")
+    build = b.build_image("controlplane", "controlplane")
+    trial = b.build_image("trial-jax-tpu", "trial-jax-tpu", deps=["checkout"])
+    server = b.build_image("model-server", "model-server", deps=["checkout"])
+    b.e2e_driver("e2e-studyjob", "e2e.studyjob_driver", deps=[build.name, trial.name])
+    b.e2e_driver("e2e-serving", "e2e.serving_driver", deps=[build.name, server.name])
+    b.e2e_driver("e2e-notebook-spawn", "e2e.notebook_spawn_driver", deps=[build.name])
+    b.bench(deps=[build.name])
+    return b.build()
+
+
+#: registry of buildable workflows (prow_config.yaml names resolve here)
+WORKFLOWS: Dict[str, Callable[[], Dict]] = {
+    **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
+    "platform-e2e": platform_e2e,
+}
+
+
+def build_all() -> Dict[str, Dict]:
+    return {name: fn() for name, fn in WORKFLOWS.items()}

@@ -46,12 +46,16 @@ def wait_for_condition(
     (testing/katib_studyjob_test.py:128-193: poll CR status under a
     deadline, raise on timeout). Returns fn()'s final value."""
     deadline = time.monotonic() + timeout
-    last: Any = None
     while time.monotonic() < deadline:
         last = fn()
         if last:
             return last
         time.sleep(interval)
+    # Final check at/after the deadline: a condition that became true during
+    # the last poll interval is a pass, not a flake.
+    last = fn()
+    if last:
+        return last
     raise TimeoutError(f"timed out after {timeout}s waiting for {desc} (last={last!r})")
 
 
@@ -120,10 +124,15 @@ class E2ECluster:
         return self
 
     def stop(self) -> None:
-        for server in self._servers:
-            server.close()
-        self._servers.clear()
-        self.mgr.stop()
+        try:
+            for server in self._servers:
+                try:
+                    server.close()
+                except Exception:
+                    pass  # a half-torn-down listener must not block shutdown
+        finally:
+            self._servers.clear()
+            self.mgr.stop()
 
     def __enter__(self) -> "E2ECluster":
         return self.start()

@@ -72,3 +72,35 @@ def junit_xml(suite: TestSuite) -> str:
 def write_junit(suite: TestSuite, path: str) -> None:
     with open(path, "w") as f:
         f.write(junit_xml(suite))
+
+
+def run_driver(
+    suite_name: str,
+    class_name: str,
+    case_name,
+    make_case,
+    argv=None,
+    add_args=None,
+    default_junit: str = "junit.xml",
+) -> int:
+    """Shared driver entry point: argparse (--junit + driver extras), run the
+    flow as one junit case, write XML, print PASS/FAIL, return exit code.
+
+    ``case_name`` may be a callable(args) for parameterized names;
+    ``make_case(args)`` returns the zero-arg flow to execute;
+    ``add_args(parser)`` registers driver-specific flags.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    if add_args is not None:
+        add_args(parser)
+    parser.add_argument("--junit", default=default_junit)
+    args = parser.parse_args(argv)
+
+    suite = TestSuite(suite_name)
+    name = case_name(args) if callable(case_name) else case_name
+    case = suite.run(class_name, name, make_case(args))
+    write_junit(suite, args.junit)
+    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
+    return 0 if suite.passed else 1

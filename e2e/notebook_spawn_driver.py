@@ -16,7 +16,6 @@ Run standalone:  python -m e2e.notebook_spawn_driver
 
 from __future__ import annotations
 
-import argparse
 import sys
 from typing import Any, Dict
 
@@ -29,7 +28,7 @@ from kubeflow_tpu.tpu.env import (
 from kubeflow_tpu.tpu.topology import RESOURCE_TPU
 
 from .cluster import E2ECluster, csrf_headers, http_json, unique_namespace, wait_for_condition
-from .junit import TestSuite, write_junit
+from .junit import run_driver
 
 NOTEBOOK_API = "kubeflow.org/v1beta1"
 OWNER = "spawn-e2e@example.com"
@@ -87,14 +86,17 @@ def run_notebook_spawn_e2e(timeout: float = 60.0) -> Dict[str, Any]:
                     return nb["status"]["phase"]
             return ""
 
+        def nb_pods():
+            return [
+                p
+                for p in cluster.client.list("v1", "Pod", ns)
+                if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
+            ]
+
         wait_for_condition(lambda: notebook_phase() == "ready", timeout, desc="notebook ready")
 
         # One pod per slice host, each with chips + deterministic JAX env.
-        pods = [
-            p
-            for p in cluster.client.list("v1", "Pod", ns)
-            if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
-        ]
+        pods = nb_pods()
         assert len(pods) == 2, f"2x4 v5e slice = 2 hosts, got {len(pods)} pods"
         hostnames = set()
         for pod in pods:
@@ -115,15 +117,7 @@ def run_notebook_spawn_e2e(timeout: float = 60.0) -> Dict[str, Any]:
             "PATCH", f"{base}/api/namespaces/{ns}/notebooks/nb-e2e", {"stopped": True}, headers
         )
         wait_for_condition(lambda: notebook_phase() == "stopped", timeout, desc="notebook stopped")
-        wait_for_condition(
-            lambda: not [
-                p
-                for p in cluster.client.list("v1", "Pod", ns)
-                if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
-            ],
-            timeout,
-            desc="slice released",
-        )
+        wait_for_condition(lambda: not nb_pods(), timeout, desc="slice released")
 
         # Restart: chips reacquired, back to ready.
         http_json(
@@ -135,12 +129,7 @@ def run_notebook_spawn_e2e(timeout: float = 60.0) -> Dict[str, Any]:
         http_json("DELETE", f"{base}/api/namespaces/{ns}/notebooks/nb-e2e", headers=headers)
         wait_for_condition(lambda: notebook_phase() == "", timeout, desc="notebook deleted")
         wait_for_condition(
-            lambda: not cluster.client.list("apps/v1", "StatefulSet", ns)
-            and not [
-                p
-                for p in cluster.client.list("v1", "Pod", ns)
-                if p["metadata"].get("labels", {}).get("notebook-name") == "nb-e2e"
-            ],
+            lambda: not cluster.client.list("apps/v1", "StatefulSet", ns) and not nb_pods(),
             timeout,
             desc="children garbage-collected",
         )
@@ -148,18 +137,18 @@ def run_notebook_spawn_e2e(timeout: float = 60.0) -> Dict[str, Any]:
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--timeout", type=float, default=60.0)
-    parser.add_argument("--junit", default="junit_notebook_spawn.xml")
-    args = parser.parse_args(argv)
+    def add_args(parser):
+        parser.add_argument("--timeout", type=float, default=60.0)
 
-    suite = TestSuite("e2e-notebook-spawn")
-    case = suite.run(
-        "NotebookSpawnE2E", "spawn-stop-restart-delete", lambda: run_notebook_spawn_e2e(args.timeout)
+    return run_driver(
+        "e2e-notebook-spawn",
+        "NotebookSpawnE2E",
+        "spawn-stop-restart-delete",
+        lambda args: lambda: run_notebook_spawn_e2e(args.timeout),
+        argv=argv,
+        add_args=add_args,
+        default_junit="junit_notebook_spawn.xml",
     )
-    write_junit(suite, args.junit)
-    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
-    return 0 if suite.passed else 1
 
 
 if __name__ == "__main__":

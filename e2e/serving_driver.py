@@ -13,7 +13,6 @@ Run standalone:  python -m e2e.serving_driver
 
 from __future__ import annotations
 
-import argparse
 import sys
 import urllib.error
 from typing import Any, Dict, List
@@ -23,7 +22,7 @@ import numpy as np
 from kubeflow_tpu.serving.server import ModelServer, bert_served_model
 
 from .cluster import http_json
-from .junit import TestSuite, write_junit
+from .junit import run_driver
 from .retry import run_with_retry
 
 TOLERANCE = 1e-3  # test_tf_serving.py:40-57 almost_equal tolerance
@@ -78,15 +77,14 @@ def run_serving_e2e(retries: int = 10) -> Dict[str, Any]:
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--junit", default="junit_serving.xml")
-    args = parser.parse_args(argv)
-
-    suite = TestSuite("e2e-serving")
-    case = suite.run("ServingE2E", "bert-predict", run_serving_e2e)
-    write_junit(suite, args.junit)
-    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
-    return 0 if suite.passed else 1
+    return run_driver(
+        "e2e-serving",
+        "ServingE2E",
+        "bert-predict",
+        lambda args: run_serving_e2e,
+        argv=argv,
+        default_junit="junit_serving.xml",
+    )
 
 
 if __name__ == "__main__":

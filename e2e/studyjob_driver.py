@@ -12,7 +12,6 @@ Writes junit XML (test_tf_serving.py:139-143 pattern).
 
 from __future__ import annotations
 
-import argparse
 import sys
 from typing import Any, Dict
 
@@ -20,7 +19,7 @@ from kubeflow_tpu.controllers.studyjob import STUDY_API, InProcessTrialRunner
 from kubeflow_tpu.hpo.trials import mnist_objective, quadratic_objective
 
 from .cluster import E2ECluster, unique_namespace, wait_for_condition
-from .junit import TestSuite, write_junit
+from .junit import run_driver
 
 OBJECTIVES = {"quadratic": quadratic_objective, "mnist": mnist_objective}
 
@@ -95,22 +94,20 @@ def run_studyjob_e2e(
 
 
 def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--objective", choices=sorted(OBJECTIVES), default="quadratic")
-    parser.add_argument("--max-trials", type=int, default=6)
-    parser.add_argument("--timeout", type=float, default=120.0)
-    parser.add_argument("--junit", default="junit_studyjob.xml")
-    args = parser.parse_args(argv)
+    def add_args(parser):
+        parser.add_argument("--objective", choices=sorted(OBJECTIVES), default="quadratic")
+        parser.add_argument("--max-trials", type=int, default=6)
+        parser.add_argument("--timeout", type=float, default=120.0)
 
-    suite = TestSuite("e2e-studyjob")
-    case = suite.run(
+    return run_driver(
+        "e2e-studyjob",
         "StudyJobE2E",
-        f"studyjob-{args.objective}",
-        lambda: run_studyjob_e2e(args.objective, args.max_trials, timeout=args.timeout),
+        lambda args: f"studyjob-{args.objective}",
+        lambda args: lambda: run_studyjob_e2e(args.objective, args.max_trials, timeout=args.timeout),
+        argv=argv,
+        add_args=add_args,
+        default_junit="junit_studyjob.xml",
     )
-    write_junit(suite, args.junit)
-    print(("PASS" if case.passed else f"FAIL: {case.failure}") + f" ({case.time_seconds:.1f}s)")
-    return 0 if suite.passed else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Image init contract (the s6-overlay analog, reference base image):
+# - hooks in /etc/cont-init.d run in order before the service starts,
+# - the service command comes from the image's CMD (exec "$@"),
+# - NB_PREFIX (injected by the notebook controller) is exported for
+#   servers that need their URL base path.
+set -euo pipefail
+
+if [ -d /etc/cont-init.d ]; then
+  for hook in /etc/cont-init.d/*; do
+    [ -x "$hook" ] && "$hook"
+  done
+fi
+
+export NB_PREFIX="${NB_PREFIX:-/}"
+exec "$@"

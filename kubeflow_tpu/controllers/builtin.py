@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional
 from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..runtime.manager import Reconciler, Request, Result
-from ..tpu.topology import RESOURCE_TPU
+from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
 
 
 def _pod_for_template(
@@ -221,10 +221,7 @@ class PodletReconciler(Reconciler):
 
     def _schedule(self, client: Client, pod: Dict[str, Any], nodes: List[Dict[str, Any]]) -> Optional[str]:
         selector = pod.get("spec", {}).get("nodeSelector") or {}
-        tpu_request = 0
-        for c in pod.get("spec", {}).get("containers", []):
-            limits = (c.get("resources") or {}).get("limits") or {}
-            tpu_request += int(limits.get(RESOURCE_TPU, 0))
+        tpu_request = pod_tpu_chips(pod)
         for node in nodes:
             labels = apimeta.labels_of(node)
             if any(labels.get(k) != v for k, v in selector.items()):
@@ -244,13 +241,7 @@ class PodletReconciler(Reconciler):
         for p in client.list("v1", "Pod"):
             if p.get("spec", {}).get("nodeName") != node_name or apimeta.uid_of(p) == exclude:
                 continue
-            # Terminal pods release their chips (kube-scheduler likewise
-            # excludes Succeeded/Failed pods from resource accounting).
-            if p.get("status", {}).get("phase") in ("Succeeded", "Failed"):
-                continue
-            for c in p.get("spec", {}).get("containers", []):
-                limits = (c.get("resources") or {}).get("limits") or {}
-                total += int(limits.get(RESOURCE_TPU, 0))
+            total += pod_tpu_chips(p)
         return total
 
 

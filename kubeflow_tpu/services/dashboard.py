@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional
 from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..controllers.profile import PROFILE_API
-from ..tpu.topology import RESOURCE_TPU
+from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
 from ..web.auth import AuthConfig, Authorizer, install_auth
 from ..web.http import App, HttpError, JsonResponse, Request
 
@@ -55,21 +55,15 @@ class TpuMetricsService:
             capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
             if capacity <= 0:
                 continue
-            used = 0
-            for p in pods:
-                if p.get("spec", {}).get("nodeName") != name:
-                    continue
-                for c in p.get("spec", {}).get("containers", []):
-                    used += int(((c.get("resources") or {}).get("limits") or {}).get(RESOURCE_TPU, 0))
+            used = sum(
+                pod_tpu_chips(p) for p in pods if p.get("spec", {}).get("nodeName") == name
+            )
             out.append({"node": name, "capacityChips": capacity, "allocatedChips": used,
                         "utilization": used / capacity})
         return out
 
     def namespace_tpu_usage(self, namespace: str) -> Dict[str, Any]:
-        used = 0
-        for p in self.client.list("v1", "Pod", namespace):
-            for c in p.get("spec", {}).get("containers", []):
-                used += int(((c.get("resources") or {}).get("limits") or {}).get(RESOURCE_TPU, 0))
+        used = sum(pod_tpu_chips(p) for p in self.client.list("v1", "Pod", namespace))
         return {"namespace": namespace, "allocatedChips": used}
 
 

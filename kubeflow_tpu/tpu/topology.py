@@ -149,3 +149,17 @@ _KNOWN_TOPOLOGIES: Dict[str, List[SliceTopology]] = {
 def chips_in_quota(quantity: str) -> int:
     """Parse a quota quantity for google.com/tpu (always integral chips)."""
     return int(str(quantity))
+
+
+def pod_tpu_chips(pod: Dict) -> int:
+    """Chips a pod holds against node capacity/quota: the sum of its
+    containers' ``google.com/tpu`` limits — zero once the pod is terminal
+    (kube-scheduler excludes Succeeded/Failed pods from resource
+    accounting). The single accounting predicate shared by the scheduler
+    and the dashboard metrics, so they can never disagree."""
+    if (pod.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+        return 0
+    total = 0
+    for c in (pod.get("spec") or {}).get("containers", []) or []:
+        total += int(((c.get("resources") or {}).get("limits") or {}).get(RESOURCE_TPU, 0))
+    return total

@@ -1,0 +1,111 @@
+"""Image tree validation (images/ — example-notebook-servers analog).
+
+Static invariants a registry build would surface: every Dockerfile's FROM
+chain resolves in-tree (or to the allowed external bases), the init
+contract holds, TPU images carry the TPU env, and the no-CUDA invariant —
+the whole point of the re-targeting — holds tree-wide.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+IMAGES = Path(__file__).resolve().parent.parent / "images"
+EXTERNAL_BASES = {"debian:bookworm-slim"}
+PREFIX = "kubeflow-tpu/"
+
+
+def dockerfiles():
+    return sorted(IMAGES.glob("*/Dockerfile"))
+
+
+def from_of(path: Path) -> str:
+    for line in path.read_text().splitlines():
+        if line.startswith("FROM "):
+            return line.split()[1]
+    raise AssertionError(f"{path}: no FROM line")
+
+
+def test_tree_exists():
+    names = {p.parent.name for p in dockerfiles()}
+    # the reference tree's shape: base, three server families, framework
+    # variants, plus the platform's own runtime images
+    for required in [
+        "base",
+        "jupyter",
+        "codeserver",
+        "rstudio",
+        "jupyter-scipy",
+        "jupyter-jax-tpu",
+        "jupyter-jax-tpu-full",
+        "codeserver-jax-tpu",
+        "rstudio-tidyverse",
+        "trial-jax-tpu",
+        "model-server",
+        "controlplane",
+    ]:
+        assert required in names, f"missing image {required}"
+
+
+@pytest.mark.parametrize("path", dockerfiles(), ids=lambda p: p.parent.name)
+def test_from_chain_resolves(path):
+    base = from_of(path)
+    if base in EXTERNAL_BASES:
+        return
+    assert base.startswith(PREFIX), f"{path}: FROM {base} is neither in-tree nor allowed external"
+    parent = base[len(PREFIX):].split(":")[0]
+    assert (IMAGES / parent / "Dockerfile").is_file(), f"{path}: FROM {base} has no in-tree build"
+
+
+def test_chain_roots_at_base():
+    """Every image must (transitively) root at an external base — no cycles."""
+    for path in dockerfiles():
+        seen = set()
+        cur = path
+        while True:
+            base = from_of(cur)
+            if base in EXTERNAL_BASES:
+                break
+            parent = base[len(PREFIX):].split(":")[0]
+            assert parent not in seen, f"cycle through {parent}"
+            seen.add(parent)
+            cur = IMAGES / parent / "Dockerfile"
+
+
+def test_no_cuda_anywhere():
+    """The TPU re-targeting's core invariant: zero NVIDIA/CUDA stack
+    (reference images need cuda-compat/cudnn/CUPTI —
+    jupyter-tensorflow/cuda.Dockerfile:1-80)."""
+    banned = re.compile(r"nvidia|cuda|cudnn|nccl|cupti", re.IGNORECASE)
+    for path in IMAGES.rglob("*"):
+        if path.is_file() and path.suffix not in (".md",):
+            for line in path.read_text().splitlines():
+                if line.strip().startswith("#"):  # docs may cite the reference
+                    continue
+                # torch cpu wheels index mentions /whl/cpu, never cuda
+                assert not banned.search(line), f"{path}: CUDA-era content: {line.strip()}"
+
+
+def test_tpu_images_set_platform_env():
+    for name in ["jupyter-jax-tpu", "codeserver-jax-tpu", "trial-jax-tpu", "model-server"]:
+        text = (IMAGES / name / "Dockerfile").read_text()
+        assert "JAX_PLATFORMS=tpu" in text, f"{name}: missing JAX_PLATFORMS=tpu"
+        assert "jax[tpu]" in text, f"{name}: missing jax[tpu] wheel install"
+        # no host-specific env baked in — injection is the webhook's job and
+        # must be deterministic across slice hosts (tpu/env.py contract)
+        assert "TPU_WORKER_ID" not in text, f"{name}: worker identity must not be baked"
+
+
+def test_base_init_contract():
+    init = (IMAGES / "base" / "init.sh").read_text()
+    assert "cont-init.d" in init and 'exec "$@"' in init
+    df = (IMAGES / "base" / "Dockerfile").read_text()
+    assert "tini" in df and "init.sh" in df
+    # non-root user matching the controller's default fsGroup handling
+    assert "NB_UID=1000" in df and "NB_GID=100" in df
+
+
+def test_serving_image_exposes_predict_port():
+    text = (IMAGES / "model-server" / "Dockerfile").read_text()
+    assert "EXPOSE 8500" in text  # the reference predict port (test_tf_serving.py:108)

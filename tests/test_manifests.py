@@ -1,0 +1,208 @@
+"""Manifest tree validation (manifests/ — the reference's L9 layer).
+
+The reference's manifests are exercised only by cluster deploys; here the
+suite statically enforces the invariants a deploy would surface: YAML
+parses, kustomization references resolve, CRDs cover every platform kind
+the code registers, selectors line up, and ConfigMap refs exist.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.meta import REGISTRY
+
+MANIFESTS = Path(__file__).resolve().parent.parent / "manifests"
+
+#: API groups owned by the platform — every registered kind in these groups
+#: must ship a CRD.
+PLATFORM_GROUPS = {
+    "kubeflow.org",
+    "tensorboard.kubeflow.org",
+    "katib.kubeflow.org",
+    "serving.kubeflow.org",
+}
+
+
+def yaml_docs(path: Path):
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def all_yaml_files():
+    return sorted(MANIFESTS.rglob("*.yaml"))
+
+
+def all_kustomizations():
+    return sorted(MANIFESTS.rglob("kustomization.yaml"))
+
+
+def docs_of_base(base_dir: Path):
+    docs = []
+    kust = yaml_docs(base_dir / "kustomization.yaml")[0]
+    for res in kust.get("resources", []):
+        target = base_dir / res
+        if target.is_file():
+            docs.extend(yaml_docs(target))
+    return kust, docs
+
+
+def test_manifests_exist():
+    assert MANIFESTS.is_dir()
+    assert len(all_kustomizations()) >= 12  # top-level + crds + 11 components
+
+
+@pytest.mark.parametrize("path", all_yaml_files(), ids=lambda p: str(p.relative_to(MANIFESTS)))
+def test_yaml_parses_and_has_kind(path):
+    for doc in yaml_docs(path):
+        if path.name == "kustomization.yaml":
+            assert doc.get("kind") == "Kustomization", path
+        else:
+            assert doc.get("apiVersion") and doc.get("kind"), f"{path}: doc missing apiVersion/kind"
+            assert doc.get("metadata", {}).get("name"), f"{path}: doc missing metadata.name"
+
+
+@pytest.mark.parametrize(
+    "path", all_kustomizations(), ids=lambda p: str(p.parent.relative_to(MANIFESTS) or "top")
+)
+def test_kustomization_references_resolve(path):
+    base = path.parent
+    kust = yaml_docs(path)[0]
+    for res in kust.get("resources", []):
+        target = base / res
+        assert (
+            target.is_file() or (target / "kustomization.yaml").is_file()
+        ), f"{path}: unresolved resource {res!r}"
+    for gen in kust.get("configMapGenerator", []):
+        for env in gen.get("envs", []):
+            assert (base / env).is_file(), f"{path}: missing env file {env!r}"
+
+
+def test_top_level_covers_every_component_dir():
+    kust = yaml_docs(MANIFESTS / "kustomization.yaml")[0]
+    listed = {r.split("/")[0] for r in kust["resources"]}
+    on_disk = {p.name for p in MANIFESTS.iterdir() if p.is_dir()}
+    assert listed == on_disk, (listed, on_disk)
+
+
+def test_crds_cover_registered_platform_kinds():
+    crds = {}
+    for doc in yaml_docs(MANIFESTS / "crds" / "crds.yaml"):
+        spec = doc["spec"]
+        # CRD object names are always <plural>.<group>
+        assert doc["metadata"]["name"] == f"{spec['names']['plural']}.{spec['group']}"
+        crds[(spec["group"], spec["names"]["kind"])] = spec
+    for res in REGISTRY.all():
+        if res.group not in PLATFORM_GROUPS:
+            continue
+        key = (res.group, res.kind)
+        assert key in crds, f"no CRD for registered kind {key}"
+        spec = crds[key]
+        assert spec["names"]["plural"] == res.plural, key
+        want_scope = "Namespaced" if res.namespaced else "Cluster"
+        assert spec["scope"] == want_scope, key
+        assert any(v["name"] == res.version for v in spec["versions"]), key
+    # and no orphan CRDs for kinds the code never registered
+    registered = {(r.group, r.kind) for r in REGISTRY.all()}
+    for key in crds:
+        assert key in registered, f"CRD for unregistered kind {key}"
+
+
+def _deployments_and_services(docs):
+    deployments = [d for d in docs if d["kind"] == "Deployment"]
+    services = [d for d in docs if d["kind"] == "Service"]
+    return deployments, services
+
+
+@pytest.mark.parametrize(
+    "base",
+    [p.parent for p in all_kustomizations() if p.parent.name == "base"],
+    ids=lambda p: p.parent.name,
+)
+def test_component_wiring(base):
+    kust, docs = docs_of_base(base)
+    deployments, services = _deployments_and_services(docs)
+    assert deployments, f"{base}: no Deployment"
+
+    generated_cms = {g["name"] for g in kust.get("configMapGenerator", [])}
+    declared_cms = {d["metadata"]["name"] for d in docs if d["kind"] == "ConfigMap"}
+    service_accounts = {d["metadata"]["name"] for d in docs if d["kind"] == "ServiceAccount"}
+    cluster_roles = {d["metadata"]["name"] for d in docs if d["kind"] == "ClusterRole"}
+    kust_images = {i["name"] for i in kust.get("images", [])}
+
+    for dep in deployments:
+        tmpl = dep["spec"]["template"]
+        pod_labels = tmpl["metadata"]["labels"]
+        sel = dep["spec"]["selector"]["matchLabels"]
+        assert all(pod_labels.get(k) == v for k, v in sel.items()), (
+            f"{base}: selector {sel} not covered by pod labels {pod_labels}"
+        )
+        # every Service of the component must select these pods
+        for svc in services:
+            svc_sel = svc["spec"]["selector"]
+            assert all(pod_labels.get(k) == v for k, v in svc_sel.items()), (
+                f"{base}: service {svc['metadata']['name']} selector mismatch"
+            )
+        # serviceAccount + configmap refs resolve
+        sa = tmpl["spec"].get("serviceAccountName")
+        if sa:
+            assert sa in service_accounts, f"{base}: unknown serviceAccount {sa}"
+        for c in tmpl["spec"]["containers"]:
+            assert c["image"] in kust_images, (
+                f"{base}: image {c['image']} not pinned in kustomization images"
+            )
+            for ef in c.get("envFrom", []):
+                name = ef.get("configMapRef", {}).get("name")
+                if name:
+                    assert name in generated_cms | declared_cms, (
+                        f"{base}: envFrom references unknown ConfigMap {name}"
+                    )
+        for vol in tmpl["spec"].get("volumes", []):
+            cm = vol.get("configMap", {}).get("name")
+            if cm:
+                assert cm in generated_cms | declared_cms, (
+                    f"{base}: volume references unknown ConfigMap {cm}"
+                )
+
+    # rolebindings point at roles that exist in the same base
+    for doc in docs:
+        if doc["kind"] == "ClusterRoleBinding":
+            assert doc["roleRef"]["name"] in cluster_roles, (
+                f"{base}: binding to unknown role {doc['roleRef']['name']}"
+            )
+            for sub in doc["subjects"]:
+                if sub["kind"] == "ServiceAccount":
+                    assert sub["name"] in service_accounts, (
+                        f"{base}: binding to unknown SA {sub['name']}"
+                    )
+
+
+def test_webhook_configuration_targets_pod_create():
+    docs = yaml_docs(MANIFESTS / "admission-webhook" / "base" / "resources.yaml")
+    hooks = [d for d in docs if d["kind"] == "MutatingWebhookConfiguration"]
+    assert len(hooks) == 1
+    rule = hooks[0]["webhooks"][0]["rules"][0]
+    assert rule["operations"] == ["CREATE"] and rule["resources"] == ["pods"]
+    # Ignore failures: a down webhook must not brick pod creation platform-wide
+    assert hooks[0]["webhooks"][0]["failurePolicy"] == "Ignore"
+
+
+def test_spawner_configmap_parses_into_spawner_config():
+    """The deployed spawner ConfigMap must round-trip through the real
+    SpawnerConfig loader (config drift between manifests and code is the
+    reference's classic failure mode)."""
+    from kubeflow_tpu.services.spawner_config import SpawnerConfig
+
+    docs = yaml_docs(MANIFESTS / "jupyter-web-app" / "base" / "resources.yaml")
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    cfg = SpawnerConfig.from_yaml(cm["data"]["spawner_ui_config.yaml"])
+    assert cfg.form_value({}, "cpu") == "4"
+    tpus = cfg.defaults["tpus"]
+    assert "v5e" in tpus["generations"] and tpus["value"]["generation"] == "none"
+    # tpu selection in a form resolves through the real topology validator
+    assert cfg.tpu_of_form({"tpus": {"generation": "v5e", "topology": "2x4"}}) == {
+        "generation": "v5e",
+        "topology": "2x4",
+    }

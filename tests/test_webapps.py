@@ -228,6 +228,16 @@ class TestDashboard:
         assert r.body["allocatedChips"] == 4
         # platform inference from providerID
         assert dash.call("GET", "/api/platform-info", None, ALICE).body["provider"] == "gce"
+        # terminal pods release chips in the dashboard's accounting too (the
+        # same pod_tpu_chips predicate the scheduler uses — they must agree)
+        done = platform.client.get("v1", "Pod", "worker", "default")
+        done["status"]["phase"] = "Succeeded"
+        platform.client.update_status(done)
+        assert platform.wait_idle()
+        node = dash.call("GET", "/api/metrics/node", None, ALICE).body[0]
+        assert node["allocatedChips"] == 0 and node["utilization"] == 0.0
+        r = dash.call("GET", "/api/metrics/namespace?namespace=default", None, ADMIN)
+        assert r.body["allocatedChips"] == 0
 
     def test_all_namespaces_admin_only(self, platform, auth):
         kfam = make_kfam_app(platform.client, auth)
