@@ -1,0 +1,332 @@
+"""Storage backends for the Store: native C++ core with a Python fallback.
+
+The reference's control plane is compiled (five Go binaries — SURVEY.md
+§2.9). Here the storage hot path — MVCC buckets, revision counter,
+label-filtered listing, and the watch journal — lives in a C++ shared
+library (kubeflow_tpu/native/store_core.cc) bound via ctypes; object
+*semantics* (admission, finalizers, status merge, GC) stay in the Python
+Store on top of either backend.
+
+The native backend adds a capability the dict backend lacks: a bounded
+write journal, so watches can resume from a resourceVersion (etcd watch
+windows). Selection: KUBEFLOW_TPU_NATIVE=1 forces native (raises if the
+toolchain is missing), =0 forces Python, unset tries native and falls back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import meta as apimeta
+
+_REC = "\x1e"
+_UNIT = "\x1f"
+
+#: journal op codes (shared with store_core.cc)
+OPS = ("ADDED", "MODIFIED", "DELETED")
+_OP_CODE = {name: i for i, name in enumerate(OPS)}
+
+
+@dataclass
+class JournalRecord:
+    rv: int
+    type: str  # ADDED | MODIFIED | DELETED
+    bucket: str
+    namespace: str
+    name: str
+    object: Dict[str, Any]
+
+
+class JournalExpired(Exception):
+    """since_rv fell out of the journal window — relist, like etcd 410 Gone."""
+
+
+class DictBackend:
+    """Pure-Python storage: plain dicts, no journal (the pre-native shape)."""
+
+    journal_capable = False
+
+    def __init__(self) -> None:
+        self._rv = 0
+        self._data: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
+
+    def next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def current_rv(self) -> int:
+        return self._rv
+
+    def contains(self, bucket: str, ns: str, name: str) -> bool:
+        return (ns, name) in self._data.get(bucket, {})
+
+    def get(self, bucket: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
+        obj = self._data.get(bucket, {}).get((ns, name))
+        return apimeta.deepcopy(obj) if obj is not None else None
+
+    def put(self, bucket: str, ns: str, name: str, obj: Dict[str, Any], rv: int, op: str) -> None:
+        # JSON round-trip instead of deepcopy: enforces the same wire-shape
+        # contract as the native backend (tuples→lists, non-serializable
+        # values rejected), so object semantics can never depend on which
+        # backend is active — a real apiserver likewise serializes to etcd.
+        self._data.setdefault(bucket, {})[(ns, name)] = json.loads(
+            json.dumps(obj, separators=(",", ":"))
+        )
+
+    def delete(self, bucket: str, ns: str, name: str, final_obj: Dict[str, Any], rv: int) -> None:
+        self._data.get(bucket, {}).pop((ns, name), None)
+
+    def list(
+        self, bucket: str, ns: Optional[str] = None, selector: Optional[Dict[str, str]] = None
+    ) -> List[Dict[str, Any]]:
+        out = []
+        for (obj_ns, _), obj in self._data.get(bucket, {}).items():
+            if ns is not None and obj_ns != ns:
+                continue
+            if selector:
+                labels = apimeta.labels_of(obj)
+                if any(labels.get(k) != v for k, v in selector.items()):
+                    continue
+            out.append(apimeta.deepcopy(obj))
+        return out
+
+    def list_all(self) -> List[Tuple[str, Dict[str, Any]]]:
+        out = []
+        for bucket, entries in self._data.items():
+            for obj in entries.values():
+                out.append((bucket, apimeta.deepcopy(obj)))
+        return out
+
+    def count(self, bucket: str) -> int:
+        return len(self._data.get(bucket, {}))
+
+    def journal_since(self, since_rv: int, max_records: int = 0) -> List[JournalRecord]:
+        raise NotImplementedError("DictBackend keeps no journal")
+
+
+# --- native backend ----------------------------------------------------------
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libstorecore.so")
+_build_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class NativeUnavailable(Exception):
+    """The native core cannot be built/loaded here (toolchain missing)."""
+
+
+def _build_native() -> str:
+    """make the shared library if absent (idempotent, serialized)."""
+    with _build_lock:
+        src = os.path.join(_NATIVE_DIR, "store_core.cc")
+        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+            return _SO_PATH
+        try:
+            proc = subprocess.run(
+                ["make", "-C", _NATIVE_DIR], capture_output=True, text=True
+            )
+        except FileNotFoundError as e:  # no make on PATH
+            raise NativeUnavailable(f"native build toolchain missing: {e}") from None
+        if proc.returncode != 0:
+            raise NativeUnavailable(
+                f"native core build failed:\n{proc.stdout}\n{proc.stderr}"
+            )
+        return _SO_PATH
+
+
+def load_native_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _build_native()
+    lib = ctypes.CDLL(path)
+    lib.store_new.restype = ctypes.c_void_p
+    lib.store_destroy.argtypes = [ctypes.c_void_p]
+    lib.store_next_rv.argtypes = [ctypes.c_void_p]
+    lib.store_next_rv.restype = ctypes.c_uint64
+    lib.store_current_rv.argtypes = [ctypes.c_void_p]
+    lib.store_current_rv.restype = ctypes.c_uint64
+    lib.store_put.argtypes = [ctypes.c_void_p] + [ctypes.c_char_p] * 5 + [ctypes.c_uint64, ctypes.c_int]
+    lib.store_put.restype = ctypes.c_int
+    lib.store_get.argtypes = [ctypes.c_void_p] + [ctypes.c_char_p] * 3
+    lib.store_get.restype = ctypes.c_void_p  # manual free
+    lib.store_contains.argtypes = [ctypes.c_void_p] + [ctypes.c_char_p] * 3
+    lib.store_contains.restype = ctypes.c_int
+    lib.store_delete.argtypes = (
+        [ctypes.c_void_p] + [ctypes.c_char_p] * 4 + [ctypes.c_uint64, ctypes.c_int]
+    )
+    lib.store_delete.restype = ctypes.c_int
+    lib.store_list.argtypes = (
+        [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
+    )
+    lib.store_list.restype = ctypes.c_void_p
+    lib.store_list_all.argtypes = [ctypes.c_void_p]
+    lib.store_list_all.restype = ctypes.c_void_p
+    lib.store_journal_since.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.store_journal_since.restype = ctypes.c_void_p
+    lib.store_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_count.restype = ctypes.c_uint64
+    lib.store_set_journal_cap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.store_free_str.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def _enc(s: Optional[str]) -> bytes:
+    return (s or "").encode()
+
+
+class NativeBackend:
+    """ctypes binding over the C++ store core."""
+
+    journal_capable = True
+
+    def __init__(self) -> None:
+        self._lib = load_native_lib()
+        self._h = self._lib.store_new()
+
+    def __del__(self) -> None:
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.store_destroy(h)
+
+    # -- string marshalling --------------------------------------------------
+    def _take_str(self, ptr: Optional[int]) -> Optional[str]:
+        if not ptr:
+            return None
+        try:
+            return ctypes.string_at(ptr).decode()
+        finally:
+            self._lib.store_free_str(ptr)
+
+    @staticmethod
+    def _pairs_flat(pairs: Dict[str, str]) -> str:
+        """Flatten k=v pairs for the C boundary, rejecting anything that
+        would corrupt the wire format (keys with '=', separator bytes) —
+        real Kubernetes label syntax forbids all of these anyway; failing
+        loudly beats two backends silently disagreeing on a match."""
+        for k, v in pairs.items():
+            if "=" in k or _UNIT in k or _REC in k or _UNIT in str(v) or _REC in str(v):
+                raise ValueError(f"label not representable on the native wire: {k!r}={v!r}")
+        return _UNIT.join(f"{k}={v}" for k, v in sorted(pairs.items()))
+
+    @classmethod
+    def _labels_flat(cls, obj: Dict[str, Any]) -> str:
+        return cls._pairs_flat(apimeta.labels_of(obj))
+
+    @classmethod
+    def _selector_flat(cls, selector: Optional[Dict[str, str]]) -> str:
+        return cls._pairs_flat(selector) if selector else ""
+
+    # -- backend interface ---------------------------------------------------
+    def next_rv(self) -> int:
+        return int(self._lib.store_next_rv(self._h))
+
+    def current_rv(self) -> int:
+        return int(self._lib.store_current_rv(self._h))
+
+    def contains(self, bucket: str, ns: str, name: str) -> bool:
+        return bool(self._lib.store_contains(self._h, _enc(bucket), _enc(ns), _enc(name)))
+
+    def get(self, bucket: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
+        blob = self._take_str(self._lib.store_get(self._h, _enc(bucket), _enc(ns), _enc(name)))
+        return None if blob is None else json.loads(blob)
+
+    def put(self, bucket: str, ns: str, name: str, obj: Dict[str, Any], rv: int, op: str) -> None:
+        self._lib.store_put(
+            self._h,
+            _enc(bucket),
+            _enc(ns),
+            _enc(name),
+            json.dumps(obj, separators=(",", ":")).encode(),
+            self._labels_flat(obj).encode(),
+            rv,
+            _OP_CODE[op],
+        )
+
+    def delete(self, bucket: str, ns: str, name: str, final_obj: Dict[str, Any], rv: int) -> None:
+        self._lib.store_delete(
+            self._h,
+            _enc(bucket),
+            _enc(ns),
+            _enc(name),
+            json.dumps(final_obj, separators=(",", ":")).encode(),
+            rv,
+            _OP_CODE["DELETED"],
+        )
+
+    def list(
+        self, bucket: str, ns: Optional[str] = None, selector: Optional[Dict[str, str]] = None
+    ) -> List[Dict[str, Any]]:
+        blob = self._take_str(
+            self._lib.store_list(
+                self._h,
+                _enc(bucket),
+                _enc(ns),
+                0 if ns is None else 1,  # "" filters the empty namespace; None = all
+                _enc(self._selector_flat(selector)),
+            )
+        )
+        if not blob:
+            return []
+        return [json.loads(r) for r in blob.split(_REC)]
+
+    def list_all(self) -> List[Tuple[str, Dict[str, Any]]]:
+        blob = self._take_str(self._lib.store_list_all(self._h))
+        if not blob:
+            return []
+        out = []
+        for rec in blob.split(_REC):
+            bucket, _, obj_json = rec.partition(_UNIT)
+            out.append((bucket, json.loads(obj_json)))
+        return out
+
+    def count(self, bucket: str) -> int:
+        return int(self._lib.store_count(self._h, _enc(bucket)))
+
+    def set_journal_cap(self, cap: int) -> None:
+        self._lib.store_set_journal_cap(self._h, cap)
+
+    def journal_since(self, since_rv: int, max_records: int = 0) -> List[JournalRecord]:
+        ptr = self._lib.store_journal_since(self._h, since_rv, max_records)
+        blob = self._take_str(ptr)
+        if blob is None:
+            raise JournalExpired(f"journal window expired before rv {since_rv}")
+        if not blob:
+            return []
+        out = []
+        for rec in blob.split(_REC):
+            rv_s, op_s, bucket, ns, name, obj_json = rec.split(_UNIT, 5)
+            out.append(
+                JournalRecord(int(rv_s), OPS[int(op_s)], bucket, ns, name, json.loads(obj_json))
+            )
+        return out
+
+
+def default_backend():
+    """Backend selection: KUBEFLOW_TPU_NATIVE=1 forces native, =0 forces
+    Python, unset prefers native and falls back ONLY when the toolchain is
+    genuinely unavailable — a broken native core (bad signature, crash in
+    store_new) must surface, not silently downgrade to the journal-less
+    fallback."""
+    mode = os.environ.get("KUBEFLOW_TPU_NATIVE", "").strip()
+    if mode == "0":
+        return DictBackend()
+    if mode == "1":
+        return NativeBackend()
+    try:
+        return NativeBackend()
+    except NativeUnavailable as e:
+        import logging
+
+        logging.getLogger("kubeflow_tpu.apiserver").warning(
+            "native store core unavailable, using Python fallback: %s", e
+        )
+        return DictBackend()

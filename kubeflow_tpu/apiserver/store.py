@@ -10,7 +10,12 @@ finalizer removal) — the profile-controller's teardown path depends on this
 
 Admission hooks run on pod writes before persistence — the seam where the
 PodDefault mutating webhook attaches (reference: admission-webhook/main.go:443).
-A C++ storage core can replace the dict backend behind the same interface.
+
+Persistence is delegated to a storage backend (kubeflow_tpu/apiserver/
+backend.py): the native C++ core (kubeflow_tpu/native/store_core.cc) by
+default — the analog of the reference's compiled control-plane binaries —
+with a pure-Python fallback. On the native backend, watches can resume from
+a resourceVersion via the write journal (etcd watch-window semantics).
 """
 
 from __future__ import annotations
@@ -25,6 +30,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api import meta as apimeta
 from ..api.meta import REGISTRY, Resource
+from .backend import DictBackend, JournalExpired, NativeBackend, default_backend  # noqa: F401
 
 
 class ApiError(Exception):
@@ -64,6 +70,11 @@ class Invalid(ApiError):
 class Forbidden(ApiError):
     code = 403
     reason = "Forbidden"
+
+
+class Expired(ApiError):
+    code = 410
+    reason = "Expired"
 
 
 @dataclass
@@ -108,10 +119,18 @@ class _Watcher:
 
     def close(self) -> None:
         self.closed = True
-        try:
-            self.queue.put_nowait(None)
-        except queue.Full:
-            pass
+        # The end-of-stream sentinel must ALWAYS arrive, or `for e in w`
+        # blocks forever after draining — evict events until it fits (the
+        # consumer is relisting anyway once it sees the stream closed).
+        while True:
+            try:
+                self.queue.put_nowait(None)
+                return
+            except queue.Full:
+                try:
+                    self.queue.get_nowait()
+                except queue.Empty:
+                    pass
 
     def __iter__(self):
         while True:
@@ -122,11 +141,9 @@ class _Watcher:
 
 
 class Store:
-    def __init__(self) -> None:
+    def __init__(self, backend=None) -> None:
         self._lock = threading.RLock()
-        self._rv = 0
-        # bucket key -> {(namespace or "", name) -> object}
-        self._data: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {}
+        self.backend = backend if backend is not None else default_backend()
         self._watchers: List[_Watcher] = []
         self._admission: List[AdmissionHook] = []
 
@@ -140,13 +157,6 @@ class Store:
         return obj
 
     # -- internals ----------------------------------------------------------
-    def _next_rv(self) -> str:
-        self._rv += 1
-        return str(self._rv)
-
-    def _bucket(self, res: Resource) -> Dict[Tuple[str, str], Dict[str, Any]]:
-        return self._data.setdefault(res.key, {})
-
     @staticmethod
     def _obj_key(res: Resource, namespace: Optional[str], name: str) -> Tuple[str, str]:
         return (namespace or "") if res.namespaced else "", name
@@ -180,26 +190,27 @@ class Store:
         obj = self._admit("CREATE", res, obj)
         md = obj.setdefault("metadata", {})  # hooks may return a fresh copy
         with self._lock:
-            bucket = self._bucket(res)
-            key = self._obj_key(res, md.get("namespace"), name)
-            if key in bucket:
-                raise Conflict(f"{res.kind} {'/'.join(k for k in key if k)} already exists")
+            ns, name = self._obj_key(res, md.get("namespace"), name)
+            if self.backend.contains(res.key, ns, name):
+                where = f"{ns}/{name}" if ns else name
+                raise Conflict(f"{res.kind} {where} already exists")
             md["uid"] = md.get("uid") or str(uuid.uuid4())
             md["creationTimestamp"] = self.now()
-            md["resourceVersion"] = self._next_rv()
+            rv = self.backend.next_rv()
+            md["resourceVersion"] = str(rv)
             md.setdefault("generation", 1)
-            bucket[key] = obj
+            self.backend.put(res.key, ns, name, obj, rv, "ADDED")
             self._notify(res, WatchEvent("ADDED", obj))
             return apimeta.deepcopy(obj)
 
     def get(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
-            bucket = self._bucket(res)
-            key = self._obj_key(res, namespace, name)
-            if key not in bucket:
+            ns, name = self._obj_key(res, namespace, name)
+            obj = self.backend.get(res.key, ns, name)
+            if obj is None:
                 where = f" in {namespace}" if res.namespaced else ""
                 raise NotFound(f'{res.kind} "{name}" not found{where}')
-            return apimeta.deepcopy(bucket[key])
+            return obj
 
     def list(
         self,
@@ -209,17 +220,10 @@ class Store:
         field_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
         with self._lock:
-            out = []
-            for (ns, _), obj in self._bucket(res).items():
-                if res.namespaced and namespace is not None and ns != namespace:
-                    continue
-                if label_selector:
-                    labels = apimeta.labels_of(obj)
-                    if any(labels.get(k) != v for k, v in label_selector.items()):
-                        continue
-                if field_selector and not _match_fields(obj, field_selector):
-                    continue
-                out.append(apimeta.deepcopy(obj))
+            ns = namespace if (res.namespaced and namespace is not None) else None
+            out = self.backend.list(res.key, ns, label_selector)
+            if field_selector:
+                out = [o for o in out if _match_fields(o, field_selector)]
             return out
 
     def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
@@ -227,11 +231,10 @@ class Store:
         obj = apimeta.deepcopy(obj)
         md = obj.setdefault("metadata", {})
         with self._lock:
-            bucket = self._bucket(res)
-            key = self._obj_key(res, md.get("namespace"), md.get("name", ""))
-            if key not in bucket:
+            ns, name = self._obj_key(res, md.get("namespace"), md.get("name", ""))
+            current = self.backend.get(res.key, ns, name)
+            if current is None:
                 raise NotFound(f'{res.kind} "{md.get("name")}" not found')
-            current = bucket[key]
             cur_md = current["metadata"]
             if md.get("resourceVersion") and md["resourceVersion"] != cur_md["resourceVersion"]:
                 raise Conflict(
@@ -261,13 +264,14 @@ class Store:
             # requeue itself forever (controllers in the reference rely on
             # apiserver-side semantic no-op detection the same way).
             if _equal_ignoring_rv(current, obj):
-                return apimeta.deepcopy(current)
-            md["resourceVersion"] = self._next_rv()
-            bucket[key] = obj
+                return current
+            rv = self.backend.next_rv()
+            md["resourceVersion"] = str(rv)
+            self.backend.put(res.key, ns, name, obj, rv, "MODIFIED")
             self._notify(res, WatchEvent("MODIFIED", obj))
             # Finalizer removal on a deleting object completes the delete.
             if md.get("deletionTimestamp") and not md.get("finalizers"):
-                del bucket[key]
+                self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
                 self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
 
@@ -290,20 +294,21 @@ class Store:
 
     def delete(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
         with self._lock:
-            bucket = self._bucket(res)
-            key = self._obj_key(res, namespace, name)
-            if key not in bucket:
+            ns, name = self._obj_key(res, namespace, name)
+            obj = self.backend.get(res.key, ns, name)
+            if obj is None:
                 where = f" in {namespace}" if res.namespaced else ""
                 raise NotFound(f'{res.kind} "{name}" not found{where}')
-            obj = bucket[key]
             md = obj["metadata"]
             if md.get("finalizers"):
                 if not md.get("deletionTimestamp"):
                     md["deletionTimestamp"] = self.now()
-                    md["resourceVersion"] = self._next_rv()
+                    rv = self.backend.next_rv()
+                    md["resourceVersion"] = str(rv)
+                    self.backend.put(res.key, ns, name, obj, rv, "MODIFIED")
                     self._notify(res, WatchEvent("MODIFIED", obj))
                 return apimeta.deepcopy(obj)
-            del bucket[key]
+            self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
             self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
 
@@ -326,11 +331,26 @@ class Store:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         send_initial: bool = False,
+        since_rv: Optional[int] = None,
     ) -> _Watcher:
+        """Open a watch stream. ``since_rv`` replays history from the write
+        journal (native backend only) before going live — etcd watch-window
+        semantics; raises Expired (410) when the window has been trimmed, in
+        which case the caller relists (informer resync)."""
         key = res.key if res else "*"
         w = _Watcher(key, namespace, label_selector)
         with self._lock:
-            if send_initial and res is not None:
+            if since_rv is not None:
+                if not getattr(self.backend, "journal_capable", False):
+                    raise Invalid("this backend keeps no journal; watch without since_rv")
+                try:
+                    records = self.backend.journal_since(since_rv)
+                except JournalExpired as e:
+                    raise Expired(str(e)) from None
+                for rec in records:
+                    if w.matches(rec.bucket, rec.object):
+                        w.send(WatchEvent(rec.type, rec.object))
+            elif send_initial and res is not None:
                 for obj in self.list(res, namespace=namespace, label_selector=label_selector):
                     w.send(WatchEvent("ADDED", obj))
             self._watchers.append(w)
@@ -345,17 +365,14 @@ class Store:
         """
         deleted = 0
         with self._lock:
-            uids = set()
-            for bucket in self._data.values():
-                for obj in bucket.values():
-                    uids.add(obj["metadata"]["uid"])
+            everything = self.backend.list_all()
+            uids = {obj["metadata"]["uid"] for _, obj in everything}
             doomed: List[Tuple[Resource, str, Optional[str]]] = []
-            for res_key, bucket in self._data.items():
-                for obj in bucket.values():
-                    refs = obj["metadata"].get("ownerReferences") or []
-                    if refs and all(r.get("uid") not in uids for r in refs):
-                        res = next(r for r in REGISTRY.all() if r.key == res_key)
-                        doomed.append((res, apimeta.name_of(obj), apimeta.namespace_of(obj)))
+            for res_key, obj in everything:
+                refs = obj["metadata"].get("ownerReferences") or []
+                if refs and all(r.get("uid") not in uids for r in refs):
+                    res = next(r for r in REGISTRY.all() if r.key == res_key)
+                    doomed.append((res, apimeta.name_of(obj), apimeta.namespace_of(obj)))
         for res, name, ns in doomed:
             try:
                 self.delete(res, name, ns)

@@ -1,0 +1,267 @@
+// Native storage core for the in-process API server.
+//
+// The reference's control plane is compiled (five Go binaries — SURVEY.md
+// §2.9); this is the TPU build's native runtime core: an MVCC object store
+// with a replayable write journal, built as a C shared library and bound
+// from Python via ctypes (kubeflow_tpu/apiserver/backend.py).
+//
+// Responsibilities (the storage hot path):
+//   - buckets of (namespace, name) -> {opaque blob, labels, revision},
+//   - a global monotonically increasing resourceVersion counter,
+//   - equality label-selector matching during list (without handing every
+//     object back to Python for filtering),
+//   - a bounded write journal keyed by revision — watchers can resume from
+//     a resourceVersion the way etcd watch windows work (the pure-Python
+//     fallback backend cannot replay history).
+//
+// Object semantics (admission, finalizers, status merge, GC) stay in
+// Python: blobs are opaque here. Wire formats across the ctypes boundary:
+//   labels/selector:  "k=v\x1fk2=v2"      (unit separator between pairs)
+//   list result:      blob \x1e blob ...  (record separator between blobs)
+//   journal records:  rv \x1f op \x1f bucket \x1f ns \x1f name \x1f blob,
+//                     records joined by \x1e
+// Blobs are JSON produced by json.dumps, which escapes control characters,
+// so 0x1e/0x1f never appear inside a blob.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace {
+
+constexpr char kRecordSep = '\x1e';
+constexpr char kUnitSep = '\x1f';
+
+struct Entry {
+  std::string blob;
+  std::map<std::string, std::string> labels;
+  uint64_t rv = 0;
+};
+
+struct JournalEntry {
+  uint64_t rv = 0;
+  int op = 0;  // 0 ADDED, 1 MODIFIED, 2 DELETED (assigned by the caller)
+  std::string bucket;
+  std::string ns;
+  std::string name;
+  std::string blob;
+};
+
+using Key = std::pair<std::string, std::string>;  // (namespace, name)
+
+struct StoreCore {
+  std::mutex mu;
+  uint64_t rv = 0;
+  std::map<std::string, std::map<Key, Entry>> buckets;
+  std::deque<JournalEntry> journal;
+  size_t journal_cap = 65536;
+};
+
+std::map<std::string, std::string> parse_pairs(const char* s) {
+  std::map<std::string, std::string> out;
+  if (s == nullptr || *s == '\0') return out;
+  const std::string text(s);
+  size_t start = 0;
+  while (start <= text.size()) {
+    size_t end = text.find(kUnitSep, start);
+    const std::string pair =
+        text.substr(start, end == std::string::npos ? std::string::npos : end - start);
+    size_t eq = pair.find('=');
+    if (eq != std::string::npos) {
+      out[pair.substr(0, eq)] = pair.substr(eq + 1);
+    }
+    if (end == std::string::npos) break;
+    start = end + 1;
+  }
+  return out;
+}
+
+bool selector_matches(const std::map<std::string, std::string>& labels,
+                      const std::map<std::string, std::string>& selector) {
+  for (const auto& kv : selector) {
+    auto it = labels.find(kv.first);
+    if (it == labels.end() || it->second != kv.second) return false;
+  }
+  return true;
+}
+
+char* dup_string(const std::string& s) {
+  char* out = static_cast<char*>(std::malloc(s.size() + 1));
+  if (out == nullptr) return nullptr;
+  std::memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* store_new() { return new StoreCore(); }
+
+void store_destroy(void* h) { delete static_cast<StoreCore*>(h); }
+
+uint64_t store_next_rv(void* h) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return ++s->rv;
+}
+
+uint64_t store_current_rv(void* h) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->rv;
+}
+
+// Insert or replace; appends a journal record with the caller's op code and
+// the entry's revision (which the caller must already have stamped into the
+// blob via store_next_rv).
+int store_put(void* h, const char* bucket, const char* ns, const char* name,
+              const char* blob, const char* labels, uint64_t rv, int op) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Entry e;
+  e.blob = blob ? blob : "";
+  e.labels = parse_pairs(labels);
+  e.rv = rv;
+  s->buckets[bucket][{ns ? ns : "", name ? name : ""}] = e;
+  s->journal.push_back({rv, op, bucket, ns ? ns : "", name ? name : "", e.blob});
+  while (s->journal.size() > s->journal_cap) s->journal.pop_front();
+  return 0;
+}
+
+// Returns a malloc'd copy of the blob, or nullptr if absent.
+char* store_get(void* h, const char* bucket, const char* ns, const char* name) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto b = s->buckets.find(bucket);
+  if (b == s->buckets.end()) return nullptr;
+  auto it = b->second.find({ns ? ns : "", name ? name : ""});
+  if (it == b->second.end()) return nullptr;
+  return dup_string(it->second.blob);
+}
+
+int store_contains(void* h, const char* bucket, const char* ns, const char* name) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto b = s->buckets.find(bucket);
+  if (b == s->buckets.end()) return 0;
+  return b->second.count({ns ? ns : "", name ? name : ""}) ? 1 : 0;
+}
+
+// Removes the entry and journals the caller-provided final blob (the object
+// state at deletion, which may differ from the stored blob after a
+// finalizer-driven update). Returns 0, or -1 if absent.
+int store_delete(void* h, const char* bucket, const char* ns, const char* name,
+                 const char* final_blob, uint64_t rv, int op) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto b = s->buckets.find(bucket);
+  if (b == s->buckets.end()) return -1;
+  auto it = b->second.find({ns ? ns : "", name ? name : ""});
+  if (it == b->second.end()) return -1;
+  std::string blob = final_blob ? final_blob : it->second.blob;
+  b->second.erase(it);
+  s->journal.push_back({rv, op, bucket, ns ? ns : "", name ? name : "", blob});
+  while (s->journal.size() > s->journal_cap) s->journal.pop_front();
+  return 0;
+}
+
+// Blobs of every entry in a bucket (optionally namespace- and
+// selector-filtered), joined by the record separator. filter_by_ns is an
+// explicit flag so the empty namespace ("" — cluster-scoped keys) remains
+// distinguishable from "all namespaces".
+char* store_list(void* h, const char* bucket, const char* ns, int filter_by_ns,
+                 const char* selector) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string out;
+  auto sel = parse_pairs(selector);
+  const bool filter_ns = filter_by_ns != 0;
+  auto b = s->buckets.find(bucket);
+  if (b != s->buckets.end()) {
+    for (const auto& kv : b->second) {
+      if (filter_ns && kv.first.first != (ns ? ns : "")) continue;
+      if (!sel.empty() && !selector_matches(kv.second.labels, sel)) continue;
+      if (!out.empty()) out.push_back(kRecordSep);
+      out += kv.second.blob;
+    }
+  }
+  return dup_string(out);
+}
+
+// Every entry in every bucket as "bucket \x1f blob" records (the GC sweep).
+char* store_list_all(void* h) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string out;
+  for (const auto& bucket : s->buckets) {
+    for (const auto& kv : bucket.second) {
+      if (!out.empty()) out.push_back(kRecordSep);
+      out += bucket.first;
+      out.push_back(kUnitSep);
+      out += kv.second.blob;
+    }
+  }
+  return dup_string(out);
+}
+
+// Journal records with rv > since_rv, oldest first, at most max records.
+// Returns nullptr (distinct from "") when since_rv has fallen out of the
+// journal window — the caller must relist, exactly like an expired etcd
+// watch.
+char* store_journal_since(void* h, uint64_t since_rv, int max) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  // Servable iff no record with rv > since_rv has been trimmed: trimmed
+  // records all have rv < front().rv, so the window holds exactly when
+  // since_rv >= front().rv - 1.
+  if (!s->journal.empty() && since_rv + 1 < s->journal.front().rv) {
+    return nullptr;  // window expired — caller must relist
+  }
+  std::string out;
+  int n = 0;
+  for (const auto& je : s->journal) {
+    if (je.rv <= since_rv) continue;
+    if (max > 0 && n >= max) break;
+    if (!out.empty()) out.push_back(kRecordSep);
+    out += std::to_string(je.rv);
+    out.push_back(kUnitSep);
+    out += std::to_string(je.op);
+    out.push_back(kUnitSep);
+    out += je.bucket;
+    out.push_back(kUnitSep);
+    out += je.ns;
+    out.push_back(kUnitSep);
+    out += je.name;
+    out.push_back(kUnitSep);
+    out += je.blob;
+    ++n;
+  }
+  return dup_string(out);
+}
+
+// Bound the journal window (testing + memory control; default 65536).
+void store_set_journal_cap(void* h, uint64_t cap) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  s->journal_cap = cap == 0 ? 1 : static_cast<size_t>(cap);
+  while (s->journal.size() > s->journal_cap) s->journal.pop_front();
+}
+
+uint64_t store_count(void* h, const char* bucket) {
+  StoreCore* s = static_cast<StoreCore*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto b = s->buckets.find(bucket);
+  return b == s->buckets.end() ? 0 : b->second.size();
+}
+
+void store_free_str(char* p) { std::free(p); }
+
+}  // extern "C"
