@@ -106,7 +106,9 @@ class DictBackend:
     def count(self, bucket: str) -> int:
         return len(self._data.get(bucket, {}))
 
-    def journal_since(self, since_rv: int, max_records: int = 0) -> List[JournalRecord]:
+    def journal_since(
+        self, since_rv: int, max_records: int = 0, bucket: Optional[str] = None
+    ) -> List[JournalRecord]:
         raise NotImplementedError("DictBackend keeps no journal")
 
 
@@ -126,7 +128,13 @@ def _build_native() -> str:
     """make the shared library if absent (idempotent, serialized)."""
     with _build_lock:
         src = os.path.join(_NATIVE_DIR, "store_core.cc")
-        if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        have_so = os.path.exists(_SO_PATH)
+        if not os.path.exists(src):
+            # Artifact-based install: source stripped, prebuilt .so shipped.
+            if have_so:
+                return _SO_PATH
+            raise NativeUnavailable(f"neither {_SO_PATH} nor its source exists")
+        if have_so and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
             return _SO_PATH
         try:
             proc = subprocess.run(
@@ -169,7 +177,12 @@ def load_native_lib() -> ctypes.CDLL:
     lib.store_list.restype = ctypes.c_void_p
     lib.store_list_all.argtypes = [ctypes.c_void_p]
     lib.store_list_all.restype = ctypes.c_void_p
-    lib.store_journal_since.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.store_journal_since.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_char_p,
+    ]
     lib.store_journal_since.restype = ctypes.c_void_p
     lib.store_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.store_count.restype = ctypes.c_uint64
@@ -207,6 +220,16 @@ class NativeBackend:
             self._lib.store_free_str(ptr)
 
     @staticmethod
+    def _check_key(*parts: str) -> None:
+        """Bucket/namespace/name flow raw into the journal wire format —
+        separator bytes in them would misalign journal records for every
+        future watch resume, so reject at the write boundary (real
+        Kubernetes DNS-1123 names can't contain them either)."""
+        for p in parts:
+            if _UNIT in p or _REC in p:
+                raise ValueError(f"object key not representable on the native wire: {p!r}")
+
+    @staticmethod
     def _pairs_flat(pairs: Dict[str, str]) -> str:
         """Flatten k=v pairs for the C boundary, rejecting anything that
         would corrupt the wire format (keys with '=', separator bytes) —
@@ -240,6 +263,7 @@ class NativeBackend:
         return None if blob is None else json.loads(blob)
 
     def put(self, bucket: str, ns: str, name: str, obj: Dict[str, Any], rv: int, op: str) -> None:
+        self._check_key(bucket, ns, name)
         self._lib.store_put(
             self._h,
             _enc(bucket),
@@ -252,6 +276,7 @@ class NativeBackend:
         )
 
     def delete(self, bucket: str, ns: str, name: str, final_obj: Dict[str, Any], rv: int) -> None:
+        self._check_key(bucket, ns, name)
         self._lib.store_delete(
             self._h,
             _enc(bucket),
@@ -294,8 +319,10 @@ class NativeBackend:
     def set_journal_cap(self, cap: int) -> None:
         self._lib.store_set_journal_cap(self._h, cap)
 
-    def journal_since(self, since_rv: int, max_records: int = 0) -> List[JournalRecord]:
-        ptr = self._lib.store_journal_since(self._h, since_rv, max_records)
+    def journal_since(
+        self, since_rv: int, max_records: int = 0, bucket: Optional[str] = None
+    ) -> List[JournalRecord]:
+        ptr = self._lib.store_journal_since(self._h, since_rv, max_records, _enc(bucket))
         blob = self._take_str(ptr)
         if blob is None:
             raise JournalExpired(f"journal window expired before rv {since_rv}")

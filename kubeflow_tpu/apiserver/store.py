@@ -344,7 +344,11 @@ class Store:
                 if not getattr(self.backend, "journal_capable", False):
                     raise Invalid("this backend keeps no journal; watch without since_rv")
                 try:
-                    records = self.backend.journal_since(since_rv)
+                    # Single-bucket watches filter in the C core — a resume
+                    # must not marshal the whole journal.
+                    records = self.backend.journal_since(
+                        since_rv, bucket=res.key if res else None
+                    )
                 except JournalExpired as e:
                     raise Expired(str(e)) from None
                 for rec in records:

@@ -212,11 +212,13 @@ char* store_list_all(void* h) {
   return dup_string(out);
 }
 
-// Journal records with rv > since_rv, oldest first, at most max records.
+// Journal records with rv > since_rv, oldest first, at most max records,
+// optionally filtered to one bucket (empty = all buckets — filtering here
+// keeps a single-bucket resume from marshalling the whole journal).
 // Returns nullptr (distinct from "") when since_rv has fallen out of the
 // journal window — the caller must relist, exactly like an expired etcd
 // watch.
-char* store_journal_since(void* h, uint64_t since_rv, int max) {
+char* store_journal_since(void* h, uint64_t since_rv, int max, const char* bucket) {
   StoreCore* s = static_cast<StoreCore*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   // Servable iff no record with rv > since_rv has been trimmed: trimmed
@@ -225,10 +227,12 @@ char* store_journal_since(void* h, uint64_t since_rv, int max) {
   if (!s->journal.empty() && since_rv + 1 < s->journal.front().rv) {
     return nullptr;  // window expired — caller must relist
   }
+  const bool filter_bucket = (bucket != nullptr && *bucket != '\0');
   std::string out;
   int n = 0;
   for (const auto& je : s->journal) {
     if (je.rv <= since_rv) continue;
+    if (filter_bucket && je.bucket != bucket) continue;
     if (max > 0 && n >= max) break;
     if (!out.empty()) out.push_back(kRecordSep);
     out += std::to_string(je.rv);

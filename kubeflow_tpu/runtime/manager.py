@@ -24,6 +24,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Store, WatchEvent
 from .metrics import METRICS
+from .tracing import TRACER
 
 log = logging.getLogger("kubeflow_tpu.runtime")
 
@@ -184,7 +185,14 @@ class _Controller:
                 return
             start = time.monotonic()
             try:
-                result = self.reconciler.reconcile(client, req) or Result()
+                with TRACER.span(
+                    "reconcile",
+                    controller=self.name,
+                    request=f"{req.namespace or ''}/{req.name}",
+                ) as span:
+                    result = self.reconciler.reconcile(client, req) or Result()
+                    if result.requeue_after > 0:
+                        span.set("requeue_after_s", result.requeue_after)
                 self.queue.forget(req)
                 if result.requeue_after > 0:
                     self.queue.add_after(req, result.requeue_after)

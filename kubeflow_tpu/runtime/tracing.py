@@ -1,0 +1,187 @@
+"""Tracing: spans over reconcile loops and HTTP handlers.
+
+The reference has NO tracing (SURVEY.md §5 — the closest thing is the
+culler's HTTP probe); this is green-field for the TPU build. Design goals:
+
+- OpenTelemetry wire vocabulary (traceId/spanId/parentSpanId, nanosecond
+  epochs, status, attributes) so exported JSON loads into any OTLP-adjacent
+  tooling without translation,
+- zero hard dependency: stdlib only, in-memory ring buffer by default, an
+  optional JSON-lines file exporter (KUBEFLOW_TPU_TRACE_FILE),
+- near-zero overhead when idle: span creation is a couple of dict ops; no
+  locks on the hot path beyond the ring append,
+- context propagation: thread-local current span, so nested spans parent
+  automatically (reconcile → store call → notify), and an explicit
+  ``traceparent`` header codec for cross-service HTTP hops (the
+  dashboard BFF → KFAM call chain).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+_local = threading.local()
+
+
+def _rand_hex(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+    start_ns: int = 0
+    end_ns: int = 0
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[Dict[str, Any]] = field(default_factory=list)
+    status: str = "OK"  # OK | ERROR
+    status_message: str = ""
+
+    def set(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def add_event(self, name: str, **attrs: Any) -> "Span":
+        self.events.append({"name": name, "timeUnixNano": time.time_ns(), "attributes": attrs})
+        return self
+
+    def record_error(self, exc: BaseException) -> "Span":
+        self.status = "ERROR"
+        self.status_message = f"{type(exc).__name__}: {exc}"
+        return self
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "startTimeUnixNano": self.start_ns,
+            "endTimeUnixNano": self.end_ns,
+            "status": {"code": self.status, "message": self.status_message},
+            "attributes": self.attributes,
+        }
+        if self.parent_span_id:
+            d["parentSpanId"] = self.parent_span_id
+        if self.events:
+            d["events"] = self.events
+        return d
+
+
+class Tracer:
+    """Span factory + ring-buffer store (+ optional JSON-lines export)."""
+
+    def __init__(self, service: str = "kubeflow-tpu", capacity: int = 4096,
+                 export_path: Optional[str] = None):
+        self.service = service
+        self._spans: Deque[Span] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._export_path = export_path or os.environ.get("KUBEFLOW_TPU_TRACE_FILE")
+        self._export_file = None  # opened lazily, kept for the tracer's life
+
+    # -- context -------------------------------------------------------------
+    @staticmethod
+    def current_span() -> Optional[Span]:
+        return getattr(_local, "span", None)
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        traceparent: Optional[str] = None,
+        **attributes: Any,
+    ) -> Iterator[Span]:
+        """Open a span; parents to (in order) the explicit parent, a
+        ``traceparent`` header, or the thread-local current span."""
+        if parent is None and traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                trace_id, parent_span_id = parsed
+                parent = Span("remote", trace_id, parent_span_id)
+        if parent is None:
+            parent = self.current_span()
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else _rand_hex(16),
+            span_id=_rand_hex(8),
+            parent_span_id=parent.span_id if parent else None,
+            start_ns=time.time_ns(),
+            attributes={"service.name": self.service, **attributes},
+        )
+        prev = self.current_span()
+        _local.span = span
+        try:
+            yield span
+        except BaseException as e:
+            span.record_error(e)
+            raise
+        finally:
+            span.end_ns = time.time_ns()
+            _local.span = prev
+            self._record(span)
+
+    # -- storage / export ----------------------------------------------------
+    def _record(self, span: Span) -> None:
+        line = json.dumps(span.to_dict()) + "\n" if self._export_path else None
+        with self._lock:
+            self._spans.append(span)
+            if line is not None:
+                try:
+                    if self._export_file is None:
+                        self._export_file = open(self._export_path, "a")
+                    self._export_file.write(line)
+                    self._export_file.flush()
+                except OSError:
+                    pass  # tracing must never take the control plane down
+
+    def finished_spans(self, name: Optional[str] = None, trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            spans = list(self._spans)
+        if name is not None:
+            spans = [s for s in spans if s.name == name]
+        if trace_id is not None:
+            spans = [s for s in spans if s.trace_id == trace_id]
+        return spans
+
+    def trace_tree(self, trace_id: str) -> Dict[str, List[Span]]:
+        """children-by-parent index of one trace (test/debug helper)."""
+        tree: Dict[str, List[Span]] = {}
+        for s in self.finished_spans(trace_id=trace_id):
+            tree.setdefault(s.parent_span_id or "", []).append(s)
+        return tree
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# -- W3C traceparent codec (the cross-service hop) ---------------------------
+
+def format_traceparent(span: Span) -> str:
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def parse_traceparent(header: str) -> Optional[tuple]:
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+#: process-global tracer (mirrors METRICS's process-global registry)
+TRACER = Tracer()

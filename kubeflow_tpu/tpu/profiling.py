@@ -1,0 +1,81 @@
+"""JAX profiler integration — the TPU answer to the reference's CUPTI
+plumbing (jupyter-tensorflow/cuda.Dockerfile:61-71 LD_LIBRARY_PATH surgery;
+on TPU the profiler ships with JAX and needs wiring, not drivers).
+
+Used by the notebook/serving images (images/jupyter-jax-tpu exposes :9999)
+and by bench/perf work: start a profile server for TensorBoard's profile
+plugin to connect to, or capture a step trace programmatically and read
+back where the time went.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Optional
+
+PROFILE_PORT = 9999
+
+_server_lock = threading.Lock()
+_server_started_port: Optional[int] = None
+
+
+def start_profile_server(port: int = PROFILE_PORT) -> int:
+    """Start the in-process profiler gRPC server (idempotent). TensorBoard's
+    profile plugin captures from it: tensorboard --logdir=... then
+    'capture profile' at <pod-dns>:<port> — reachable through the headless
+    service the notebook controller creates."""
+    global _server_started_port
+    import jax
+
+    with _server_lock:
+        if _server_started_port is not None:
+            if _server_started_port != port:
+                raise RuntimeError(
+                    f"profiler server already on port {_server_started_port}; "
+                    f"cannot also serve {port} (one server per process)"
+                )
+            return _server_started_port
+        jax.profiler.start_server(port)
+        _server_started_port = port
+        return port
+
+
+@contextmanager
+def step_trace(logdir: str, name: str = "step"):
+    """Capture a programmatic trace into ``logdir`` (xplane protos readable
+    by TensorBoard/XProf). Use around a handful of steps, not whole runs."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+def annotate(name: str):
+    """Named region inside a trace (shows as a range in the timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def profile_step(
+    fn: Callable[..., Any], *args: Any, logdir: str, iters: int = 3, **kwargs: Any
+) -> Dict[str, Any]:
+    """Run ``fn`` under the profiler (after one untraced warmup for compile)
+    and return {result, trace_files}. The capture covers ``iters`` steps so
+    steady-state behavior dominates over first-step noise."""
+    import jax
+
+    result = fn(*args, **kwargs)  # warmup/compile outside the trace
+    jax.block_until_ready(result)
+    with step_trace(logdir):
+        for _ in range(iters):
+            result = fn(*args, **kwargs)
+        jax.block_until_ready(result)
+    traces = sorted(
+        glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
+    )
+    return {"result": result, "trace_files": traces}

@@ -116,6 +116,21 @@ class App:
 
     # -- dispatch ------------------------------------------------------------
     def dispatch(self, req: Request) -> JsonResponse:
+        from ..runtime.tracing import TRACER  # late import: web ↛ runtime cycle
+
+        with TRACER.span(
+            f"{self.name} {req.method}",
+            traceparent=req.header("traceparent") or None,
+            **{"http.method": req.method, "http.target": req.path, "app": self.name},
+        ) as span:
+            resp = self._dispatch_inner(req)
+            span.set("http.status_code", resp.status)
+            if resp.status >= 500:
+                span.status = "ERROR"
+                span.status_message = f"HTTP {resp.status}"
+            return resp
+
+    def _dispatch_inner(self, req: Request) -> JsonResponse:
         try:
             for mw in self._middleware:
                 short = mw(req)

@@ -176,6 +176,24 @@ class TestParityEdges:
         with pytest.raises(ValueError, match="not representable"):
             b.list("k", None, {"a=b": "c"})
 
+    def test_unrepresentable_key_rejected_loudly(self):
+        """Separator bytes in ns/name would misalign journal records for
+        every later watch resume — reject at the write boundary."""
+        b = NativeBackend()
+        with pytest.raises(ValueError, match="not representable"):
+            b.put("k", "", "a\x1fb", {"metadata": {"name": "a\x1fb"}}, 1, "ADDED")
+        with pytest.raises(ValueError, match="not representable"):
+            b.delete("k", "n\x1es", "x", {}, 2)
+
+    def test_journal_bucket_filter(self):
+        b = NativeBackend()
+        b.put("b1", "n", "x", {"metadata": {"name": "x"}}, 1, "ADDED")
+        b.put("b2", "n", "y", {"metadata": {"name": "y"}}, 2, "ADDED")
+        b.put("b1", "n", "z", {"metadata": {"name": "z"}}, 3, "ADDED")
+        only_b1 = b.journal_since(0, bucket="b1")
+        assert [r.name for r in only_b1] == ["x", "z"]
+        assert len(b.journal_since(0)) == 3  # unfiltered sees everything
+
     def test_watch_resume_overflow_still_terminates(self, native_store):
         """Replaying more history than the watcher queue holds must close the
         stream WITH its end sentinel — the consumer loop terminates and

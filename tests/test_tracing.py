@@ -1,0 +1,153 @@
+"""Tracing subsystem: span model, context propagation, runtime + HTTP
+integration, JAX profiler capture (SURVEY §5 — green-field for this build)."""
+
+import json
+import threading
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.runtime.tracing import (
+    TRACER,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    TRACER.reset()
+    yield
+    TRACER.reset()
+
+
+class TestSpans:
+    def test_nesting_parents_automatically(self):
+        t = Tracer("t")
+        with t.span("outer") as outer:
+            with t.span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_span_id == outer.span_id
+        assert outer.parent_span_id is None
+        # both finished, inner first
+        names = [s.name for s in t.finished_spans()]
+        assert names == ["inner", "outer"]
+        assert all(s.end_ns >= s.start_ns for s in t.finished_spans())
+
+    def test_error_recorded_and_reraised(self):
+        t = Tracer("t")
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("no")
+        (span,) = t.finished_spans()
+        assert span.status == "ERROR" and "ValueError" in span.status_message
+
+    def test_traceparent_roundtrip(self):
+        t = Tracer("t")
+        with t.span("client") as client_span:
+            header = format_traceparent(client_span)
+        with t.span("server", traceparent=header) as server_span:
+            pass
+        assert server_span.trace_id == client_span.trace_id
+        assert server_span.parent_span_id == client_span.span_id
+        assert parse_traceparent("garbage") is None
+        assert parse_traceparent("00-" + "a" * 32 + "-" + "b" * 16 + "-01") == ("a" * 32, "b" * 16)
+
+    def test_threads_do_not_share_context(self):
+        t = Tracer("t")
+        seen = {}
+
+        def worker():
+            with t.span("thread-span") as s:
+                seen["parent"] = s.parent_span_id
+
+        with t.span("main"):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert seen["parent"] is None  # no cross-thread parenting
+
+    def test_ring_buffer_bounded(self):
+        t = Tracer("t", capacity=10)
+        for i in range(25):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.finished_spans()
+        assert len(spans) == 10 and spans[0].name == "s15"
+
+    def test_export_jsonl(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        t = Tracer("svc", export_path=str(path))
+        with t.span("a", key="v"):
+            pass
+        rec = json.loads(path.read_text().strip())
+        assert rec["name"] == "a" and rec["attributes"]["key"] == "v"
+        assert rec["status"]["code"] == "OK"
+        assert len(rec["traceId"]) == 32 and len(rec["spanId"]) == 16
+
+
+class TestRuntimeIntegration:
+    def test_reconciles_emit_spans(self):
+        mgr = build_platform().start()
+        try:
+            mgr.client.create(
+                new_object("v1", "Pod", "traced", "default", spec={"containers": [{"name": "c"}]})
+            )
+            assert mgr.wait_idle(10)
+        finally:
+            mgr.stop()
+        spans = TRACER.finished_spans(name="reconcile")
+        assert spans, "no reconcile spans recorded"
+        podlet = [s for s in spans if s.attributes.get("controller") == "PodletReconciler"]
+        assert podlet and podlet[0].attributes["request"] == "default/traced"
+        assert podlet[0].trace_id and podlet[0].duration_ms >= 0
+
+    def test_http_spans_propagate_traceparent(self):
+        from kubeflow_tpu.apiserver.store import Store
+        from kubeflow_tpu.apiserver.client import Client
+        from kubeflow_tpu.services.kfam import make_kfam_app
+        from kubeflow_tpu.web.auth import AuthConfig
+
+        client = Client(Store())
+        app = make_kfam_app(client, AuthConfig(cluster_admins=["root@x"]))
+        with TRACER.span("caller") as caller:
+            header = format_traceparent(caller)
+            resp = app.call(
+                "GET",
+                "/kfam/v1/role/clusteradmin",
+                headers={"kubeflow-userid": "root@x", "traceparent": header},
+            )
+        assert resp.status == 200
+        server_spans = [s for s in TRACER.finished_spans() if s.name.startswith("kfam ")]
+        assert server_spans and server_spans[0].trace_id == caller.trace_id
+        assert server_spans[0].attributes["http.status_code"] == 200
+
+
+class TestProfiler:
+    def test_port_conflict_raises(self):
+        import kubeflow_tpu.tpu.profiling as prof
+
+        with prof._server_lock:
+            prev = prof._server_started_port
+            prof._server_started_port = 9999
+        try:
+            assert prof.start_profile_server(9999) == 9999  # idempotent same port
+            with pytest.raises(RuntimeError, match="already on port"):
+                prof.start_profile_server(9005)
+        finally:
+            with prof._server_lock:
+                prof._server_started_port = prev
+
+    def test_profile_step_captures_xplane(self, tmp_path):
+        import jax.numpy as jnp
+        from kubeflow_tpu.tpu.profiling import profile_step
+
+        def step(x):
+            return (x @ x).sum()
+
+        out = profile_step(step, jnp.ones((64, 64)), logdir=str(tmp_path))
+        assert float(out["result"]) == 64.0 * 64 * 64
+        assert out["trace_files"], "no xplane trace captured"
