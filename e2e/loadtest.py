@@ -1,0 +1,111 @@
+"""Controller scalability probe — the analog of the reference's
+notebook-controller loadtest (loadtest/start_notebooks.py:1-12 spawns N
+Notebook CRs + PVCs and leaves observation to the operator; SURVEY.md §6
+lists it as the only in-tree performance tooling).
+
+This version measures instead of just spawning: N TPU notebooks spawn
+through the full path (CR → controller → webhook → scheduler), and the
+probe reports time-to-all-running, reconcile throughput, and steady-state
+churn (stop/start waves). Run:  python -m e2e.loadtest [-n 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.store import Conflict
+from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION
+from kubeflow_tpu.runtime.metrics import METRICS
+
+from .cluster import E2ECluster, wait_for_condition
+from .retry import run_with_retry
+
+NOTEBOOK_API = "kubeflow.org/v1beta1"
+
+
+def mknotebook(i: int, ns: str) -> Dict[str, Any]:
+    return new_object(
+        NOTEBOOK_API,
+        "Notebook",
+        f"load-{i}",
+        ns,
+        spec={"template": {"spec": {"containers": [{"name": "nb", "image": "jupyter-jax"}]}}},
+    )
+
+
+def run_loadtest(n: int = 50, timeout: float = 120.0) -> Dict[str, Any]:
+    # Single-host notebooks (no TPU block): the probe stresses the reconcile
+    # plane, not the fake scheduler's capacity math.
+    with E2ECluster(nodes=[]) as cluster:
+        ns = cluster.create_profile("load@example.com", "loadtest")
+        reconciles_before = METRICS.total("controller_reconcile_total")
+
+        def running_count() -> int:
+            sts = cluster.client.list("apps/v1", "StatefulSet", ns)
+            return sum(1 for s in sts if (s.get("status") or {}).get("readyReplicas", 0) >= 1)
+
+        def annotate(i: int, stop: bool) -> None:
+            """get→modify→update with Conflict retry: the controller's status
+            writes bump resourceVersion concurrently (optimistic-concurrency
+            loop, same shape as client-go's RetryOnConflict)."""
+
+            def attempt() -> None:
+                nb = cluster.client.get(NOTEBOOK_API, "Notebook", f"load-{i}", ns)
+                anns = nb["metadata"].setdefault("annotations", {})
+                if stop:
+                    anns[STOP_ANNOTATION] = "now"
+                else:
+                    anns.pop(STOP_ANNOTATION, None)
+                cluster.client.update(nb)
+
+            run_with_retry(attempt, retries=10, delay=0.02, retry_on=(Conflict,))
+
+        t0 = time.perf_counter()
+        for i in range(n):
+            cluster.client.create(mknotebook(i, ns))
+        t_created = time.perf_counter() - t0
+
+        wait_for_condition(
+            lambda: running_count() == n, timeout, desc=f"{n} notebooks running"
+        )
+        t_all_running = time.perf_counter() - t0
+
+        # Stop/start wave: every notebook scales 1→0→1 (culling churn shape).
+        t1 = time.perf_counter()
+        for i in range(n):
+            annotate(i, stop=True)
+        wait_for_condition(lambda: running_count() == 0, timeout, desc="all stopped")
+        for i in range(n):
+            annotate(i, stop=False)
+        wait_for_condition(lambda: running_count() == n, timeout, desc="all restarted")
+        t_churn = time.perf_counter() - t1
+
+        # Delta against the pre-run snapshot: METRICS is process-global and
+        # may carry counts from earlier work in the same process.
+        reconciles = METRICS.total("controller_reconcile_total") - reconciles_before
+        return {
+            "notebooks": n,
+            "create_seconds": round(t_created, 3),
+            "all_running_seconds": round(t_all_running, 3),
+            "stop_start_wave_seconds": round(t_churn, 3),
+            "notebooks_per_second": round(n / t_all_running, 1),
+            "reconciles_total": int(reconciles),
+        }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-n", type=int, default=50)
+    parser.add_argument("--timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+    print(json.dumps(run_loadtest(args.n, args.timeout)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

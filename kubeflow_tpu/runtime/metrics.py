@@ -82,6 +82,13 @@ class MetricsRegistry:
     def histogram(self, name: str, **labels: str) -> _Histogram:
         return self._get(name, "histogram", _Histogram, labels)
 
+    def total(self, name: str) -> float:
+        """Sum of a counter/gauge across every label combination."""
+        with self._lock:
+            return sum(
+                getattr(m, "value", 0.0) for m in self._metrics.get(name, {}).values()
+            )
+
     def value(self, name: str, **labels: str) -> float:
         with self._lock:
             series = self._metrics.get(name, {})

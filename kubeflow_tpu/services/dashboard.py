@@ -20,6 +20,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..controllers.profile import PROFILE_API
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth
 from ..web.http import App, HttpError, JsonResponse, Request
 
@@ -244,4 +245,5 @@ def make_dashboard_app(
         )
         return contributors(req)
 
+    install_spa(app, load_ui("dashboard.html"), cfg)
     return app

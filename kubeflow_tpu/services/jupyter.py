@@ -33,6 +33,7 @@ from ..tpu.topology import (
     NODE_LABEL_TOPOLOGY,
     RESOURCE_TPU,
 )
+from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
 from ..web.http import App, HttpError, JsonResponse, Request
 from .spawner_config import SpawnerConfig
@@ -216,16 +217,19 @@ def make_jupyter_app(
         authorizer.ensure(user(req), "update", ns)
         body = req.json or {}
         stopped = body.get("stopped")
-        nb = client.get_opt(NOTEBOOK_API, "Notebook", name, ns)
-        if nb is None:
+        if client.get_opt(NOTEBOOK_API, "Notebook", name, ns) is None:
             raise HttpError(404, "notebook not found")
-        nb = apimeta.deepcopy(nb)
-        anns = nb["metadata"].setdefault("annotations", {})
-        if stopped:
-            anns[STOP_ANNOTATION] = client.store.now()
-        else:
-            anns.pop(STOP_ANNOTATION, None)
-        client.update(nb)
+        # Atomic merge-patch (reference patch.py PATCHes the annotation the
+        # same way): a get→update here would race the controller's status
+        # writes and surface spurious 409s to the UI.
+        value = client.store.now() if stopped else None
+        client.patch(
+            NOTEBOOK_API,
+            "Notebook",
+            name,
+            {"metadata": {"annotations": {STOP_ANNOTATION: value}}},
+            ns,
+        )
         return {"status": "stopped" if stopped else "started"}
 
     @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=("DELETE",))
@@ -235,6 +239,7 @@ def make_jupyter_app(
         client.delete(NOTEBOOK_API, "Notebook", name, ns)
         return {"status": "deleted"}
 
+    install_spa(app, load_ui("jupyter.html"), cfg)
     return app
 
 

@@ -13,6 +13,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
 from ..controllers.tensorboard import TB_API, parse_logspath
+from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
 from ..web.http import App, HttpError, JsonResponse, Request
 
@@ -70,4 +71,5 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
         client.delete(TB_API, "Tensorboard", req.params["name"], req.params["ns"])
         return {"status": "deleted"}
 
+    install_spa(app, load_ui("tensorboards.html"), cfg)
     return app

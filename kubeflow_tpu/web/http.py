@@ -71,9 +71,20 @@ class JsonResponse:
     headers: Dict[str, str] = field(default_factory=dict)
     cookies: List[str] = field(default_factory=list)  # raw Set-Cookie values
 
+    @property
+    def content_type(self) -> str:
+        for k, v in self.headers.items():
+            if k.lower() == "content-type":
+                return v
+        return "application/json"
+
     def encode(self) -> bytes:
         if self.body is None:
             return b""
+        if isinstance(self.body, bytes):
+            return self.body
+        if isinstance(self.body, str) and not self.content_type.startswith("application/json"):
+            return self.body.encode()
         return json.dumps(self.body).encode()
 
 
@@ -204,10 +215,11 @@ class AppServer:
                 resp = outer.app.dispatch(req)
                 payload = resp.encode()
                 self.send_response(resp.status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", resp.content_type)
                 self.send_header("Content-Length", str(len(payload)))
                 for k, v in resp.headers.items():
-                    self.send_header(k, v)
+                    if k.lower() != "content-type":  # already sent above
+                        self.send_header(k, v)
                 for c in resp.cookies:
                     self.send_header("Set-Cookie", c)
                 self.end_headers()

@@ -1,0 +1,52 @@
+"""SPA index serving — the crud_backend ``serving.py`` contract
+(reference: crud-web-apps/common/.../serving.py:18-31): the index page is
+served with an ETag and ``Cache-Control: no-cache`` (clients revalidate
+every load, 304 when unchanged) and every index response refreshes the
+CSRF double-submit cookie so the SPA can immediately make unsafe calls.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+from .auth import AuthConfig, issue_csrf_cookie
+from .http import App, JsonResponse, Request
+
+UI_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ui")
+
+
+def load_ui(name: str) -> str:
+    """Load a UI page, inlining the shared runtime (single-file responses —
+    no extra asset routes to secure or cache)."""
+    with open(os.path.join(UI_DIR, name)) as f:
+        html = f.read()
+    for fname, open_tag, close_tag in (
+        ("common.js", "<script>", "</script>"),
+        ("style.css", "<style>", "</style>"),
+    ):
+        include = f"<!--#include {fname}-->"
+        if include in html:
+            with open(os.path.join(UI_DIR, fname)) as f:
+                html = html.replace(include, f"{open_tag}\n{f.read()}\n{close_tag}")
+    return html
+
+
+def install_spa(app: App, html: str, cfg: Optional[AuthConfig] = None,
+                paths: tuple = ("/", "/index.html")) -> None:
+    cfg = cfg or AuthConfig()
+    etag = '"' + hashlib.sha256(html.encode()).hexdigest()[:32] + '"'
+
+    def serve_index(req: Request) -> JsonResponse:
+        if req.header("if-none-match") == etag:
+            resp = JsonResponse(None, status=304)
+        else:
+            resp = JsonResponse(html, headers={"Content-Type": "text/html; charset=utf-8"})
+        resp.headers["ETag"] = etag
+        resp.headers["Cache-Control"] = "no-cache"
+        issue_csrf_cookie(resp, cfg)
+        return resp
+
+    for path in paths:
+        app.route(path)(serve_index)

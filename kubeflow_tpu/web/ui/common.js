@@ -1,0 +1,69 @@
+// Shared SPA runtime for the platform web apps (the kubeflow-common-lib
+// analog, reference: crud-web-apps/common/frontend — reduced to the pieces
+// the backends actually serve: fetch with identity passthrough + CSRF
+// double-submit, table rendering, status badges, polling).
+"use strict";
+
+function cookie(name) {
+  const m = document.cookie.match(new RegExp("(?:^|; )" + name + "=([^;]*)"));
+  return m ? decodeURIComponent(m[1]) : null;
+}
+
+async function api(method, path, body) {
+  const headers = { "content-type": "application/json" };
+  const token = cookie("XSRF-TOKEN");
+  if (token) headers["x-xsrf-token"] = token;
+  const resp = await fetch(path, {
+    method,
+    headers,
+    credentials: "same-origin",
+    body: body === undefined ? undefined : JSON.stringify(body),
+  });
+  const text = await resp.text();
+  const data = text ? JSON.parse(text) : null;
+  if (!resp.ok) throw new Error((data && data.error) || resp.statusText);
+  return data;
+}
+
+function el(tag, attrs, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "onclick") node.onclick = v;
+    else node.setAttribute(k, v);
+  }
+  for (const c of children) {
+    node.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return node;
+}
+
+function renderTable(mount, columns, rows) {
+  const table = el("table", { class: "tbl" });
+  table.append(
+    el("thead", {}, el("tr", {}, ...columns.map((c) => el("th", {}, c.title))))
+  );
+  const tbody = el("tbody");
+  for (const row of rows) {
+    tbody.append(el("tr", {}, ...columns.map((c) => el("td", {}, c.render(row)))));
+  }
+  if (!rows.length) {
+    tbody.append(
+      el("tr", {}, el("td", { colspan: String(columns.length), class: "empty" }, "none"))
+    );
+  }
+  table.append(tbody);
+  mount.replaceChildren(table);
+}
+
+function statusBadge(phase) {
+  return el("span", { class: "badge badge-" + phase }, phase);
+}
+
+function nsParam() {
+  return new URLSearchParams(location.search).get("ns") || "kubeflow-user";
+}
+
+function poll(fn, ms) {
+  fn().catch(() => {});
+  return setInterval(() => fn().catch(() => {}), ms || 3000);
+}

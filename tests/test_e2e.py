@@ -30,6 +30,16 @@ class TestDrivers:
         assert result["hosts"] == 2
 
 
+class TestLoadtest:
+    def test_loadtest_probe(self):
+        from e2e.loadtest import run_loadtest
+
+        result = run_loadtest(n=10, timeout=60.0)
+        assert result["notebooks"] == 10
+        assert result["all_running_seconds"] > 0
+        assert result["reconciles_total"] > 0
+
+
 class TestHarnessUtilities:
     def test_junit_xml_shape(self):
         suite = TestSuite("s")

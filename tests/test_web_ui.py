@@ -1,0 +1,128 @@
+"""Web UI plane: SPA index contract + page/API coherence.
+
+The reference serves Angular/Polymer SPAs through crud_backend's
+``serving.py`` (ETag + no-cache + CSRF refresh — :18-31); these tests pin
+that contract for every app and check each page's embedded client actually
+targets the API routes its backend registers (no browser/node in CI, so
+coherence is asserted at the HTTP + source level; field names are covered
+by comparing against the live list responses).
+"""
+
+import re
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.store import Store
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.services.dashboard import make_dashboard_app
+from kubeflow_tpu.services.jupyter import make_jupyter_app
+from kubeflow_tpu.services.kfam import make_kfam_app
+from kubeflow_tpu.services.tensorboards import make_tensorboards_app
+from kubeflow_tpu.services.volumes import make_volumes_app
+from kubeflow_tpu.web.auth import AuthConfig
+
+AUTH = AuthConfig(disable_auth=True, cluster_admins=["anonymous@kubeflow.org"])
+HDRS = {"kubeflow-userid": "anonymous@kubeflow.org"}
+
+
+def apps():
+    client = Client(Store())
+    kfam = make_kfam_app(client, AUTH)
+    return {
+        "jupyter": make_jupyter_app(client, auth=AUTH),
+        "dashboard": make_dashboard_app(client, kfam, AUTH),
+        "tensorboards": make_tensorboards_app(client, AUTH),
+        "volumes": make_volumes_app(client, AUTH),
+    }
+
+
+class TestSpaContract:
+    @pytest.mark.parametrize("name", ["jupyter", "dashboard", "tensorboards", "volumes"])
+    def test_index_served_with_etag_and_csrf(self, name):
+        app = apps()[name]
+        r = app.call("GET", "/", headers=HDRS)
+        assert r.status == 200
+        assert r.content_type.startswith("text/html")
+        assert "<html" in r.body.lower()
+        assert r.headers["Cache-Control"] == "no-cache"
+        assert any(c.startswith("XSRF-TOKEN=") for c in r.cookies), "CSRF cookie not refreshed"
+        # conditional revalidation → 304 without a body
+        r304 = app.call("GET", "/", headers={**HDRS, "if-none-match": r.headers["ETag"]})
+        assert r304.status == 304 and r304.encode() == b""
+        # shared runtime + styles are inlined (single-file page, no asset routes)
+        assert "async function api(" in r.body and "--brand" in r.body
+
+    def test_pages_reference_only_registered_api_routes(self):
+        """Every /api/... path the page's JS fetches must exist in the app's
+        route table (catches UI/backend drift without a browser)."""
+        for name, app in apps().items():
+            html = app.call("GET", "/", headers=HDRS).body
+            registered = [rx for method, rx, fn in app._routes]
+            for path in set(re.findall(r'"(/(?:api|kfam)/[^"$]*?)"', html)):
+                # template literals (`/api/namespaces/${NS}/...`) are matched
+                # separately below; plain strings here
+                assert any(rx.match(path) for rx in registered), (name, path)
+            for tmpl in set(re.findall(r"`(/(?:api|kfam)/[^`]*)`", html)):
+                probe = re.sub(r"\$\{[^}]*\}", "x", tmpl).split("?")[0]
+                assert any(rx.match(probe) for rx in registered), (name, tmpl)
+
+
+class TestUiBackendCoherence:
+    def test_jupyter_page_fields_match_list_response(self):
+        """The table renderers read exactly the fields the backend emits."""
+        mgr = build_platform().start()
+        try:
+            mgr.client.create(new_object("v1", "Namespace", "ui-ns"))
+            app = make_jupyter_app(mgr.client, auth=AUTH)
+            mgr.client.create(
+                new_object(
+                    "kubeflow.org/v1beta1",
+                    "Notebook",
+                    "nb1",
+                    "ui-ns",
+                    spec={"template": {"spec": {"containers": [{"name": "nb1", "image": "img"}]}}},
+                )
+            )
+            assert mgr.wait_idle(10)
+            nbs = app.call("GET", "/api/namespaces/ui-ns/notebooks", headers=HDRS).body["notebooks"]
+            html = app.call("GET", "/", headers=HDRS).body
+            for field in ("name", "image", "tpu", "status"):
+                assert field in nbs[0], field
+                assert re.search(rf"nb\.{field}\b", html), f"UI never renders {field}"
+            assert nbs[0]["status"]["phase"]  # statusBadge(nb.status.phase)
+        finally:
+            mgr.stop()
+
+    def test_volumes_page_fields_match_list_response(self):
+        client = Client(Store())
+        app = make_volumes_app(client, AUTH)
+        app.call(
+            "POST",
+            "/api/namespaces/ui-ns/pvcs",
+            {"name": "v1", "size": "5Gi", "mode": "ReadWriteOnce", "class": "{none}"},
+            headers=HDRS,
+        )
+        pvcs = app.call("GET", "/api/namespaces/ui-ns/pvcs", headers=HDRS).body["pvcs"]
+        html = app.call("GET", "/", headers=HDRS).body
+        for field in ("name", "capacity", "modes", "class", "inUse"):
+            assert field in pvcs[0], field
+            assert re.search(rf"p\.{field}\b", html), f"UI never renders {field}"
+
+    def test_tensorboards_page_fields_match_list_response(self):
+        client = Client(Store())
+        app = make_tensorboards_app(client, AUTH)
+        app.call(
+            "POST",
+            "/api/namespaces/ui-ns/tensorboards",
+            {"name": "t1", "logspath": "pvc://w/logs"},
+            headers=HDRS,
+        )
+        tbs = app.call("GET", "/api/namespaces/ui-ns/tensorboards", headers=HDRS).body[
+            "tensorboards"
+        ]
+        html = app.call("GET", "/", headers=HDRS).body
+        for field in ("name", "logspath", "ready"):
+            assert field in tbs[0], field
+            assert re.search(rf"t\.{field}\b", html), f"UI never renders {field}"
