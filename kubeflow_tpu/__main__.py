@@ -1,0 +1,60 @@
+"""python -m kubeflow_tpu — the all-in-one control plane.
+
+One process hosting the REST apiserver, the full controller set, and every
+web service on consecutive ports: the single-binary dev/demo deployment
+(the per-role manifests split exactly this composition across Deployments).
+
+Env: API_PORT (8001), DASHBOARD_PORT (8082), JUPYTER_PORT (5001),
+TENSORBOARDS_PORT (5002), VOLUMES_PORT (5003), KFAM_PORT (8081),
+APP_DISABLE_AUTH for local use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .apiserver.client import Client
+from .apiserver.server import make_apiserver_app, run_gc_loop
+from .platform import build_platform
+from .runtime.bootstrap import auth_from_env, block_forever
+from .services.dashboard import make_dashboard_app
+from .services.jupyter import make_jupyter_app
+from .services.kfam import make_kfam_app
+from .services.tensorboards import make_tensorboards_app
+from .services.volumes import make_volumes_app
+
+log = logging.getLogger("kubeflow_tpu")
+
+
+def main() -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    mgr = build_platform().start()
+    store, client = mgr.store, mgr.client
+    auth = auth_from_env()
+
+    servers = [("apiserver", make_apiserver_app(store).serve(int(os.environ.get("API_PORT", "8001"))))]
+    run_gc_loop(store)  # REST writers get GC too (Manager sweeps only its own)
+
+    kfam_app = make_kfam_app(client, auth)
+    for name, app, port_env, default in [
+        ("kfam", kfam_app, "KFAM_PORT", 8081),
+        ("dashboard", make_dashboard_app(client, kfam_app, auth), "DASHBOARD_PORT", 8082),
+        ("jupyter", make_jupyter_app(client, auth=auth), "JUPYTER_PORT", 5001),
+        ("tensorboards", make_tensorboards_app(client, auth), "TENSORBOARDS_PORT", 5002),
+        ("volumes", make_volumes_app(client, auth), "VOLUMES_PORT", 5003),
+    ]:
+        servers.append((name, app.serve(int(os.environ.get(port_env, str(default))))))
+
+    for name, server in servers:
+        log.info("%s: http://127.0.0.1:%d", name, server.port)
+    try:
+        block_forever()
+    finally:
+        for _, server in servers:
+            server.close()
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

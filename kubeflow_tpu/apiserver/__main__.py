@@ -1,0 +1,45 @@
+"""python -m kubeflow_tpu.apiserver — the REST control-plane server.
+
+Env: API_PORT (default 8001), WEBHOOK_URL (external PodDefault admission;
+unset = in-process admission, the all-in-one default), KUBEFLOW_TPU_NATIVE
+(storage backend selection).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ..apiserver.client import Client
+from ..runtime.bootstrap import block_forever
+from ..webhook.poddefault import admission_hook
+from .server import make_apiserver_app, run_gc_loop
+from .store import Store
+
+
+def main() -> None:
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    store = Store()
+    webhook_url = os.environ.get("WEBHOOK_URL", "")
+    app = make_apiserver_app(store, webhook_url=webhook_url or None)
+    if not webhook_url:
+        store.register_admission(
+            admission_hook(Client(store), cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"))
+        )
+    run_gc_loop(store)
+    port = int(os.environ.get("API_PORT", "8001"))
+    server = app.serve(port, host="0.0.0.0")
+    logging.getLogger("kubeflow_tpu.apiserver").info(
+        "apiserver on :%d (backend=%s, admission=%s)",
+        server.port,
+        type(store.backend).__name__,
+        webhook_url or "in-process",
+    )
+    try:
+        block_forever()
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

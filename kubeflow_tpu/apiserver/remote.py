@@ -1,0 +1,222 @@
+"""Remote store: the Store surface over the REST apiserver.
+
+Per-role services (controllers, webhook, web apps) run in their own
+processes and talk to ``python -m kubeflow_tpu.apiserver`` through this
+client — the analog of the reference's Go binaries using client-go against
+the Kubernetes API server. It implements exactly the Store methods that
+``Client`` and ``Manager`` consume, so the entire controller runtime works
+unchanged against a remote control plane: watches are streamed NDJSON over
+chunked HTTP, errors map back to the same ApiError taxonomy, and
+``collect_garbage`` is a no-op because the apiserver process owns the GC
+sweep (apiserver/server.py run_gc_loop).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..api import meta as apimeta
+from ..api.meta import Resource
+from .store import ApiError, Conflict, Expired, Forbidden, Invalid, NotFound, WatchEvent
+
+_ERRORS = {404: NotFound, 409: Conflict, 422: Invalid, 403: Forbidden, 410: Expired}
+
+
+def _raise_for(status_body: Dict[str, Any], code: int) -> None:
+    cls = _ERRORS.get(code, ApiError)
+    raise cls(status_body.get("message", f"HTTP {code}"))
+
+
+class RemoteWatch:
+    """Iterator of WatchEvents over one streaming HTTP response."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self.closed = False
+
+    def close(self) -> None:
+        self.closed = True
+        # Shut the raw socket down FIRST: a reader thread blocked in
+        # readinto holds the response's buffer lock, and HTTPResponse.close()
+        # would deadlock waiting for it. SHUT_RDWR makes the blocked read
+        # return EOF, the reader releases the lock, and close() proceeds.
+        try:
+            sock = getattr(getattr(self._resp, "fp", None), "raw", None)
+            sock = getattr(sock, "_sock", None)
+            if sock is not None:
+                import socket as _socket
+
+                sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        except Exception:
+            pass
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        from http.client import HTTPException
+
+        try:
+            for line in self._resp:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                yield WatchEvent(rec["type"], rec["object"])
+        except (OSError, ValueError, HTTPException):
+            # torn-down connection (incl. IncompleteRead mid-chunk) — the
+            # stream just ends; the consumer re-watches/relists
+            return
+        finally:
+            self.close()
+
+
+class RemoteStore:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- wire helpers --------------------------------------------------------
+    @staticmethod
+    def now() -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    def _path(self, res: Resource, namespace: Optional[str], name: Optional[str] = None,
+              subresource: Optional[str] = None) -> str:
+        prefix = f"/api/{res.version}" if not res.group else f"/apis/{res.group}/{res.version}"
+        parts = [prefix]
+        if res.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(res.plural)
+        if name:
+            parts.append(name)
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    def _request(self, method: str, path: str, body: Optional[Dict] = None,
+                 query: str = "", timeout: Optional[float] = None):
+        url = self.base_url + path + (f"?{query}" if query else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"content-type": "application/json"})
+        try:
+            return urllib.request.urlopen(req, timeout=timeout or self.timeout)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                status = json.loads(payload)
+            except ValueError:
+                status = {"message": payload.decode(errors="replace")}
+            _raise_for(status, e.code)
+
+    def _json(self, method: str, path: str, body: Optional[Dict] = None, query: str = "") -> Any:
+        with self._request(method, path, body, query) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else None
+
+    # -- Store surface -------------------------------------------------------
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        res = apimeta.REGISTRY.for_object(obj)
+        return self._json("POST", self._path(res, apimeta.namespace_of(obj)), obj)
+
+    def get(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        return self._json("GET", self._path(res, namespace, name))
+
+    def list(
+        self,
+        res: Resource,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        query = ""
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            query = "labelSelector=" + urllib.request.quote(sel)
+        items = self._json("GET", self._path(res, namespace), query=query)["items"]
+        if field_selector:
+            from .store import _match_fields
+
+            items = [o for o in items if _match_fields(o, field_selector)]
+        return items
+
+    def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
+        res = apimeta.REGISTRY.for_object(obj)
+        path = self._path(res, apimeta.namespace_of(obj), apimeta.name_of(obj), subresource)
+        return self._json("PUT", path, obj)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self.update(obj, subresource="status")
+
+    def patch(self, res: Resource, name: str, patch: Dict[str, Any],
+              namespace: Optional[str] = None) -> Dict[str, Any]:
+        return self._json("PATCH", self._path(res, namespace, name), patch)
+
+    def delete(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        return self._json("DELETE", self._path(res, namespace, name))
+
+    def delete_collection(
+        self, res: Resource, namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> int:
+        n = 0
+        for obj in self.list(res, namespace=namespace, label_selector=label_selector):
+            try:
+                self.delete(res, apimeta.name_of(obj), apimeta.namespace_of(obj))
+                n += 1
+            except NotFound:
+                pass
+        return n
+
+    def watch(
+        self,
+        res: Optional[Resource] = None,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        send_initial: bool = False,
+        since_rv: Optional[int] = None,
+    ) -> RemoteWatch:
+        if res is None:
+            raise Invalid("remote watch requires a resource (no cross-kind wildcard on the wire)")
+        params = ["watch=true"]
+        if send_initial:
+            params.append("sendInitial=true")
+        if since_rv is not None:
+            params.append(f"resourceVersion={since_rv}")
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            params.append("labelSelector=" + urllib.request.quote(sel))
+        resp = self._request(
+            "GET", self._path(res, namespace), query="&".join(params), timeout=3600.0
+        )
+        return RemoteWatch(resp)
+
+    def collect_garbage(self) -> int:
+        return 0  # the apiserver process runs the sweep
+
+    def register_admission(self, hook) -> None:
+        raise RuntimeError(
+            "admission runs server-side; deploy the webhook and point the "
+            "apiserver at it (WEBHOOK_URL)"
+        )
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                with self._request("GET", "/healthz", timeout=2.0) as resp:
+                    resp.read()
+                return
+            except Exception as e:
+                last = e
+                time.sleep(0.2)
+        raise TimeoutError(f"apiserver at {self.base_url} not ready: {last}")

@@ -1,0 +1,284 @@
+"""REST API server: the Kubernetes-wire HTTP surface over the Store.
+
+Exposes every registered resource at the standard paths —
+``/api/v1/...`` for core, ``/apis/<group>/<version>/...`` for groups,
+with ``namespaces/<ns>`` scoping, ``/status`` subresources, label
+selectors, JSON merge-patch, and ``?watch=true`` streaming (NDJSON watch
+events with resourceVersion resume via the native journal). This is what
+makes the per-role service entrypoints real: controllers, webapps, and the
+webhook connect to this server from separate processes exactly as the
+reference's Go binaries connect to the Kubernetes API server.
+
+Auth model: none here — like kubelet's local port, this listens on the
+pod network behind the platform's service mesh; user-facing authn/authz
+lives in the web apps (crud_backend model, SURVEY §2.7). Admission: a
+``webhook_url`` wires pod CREATEs through the external PodDefault webhook
+(AdmissionReview + JSONPatch), the MutatingWebhookConfiguration analog.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..api import meta as apimeta
+from ..api.meta import REGISTRY, Resource
+from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
+from .store import ApiError, Forbidden, Store
+
+
+def _selector_of(req: Request) -> Optional[Dict[str, str]]:
+    raw = req.query1("labelSelector")
+    if not raw:
+        return None
+    return apimeta.parse_selector_string(raw)
+
+
+def apply_json_patch(obj: Dict[str, Any], ops: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """RFC 6902 subset: add/replace/remove with object/array paths."""
+    out = apimeta.deepcopy(obj)
+    for op in ops:
+        path = [p.replace("~1", "/").replace("~0", "~") for p in op["path"].split("/")[1:]]
+        parent: Any = out
+        for seg in path[:-1]:
+            parent = parent[int(seg)] if isinstance(parent, list) else parent.setdefault(seg, {})
+        leaf = path[-1]
+        kind = op["op"]
+        if isinstance(parent, list):
+            idx = len(parent) if leaf == "-" else int(leaf)
+            if kind == "add":
+                parent.insert(idx, op["value"])
+            elif kind == "replace":
+                parent[idx] = op["value"]
+            elif kind == "remove":
+                del parent[idx]
+            else:
+                raise ValueError(f"unsupported patch op {kind!r}")
+        else:
+            if kind in ("add", "replace"):
+                parent[leaf] = op["value"]
+            elif kind == "remove":
+                parent.pop(leaf, None)
+            else:
+                raise ValueError(f"unsupported patch op {kind!r}")
+    return out
+
+
+def webhook_admission_hook(webhook_url: str, timeout: float = 5.0):
+    """Admission hook POSTing AdmissionReview to an external webhook and
+    applying the returned base64 JSONPatch (failurePolicy: Ignore — an
+    unreachable webhook must not brick pod creation, matching the
+    manifests' MutatingWebhookConfiguration)."""
+    import urllib.error
+    import urllib.request
+
+    def hook(op: str, res: Resource, obj: Dict[str, Any]) -> Dict[str, Any]:
+        if op != "CREATE" or res.kind != "Pod":
+            return obj
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "admit-" + apimeta.name_of(obj),
+                "operation": op,
+                "namespace": apimeta.namespace_of(obj),
+                "object": obj,
+            },
+        }
+        req = urllib.request.Request(
+            webhook_url, json.dumps(review).encode(), {"content-type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return obj  # failurePolicy: Ignore
+        response = body.get("response") or {}
+        if not response.get("allowed", True):
+            # 403, as the Kubernetes API server returns for admission denial
+            # — a 5xx would make clients retry a request that can't succeed.
+            raise Forbidden(response.get("status", {}).get("message", "admission denied"))
+        patch_b64 = response.get("patch")
+        if patch_b64:
+            ops = json.loads(base64.b64decode(patch_b64))
+            obj = apply_json_patch(obj, ops)
+        return obj
+
+    return hook
+
+
+def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
+    app = App("apiserver")
+    if webhook_url:
+        store.register_admission(webhook_admission_hook(webhook_url))
+
+    def res_of(req: Request) -> Resource:
+        group = req.params.get("group", "")
+        version = req.params["version"]
+        api_version = f"{group}/{version}" if group else version
+        try:
+            return REGISTRY.for_plural(api_version, req.params["plural"])
+        except KeyError as e:
+            raise HttpError(404, str(e)) from None
+
+    def error(e: ApiError) -> JsonResponse:
+        return JsonResponse(e.to_status(), status=e.code)
+
+    # -- handlers (shared by core + group paths) -----------------------------
+    def list_or_watch(req: Request):
+        res = res_of(req)
+        ns = req.params.get("ns")
+        selector = _selector_of(req)
+        if req.query1("watch") in ("true", "1"):
+            return _watch_stream(store, res, ns, selector, req)
+        try:
+            items = store.list(res, namespace=ns, label_selector=selector)
+        except ApiError as e:
+            return error(e)
+        return {
+            "apiVersion": res.api_version,
+            "kind": res.list_kind or f"{res.kind}List",
+            "metadata": {"resourceVersion": str(store.backend.current_rv())},
+            "items": items,
+        }
+
+    def create(req: Request):
+        res = res_of(req)
+        obj = req.json or {}
+        obj.setdefault("apiVersion", res.api_version)
+        obj.setdefault("kind", res.kind)
+        if req.params.get("ns"):
+            obj.setdefault("metadata", {}).setdefault("namespace", req.params["ns"])
+        try:
+            return JsonResponse(store.create(obj), status=201)
+        except ApiError as e:
+            return error(e)
+
+    def get_item(req: Request):
+        try:
+            return store.get(res_of(req), req.params["name"], req.params.get("ns"))
+        except ApiError as e:
+            return error(e)
+
+    def _check_body_matches_path(req: Request, obj: Dict[str, Any]) -> None:
+        """The body must name the object the URL addresses — a mismatched
+        client write must 400, not silently update a different object."""
+        md = obj.get("metadata") or {}
+        if md.get("name") != req.params["name"]:
+            raise HttpError(400, f"body names {md.get('name')!r}, path names {req.params['name']!r}")
+        path_ns = req.params.get("ns")
+        if path_ns is not None and md.get("namespace") not in (None, path_ns):
+            raise HttpError(
+                400, f"body namespace {md.get('namespace')!r} != path namespace {path_ns!r}"
+            )
+
+    def put_item(req: Request):
+        obj = req.json or {}
+        _check_body_matches_path(req, obj)
+        try:
+            return store.update(obj)
+        except ApiError as e:
+            return error(e)
+
+    def put_status(req: Request):
+        obj = req.json or {}
+        _check_body_matches_path(req, obj)
+        try:
+            return store.update_status(obj)
+        except ApiError as e:
+            return error(e)
+
+    def patch_item(req: Request):
+        try:
+            return store.patch(res_of(req), req.params["name"], req.json or {}, req.params.get("ns"))
+        except ApiError as e:
+            return error(e)
+
+    def delete_item(req: Request):
+        try:
+            return store.delete(res_of(req), req.params["name"], req.params.get("ns"))
+        except ApiError as e:
+            return error(e)
+
+    # -- route table ---------------------------------------------------------
+    # /api/v1/... (core) and /apis/<group>/<version>/... share handlers; the
+    # core prefix hard-pins version into the pattern params via defaults.
+    prefixes = [
+        "/api/<version>",
+        "/apis/<group>/<version>",
+    ]
+    for prefix in prefixes:
+        for scope in (f"{prefix}/namespaces/<ns>", prefix):
+            app.route(f"{scope}/<plural>", methods=("GET",))(list_or_watch)
+            app.route(f"{scope}/<plural>", methods=("POST",))(create)
+            app.route(f"{scope}/<plural>/<name>", methods=("GET",))(get_item)
+            app.route(f"{scope}/<plural>/<name>", methods=("PUT",))(put_item)
+            app.route(f"{scope}/<plural>/<name>/status", methods=("PUT",))(put_status)
+            app.route(f"{scope}/<plural>/<name>", methods=("PATCH",))(patch_item)
+            app.route(f"{scope}/<plural>/<name>", methods=("DELETE",))(delete_item)
+
+    @app.route("/healthz")
+    def healthz(req: Request):
+        return {"status": "ok", "resourceVersion": str(store.backend.current_rv())}
+
+    @app.route("/apis")
+    def discovery(req: Request):
+        groups: Dict[str, List[str]] = {}
+        for res in REGISTRY.all():
+            groups.setdefault(res.group or "core", []).append(f"{res.plural}.{res.version}")
+        return {"groups": {g: sorted(v) for g, v in groups.items()}}
+
+    return app
+
+
+def _watch_stream(
+    store: Store, res: Resource, ns: Optional[str], selector: Optional[Dict[str, str]], req: Request
+):
+    since_rv: Optional[int] = None
+    rv_param = req.query1("resourceVersion")
+    if rv_param:
+        try:
+            since_rv = int(rv_param)
+        except ValueError:
+            raise HttpError(400, f"invalid resourceVersion {rv_param!r}") from None
+    send_initial = req.query1("sendInitial") in ("true", "1")
+    try:
+        watcher = store.watch(
+            res,
+            namespace=ns,
+            label_selector=selector,
+            send_initial=send_initial,
+            since_rv=since_rv,
+        )
+    except ApiError as e:
+        return JsonResponse(e.to_status(), status=e.code)
+
+    def chunks() -> Iterator[bytes]:
+        for event in watcher:
+            yield json.dumps({"type": event.type, "object": event.object}).encode() + b"\n"
+
+    return StreamingResponse(
+        chunks(),
+        headers={"Content-Type": "application/json; stream=watch"},
+        on_close=watcher.close,
+    )
+
+
+def run_gc_loop(store: Store, interval: float = 0.1) -> threading.Thread:
+    """The kube-controller-manager GC role, hosted by the apiserver process
+    (remote controllers must not each run their own sweep)."""
+
+    def loop() -> None:
+        while True:
+            time.sleep(interval)
+            try:
+                store.collect_garbage()
+            except Exception:
+                pass
+
+    t = threading.Thread(target=loop, name="apiserver-gc", daemon=True)
+    t.start()
+    return t

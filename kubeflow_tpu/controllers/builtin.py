@@ -267,3 +267,12 @@ def make_tpu_node(name: str, generation: str, topology_label: str, chips: int) -
         "conditions": [{"type": "Ready", "status": "True"}],
     }
     return node
+
+def main() -> None:  # python -m kubeflow_tpu.controllers.builtin (substrate)
+    from ..runtime.bootstrap import run_role
+
+    run_role("substrate", StatefulSetReconciler(), DeploymentReconciler(), PodletReconciler())
+
+
+if __name__ == "__main__":
+    main()

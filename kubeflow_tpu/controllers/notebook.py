@@ -61,6 +61,23 @@ class NotebookConfig:
     # (culler.go:138-169); tests inject a fake.
     activity_prober: Optional[Callable[[Dict[str, Any]], Optional[float]]] = None
 
+    @classmethod
+    def from_env(cls) -> "NotebookConfig":
+        """The reference's env knob set (culler.go:24-27, notebook main.go)."""
+        import os
+
+        from ..utils import env_flag
+
+        return cls(
+            use_istio=env_flag("USE_ISTIO", True),
+            istio_gateway=os.environ.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
+            cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"),
+            enable_culling=env_flag("ENABLE_CULLING", False),
+            idle_time_minutes=int(os.environ.get("IDLE_TIME", "1440")),
+            culling_check_period_minutes=int(os.environ.get("CULLING_CHECK_PERIOD", "1")),
+            add_fsgroup=env_flag("ADD_FSGROUP", True),
+        )
+
 
 def tpu_topology_of(notebook: Dict[str, Any]) -> Optional[SliceTopology]:
     tpu = notebook.get("spec", {}).get("tpu")
@@ -381,3 +398,12 @@ def _nb_name_from_involved_object(ev: Dict[str, Any]) -> Optional[str]:
         if dash and ordinal.isdigit():
             return base
     return None
+
+def main() -> None:  # python -m kubeflow_tpu.controllers.notebook
+    from ..runtime.bootstrap import run_role
+
+    run_role("notebook-controller", NotebookReconciler(NotebookConfig.from_env()))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,18 @@ class ProfileConfig:
     # Injectable cloud-IAM backend: (action, plugin_kind, spec, namespace) -> None
     iam_backend: Optional[Callable[[str, str, Dict[str, Any], str], None]] = None
 
+    @classmethod
+    def from_env(cls) -> "ProfileConfig":
+        import os
+
+        chips = os.environ.get("DEFAULT_TPU_QUOTA_CHIPS", "")
+        return cls(
+            userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+            userid_prefix=os.environ.get("USERID_PREFIX", ""),
+            workload_identity=os.environ.get("WORKLOAD_IDENTITY", ""),
+            default_tpu_chips=int(chips) if chips else None,
+        )
+
 
 class ProfileReconciler(Reconciler):
     FOR = (PROFILE_API, "Profile")
@@ -292,3 +304,12 @@ class ProfileReconciler(Reconciler):
         fresh = apimeta.deepcopy(fresh)
         fresh["status"] = {"conditions": conditions}
         client.update_status(fresh)
+
+def main() -> None:  # python -m kubeflow_tpu.controllers.profile
+    from ..runtime.bootstrap import run_role
+
+    run_role("profile-controller", ProfileReconciler(ProfileConfig.from_env()))
+
+
+if __name__ == "__main__":
+    main()

@@ -290,3 +290,12 @@ class InProcessTrialRunner(Reconciler):
             fresh["status"] = status
             client.update_status(fresh)
         return Result()
+
+def main() -> None:  # python -m kubeflow_tpu.controllers.studyjob
+    from ..runtime.bootstrap import run_role
+
+    run_role("studyjob-controller", StudyJobReconciler(), TrialPodRunner())
+
+
+if __name__ == "__main__":
+    main()

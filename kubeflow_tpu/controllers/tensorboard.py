@@ -39,6 +39,18 @@ class TensorboardConfig:
     rwo_pvc_scheduling: bool = False
     image: str = DEFAULT_IMAGE
 
+    @classmethod
+    def from_env(cls) -> "TensorboardConfig":
+        import os
+
+        from ..utils import env_flag
+
+        return cls(
+            rwo_pvc_scheduling=env_flag("RWO_PVC_SCHEDULING"),
+            image=os.environ.get("TENSORBOARD_IMAGE", DEFAULT_IMAGE),
+            cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"),
+        )
+
 
 def parse_logspath(logspath: str) -> Tuple[str, Dict[str, Any]]:
     """Classify a logspath: ("pvc", {name, subpath}) or ("cloud", {uri})."""
@@ -235,3 +247,12 @@ class TensorboardReconciler(Reconciler):
             fresh = apimeta.deepcopy(tb)
             fresh["status"] = status
             client.update_status(fresh)
+
+def main() -> None:  # python -m kubeflow_tpu.controllers.tensorboard
+    from ..runtime.bootstrap import run_role
+
+    run_role("tensorboard-controller", TensorboardReconciler(TensorboardConfig.from_env()))
+
+
+if __name__ == "__main__":
+    main()

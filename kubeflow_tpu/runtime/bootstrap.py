@@ -1,0 +1,119 @@
+"""Per-role service bootstrap — what each deployed binary's main() does.
+
+The manifests run one role per Deployment (``python -m
+kubeflow_tpu.controllers.notebook`` etc. — the analog of the reference's
+per-component Go binaries). Every role connects to the REST apiserver
+(``APISERVER_URL``, default the in-cluster service DNS), serves /healthz +
+Prometheus /metrics on ``METRICS_PORT``, and blocks until signalled.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Optional
+
+from ..apiserver.remote import RemoteStore
+from ..web.http import App, Request
+from .manager import Manager, Reconciler
+from .metrics import METRICS
+
+DEFAULT_APISERVER = "http://apiserver:8001"
+
+log = logging.getLogger("kubeflow_tpu.bootstrap")
+
+
+def apiserver_url() -> str:
+    return os.environ.get("APISERVER_URL", DEFAULT_APISERVER)
+
+
+def connect(url: Optional[str] = None, timeout: float = 60.0) -> RemoteStore:
+    store = RemoteStore(url or apiserver_url())
+    store.wait_ready(timeout=timeout)
+    return store
+
+
+def serve_ops_endpoints(name: str, port: Optional[int] = None):
+    """/healthz + /metrics server every role exposes (reference: promhttp on
+    each Go binary — e.g. kfam routers.go:85-89)."""
+    app = App(f"{name}-ops")
+
+    @app.route("/healthz")
+    def healthz(req: Request):
+        return {"status": "ok", "role": name}
+
+    @app.route("/metrics")
+    def metrics(req: Request):
+        from ..web.http import JsonResponse
+
+        return JsonResponse(
+            METRICS.render(), headers={"Content-Type": "text/plain; version=0.0.4"}
+        )
+
+    if port is None:
+        port = int(os.environ.get("METRICS_PORT", "8080"))
+    # 0.0.0.0: kubelet probes and Prometheus scrape via the pod IP.
+    return app.serve(port, host="0.0.0.0")
+
+
+def block_forever() -> None:
+    """Park the main thread until SIGTERM/SIGINT (daemon threads do the work)."""
+    stop = threading.Event()
+
+    def handler(signum, frame):
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, handler)
+        except ValueError:  # non-main thread (tests)
+            break
+    stop.wait()
+
+
+def auth_from_env():
+    """AuthConfig from the crud_backend env knob set (params.env wiring)."""
+    from ..utils import env_flag
+    from ..web.auth import AuthConfig
+
+    return AuthConfig(
+        userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
+        userid_prefix=os.environ.get("USERID_PREFIX", ""),
+        disable_auth=env_flag("APP_DISABLE_AUTH"),
+        cluster_admins=[a for a in os.environ.get("CLUSTER_ADMIN", "").split(",") if a],
+        secure_cookies=env_flag("APP_SECURE_COOKIES"),
+    )
+
+
+def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
+    """Standard web-app main: factory(client, auth) served on PORT."""
+    from ..apiserver.client import Client
+
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    store = connect(url)
+    app = factory(Client(store), auth_from_env())
+    server = app.serve(int(os.environ.get("PORT", "5000")), host="0.0.0.0")
+    log.info("%s serving on :%d against %s", name, server.port, store.base_url)
+    try:
+        block_forever()
+    finally:
+        server.close()
+
+
+def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> None:
+    """Standard controller-role main: connect, reconcile, expose ops, block."""
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    store = connect(url)
+    mgr = Manager(store=store)
+    for rec in reconcilers:
+        mgr.add(rec)
+    mgr.start()
+    ops = serve_ops_endpoints(name)
+    log.info("%s running against %s (ops :%d)", name, store.base_url, ops.port)
+    try:
+        block_forever()
+    finally:
+        mgr.stop()
+        ops.close()

@@ -139,6 +139,9 @@ class _Controller:
         self.queue = _WorkQueue()
         self.name = type(reconciler).__name__
         self._threads: List[threading.Thread] = []
+        self._stopped = threading.Event()
+        self._watchers: List[Any] = []
+        self._watchers_lock = threading.Lock()
 
     def _map_owned(self, obj: Dict[str, Any]) -> List[Request]:
         for_api, for_kind = self.reconciler.FOR
@@ -163,19 +166,51 @@ class _Controller:
         self._threads.append(t)
 
     def _spawn_watch(self, store: Store, res, mapper) -> None:
-        watcher = store.watch(res, send_initial=True)
-
         def pump() -> None:
-            for event in watcher:
+            # Re-watch loop: in-process watch streams are infinite, but a
+            # remote stream ends on apiserver restart, idle socket timeout,
+            # or a dropped slow watcher — without reconnection the controller
+            # would go permanently deaf. Each (re)connect relists
+            # (send_initial=True): level-triggered reconciles make replays
+            # harmless, exactly like an informer resync.
+            while not self._stopped.is_set():
                 try:
-                    for req in mapper(event.object) or []:
-                        self.queue.add(req)
-                except Exception:  # mapper bugs must not kill the pump
-                    log.exception("%s: watch mapper failed", self.name)
+                    watcher = store.watch(res, send_initial=True)
+                except Exception:
+                    log.warning("%s: watch connect failed for %s; retrying", self.name, res.plural)
+                    self._stopped.wait(1.0)
+                    continue
+                with self._watchers_lock:
+                    self._watchers.append(watcher)
+                try:
+                    for event in watcher:
+                        try:
+                            for req in mapper(event.object) or []:
+                                self.queue.add(req)
+                        except Exception:  # mapper bugs must not kill the pump
+                            log.exception("%s: watch mapper failed", self.name)
+                finally:
+                    with self._watchers_lock:
+                        if watcher in self._watchers:
+                            self._watchers.remove(watcher)
+                if not self._stopped.is_set():
+                    log.debug("%s: watch on %s ended; re-establishing", self.name, res.plural)
+                    self._stopped.wait(0.2)
 
         t = threading.Thread(target=pump, name=f"{self.name}-watch-{res.plural}", daemon=True)
         t.start()
         self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._watchers_lock:
+            watchers = list(self._watchers)
+        for w in watchers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        self.queue.shutdown()
 
     def _worker(self) -> None:
         client = self.mgr.client
@@ -247,7 +282,7 @@ class Manager:
     def stop(self) -> None:
         self._stop.set()
         for c in self._controllers:
-            c.queue.shutdown()
+            c.stop()
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
         """Block until all queues drain and stay drained for ``settle`` seconds.

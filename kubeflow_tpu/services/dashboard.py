@@ -247,3 +247,19 @@ def make_dashboard_app(
 
     install_spa(app, load_ui("dashboard.html"), cfg)
     return app
+
+def main() -> None:  # python -m kubeflow_tpu.services.dashboard
+    import os
+
+    from ..runtime.bootstrap import run_webapp
+    from .kfam import make_kfam_app
+
+    os.environ.setdefault("PORT", "8082")
+    run_webapp(
+        "centraldashboard",
+        lambda client, auth: make_dashboard_app(client, make_kfam_app(client, auth), auth),
+    )
+
+
+if __name__ == "__main__":
+    main()

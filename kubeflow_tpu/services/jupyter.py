@@ -275,3 +275,22 @@ def _ensure_pvc(client: Client, ns: str, nb_name: str, vol: Dict[str, Any]) -> O
     except Conflict:
         pass  # already exists (concurrent spawn or reused workspace) — mount it
     return {"name": pvc_name}
+
+def main() -> None:  # python -m kubeflow_tpu.services.jupyter
+    import os
+
+    from ..runtime.bootstrap import run_webapp
+
+    def factory(client, auth):
+        spawner = None
+        path = os.environ.get("SPAWNER_CONFIG")
+        if path and os.path.exists(path):
+            with open(path) as f:
+                spawner = SpawnerConfig.from_yaml(f.read())
+        return make_jupyter_app(client, auth=auth, spawner=spawner)
+
+    run_webapp("jupyter-web-app", factory)
+
+
+if __name__ == "__main__":
+    main()

@@ -198,3 +198,15 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
         return authorizer.is_cluster_admin(user)
 
     return app
+
+def main() -> None:  # python -m kubeflow_tpu.services.kfam
+    import os
+
+    from ..runtime.bootstrap import run_webapp
+
+    os.environ.setdefault("PORT", "8081")
+    run_webapp("kfam", lambda client, auth: make_kfam_app(client, auth))
+
+
+if __name__ == "__main__":
+    main()

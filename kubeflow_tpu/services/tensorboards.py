@@ -73,3 +73,12 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
 
     install_spa(app, load_ui("tensorboards.html"), cfg)
     return app
+
+def main() -> None:  # python -m kubeflow_tpu.services.tensorboards
+    from ..runtime.bootstrap import run_webapp
+
+    run_webapp("tensorboards-web-app", lambda client, auth: make_tensorboards_app(client, auth))
+
+
+if __name__ == "__main__":
+    main()

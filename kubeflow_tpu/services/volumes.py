@@ -95,3 +95,12 @@ def _mounted_pvcs(client: Client, ns: str) -> set:
             if claim:
                 used.add(claim)
     return used
+
+def main() -> None:  # python -m kubeflow_tpu.services.volumes
+    from ..runtime.bootstrap import run_webapp
+
+    run_webapp("volumes-web-app", lambda client, auth: make_volumes_app(client, auth))
+
+
+if __name__ == "__main__":
+    main()

@@ -161,3 +161,12 @@ class InferenceServiceReconciler(Reconciler):
             fresh = apimeta.deepcopy(isvc)
             fresh["status"] = status
             client.update_status(fresh)
+
+def main() -> None:  # python -m kubeflow_tpu.serving.controller
+    from ..runtime.bootstrap import run_role
+
+    run_role("serving-controller", InferenceServiceReconciler())
+
+
+if __name__ == "__main__":
+    main()

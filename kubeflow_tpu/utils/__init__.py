@@ -1,0 +1,5 @@
+"""Small shared utilities."""
+
+from .env import env_flag
+
+__all__ = ["env_flag"]

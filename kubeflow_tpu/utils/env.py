@@ -1,0 +1,17 @@
+"""Environment-variable parsing shared by every role's config surface."""
+
+from __future__ import annotations
+
+import os
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env knob with the platform-wide truthy set. One definition —
+    every config (controllers, web auth, bootstrap) must agree on what
+    counts as 'true'."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in _TRUTHY

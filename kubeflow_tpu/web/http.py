@@ -64,6 +64,13 @@ class Request:
         return vals[0] if vals else default
 
 
+def _content_type_of(headers: Dict[str, str]) -> str:
+    for k, v in headers.items():
+        if k.lower() == "content-type":
+            return v
+    return "application/json"
+
+
 @dataclass
 class JsonResponse:
     body: Any = None
@@ -73,10 +80,7 @@ class JsonResponse:
 
     @property
     def content_type(self) -> str:
-        for k, v in self.headers.items():
-            if k.lower() == "content-type":
-                return v
-        return "application/json"
+        return _content_type_of(self.headers)
 
     def encode(self) -> bytes:
         if self.body is None:
@@ -86,6 +90,21 @@ class JsonResponse:
         if isinstance(self.body, str) and not self.content_type.startswith("application/json"):
             return self.body.encode()
         return json.dumps(self.body).encode()
+
+
+@dataclass
+class StreamingResponse:
+    """Chunked NDJSON-style response (watch streams). ``chunks`` yields bytes;
+    ``on_close`` runs when the stream ends or the client disconnects."""
+
+    chunks: Any  # Iterator[bytes]
+    status: int = 200
+    headers: Dict[str, str] = field(default_factory=dict)
+    on_close: Optional[Callable[[], None]] = None
+
+    @property
+    def content_type(self) -> str:
+        return _content_type_of(self.headers)
 
 
 Handler = Callable[[Request], Any]
@@ -136,7 +155,9 @@ class App:
         ) as span:
             resp = self._dispatch_inner(req)
             span.set("http.status_code", resp.status)
-            if resp.status >= 500:
+            if isinstance(resp, StreamingResponse):
+                span.set("http.streaming", True)  # span closes at stream start
+            elif resp.status >= 500:
                 span.status = "ERROR"
                 span.status_message = f"HTTP {resp.status}"
             return resp
@@ -154,7 +175,7 @@ class App:
                 if m:
                     req.params = m.groupdict()
                     result = fn(req)
-                    if isinstance(result, JsonResponse):
+                    if isinstance(result, (JsonResponse, StreamingResponse)):
                         return result
                     return JsonResponse(result)
             if any(rx.match(req.path) for _, rx, _ in self._routes):
@@ -186,12 +207,12 @@ class App:
         return self.dispatch(req)
 
     # -- real server ---------------------------------------------------------
-    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "AppServer":
-        return AppServer(self, host, port)
+    def serve(self, port: int = 0, host: str = "127.0.0.1", ssl_context=None) -> "AppServer":
+        return AppServer(self, host, port, ssl_context=ssl_context)
 
 
 class AppServer:
-    def __init__(self, app: App, host: str, port: int):
+    def __init__(self, app: App, host: str, port: int, ssl_context=None):
         self.app = app
         outer = self
 
@@ -213,6 +234,9 @@ class AppServer:
                     body=body,
                 )
                 resp = outer.app.dispatch(req)
+                if isinstance(resp, StreamingResponse):
+                    self._stream(resp)
+                    return
                 payload = resp.encode()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
@@ -225,9 +249,37 @@ class AppServer:
                 self.end_headers()
                 self.wfile.write(payload)
 
+            def _stream(self, resp: StreamingResponse) -> None:
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                for k, v in resp.headers.items():
+                    if k.lower() != "content-type":
+                        self.send_header(k, v)
+                self.end_headers()
+                try:
+                    for chunk in resp.chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away — normal watch termination
+                finally:
+                    if resp.on_close:
+                        try:
+                            resp.on_close()
+                        except Exception:
+                            pass
+
             do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
 
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        if ssl_context is not None:
+            # Wrap BEFORE the accept thread starts: the port must never
+            # serve a plaintext connection on a TLS-configured server.
+            self.httpd.socket = ssl_context.wrap_socket(self.httpd.socket, server_side=True)
         self.port = self.httpd.server_address[1]
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name=f"{app.name}-http", daemon=True

@@ -1,0 +1,84 @@
+"""python -m kubeflow_tpu.webhook — the PodDefault admission webhook server.
+
+Serves ``POST /apply-poddefault`` (AdmissionReview v1 in, AdmissionReview
+with a base64 JSONPatch out — reference: admission-webhook/main.go:593-608).
+PodDefaults are read from the apiserver (APISERVER_URL). TLS via
+``--tls-cert-file``/``--tls-key-file`` (reference config.go:40-50); without
+certs it serves plain HTTP (in-mesh deployments terminate TLS upstream).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import logging
+import os
+import ssl
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..runtime.bootstrap import block_forever, connect
+from ..web.http import App, Request
+from .poddefault import mutate_pod
+
+
+def make_webhook_app(client: Client, cluster_domain: str = "cluster.local") -> App:
+    app = App("admission-webhook")
+
+    @app.route("/healthz")
+    def healthz(req: Request):
+        return {"status": "ok"}
+
+    @app.route("/apply-poddefault", methods=("POST",))
+    def apply(req: Request):
+        review = req.json or {}
+        request = review.get("request") or {}
+        pod = request.get("object") or {}
+        ns = request.get("namespace") or apimeta.namespace_of(pod) or "default"
+        poddefaults = client.list("kubeflow.org/v1alpha1", "PodDefault", ns)
+        mutated = mutate_pod(pod, poddefaults, cluster_domain)
+        response = {"uid": request.get("uid", ""), "allowed": True}
+        if mutated is not pod and mutated != pod:
+            ops = [
+                {"op": "replace", "path": "/metadata", "value": mutated.get("metadata", {})},
+                {"op": "replace", "path": "/spec", "value": mutated.get("spec", {})},
+            ]
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(json.dumps(ops).encode()).decode()
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": response,
+        }
+
+    return app
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tls-cert-file", default=os.environ.get("TLS_CERT_FILE", ""))
+    parser.add_argument("--tls-key-file", default=os.environ.get("TLS_KEY_FILE", ""))
+    parser.add_argument("--port", type=int, default=int(os.environ.get("PORT", "4443")))
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    store = connect()
+    app = make_webhook_app(Client(store), os.environ.get("CLUSTER_DOMAIN", "cluster.local"))
+    ctx = None
+    if args.tls_cert_file and args.tls_key_file:
+        # Certs load (and fail) before any socket accepts a connection.
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(args.tls_cert_file, args.tls_key_file)
+    server = app.serve(args.port, host="0.0.0.0", ssl_context=ctx)
+    logging.getLogger("kubeflow_tpu.webhook").info(
+        "webhook on :%d (%s)", server.port, "TLS" if ctx else "plain HTTP"
+    )
+    try:
+        block_forever()
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()
