@@ -14,8 +14,7 @@ from __future__ import annotations
 import logging
 import os
 
-from .apiserver.client import Client
-from .apiserver.server import make_apiserver_app, run_gc_loop
+from .apiserver.server import make_apiserver_app
 from .platform import build_platform
 from .runtime.bootstrap import auth_from_env, block_forever
 from .services.dashboard import make_dashboard_app
@@ -33,8 +32,9 @@ def main() -> None:
     store, client = mgr.store, mgr.client
     auth = auth_from_env()
 
+    # Manager.start() already runs the GC sweep on this same Store; REST
+    # writers are covered by it (no second sweep needed here).
     servers = [("apiserver", make_apiserver_app(store).serve(int(os.environ.get("API_PORT", "8001"))))]
-    run_gc_loop(store)  # REST writers get GC too (Manager sweeps only its own)
 
     kfam_app = make_kfam_app(client, auth)
     for name, app, port_env, default in [

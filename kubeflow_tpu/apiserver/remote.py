@@ -14,7 +14,6 @@ sweep (apiserver/server.py run_gc_loop).
 from __future__ import annotations
 
 import json
-import threading
 import time
 import urllib.error
 import urllib.request
@@ -69,6 +68,8 @@ class RemoteWatch:
                 if not line.strip():
                     continue
                 rec = json.loads(line)
+                if rec["type"] == "BOOKMARK":  # server liveness heartbeat
+                    continue
                 yield WatchEvent(rec["type"], rec["object"])
         except (OSError, ValueError, HTTPException):
             # torn-down connection (incl. IncompleteRead mid-chunk) — the

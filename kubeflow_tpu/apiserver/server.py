@@ -257,8 +257,21 @@ def _watch_stream(
         return JsonResponse(e.to_status(), status=e.code)
 
     def chunks() -> Iterator[bytes]:
-        for event in watcher:
-            yield json.dumps({"type": event.type, "object": event.object}).encode() + b"\n"
+        # Heartbeat BOOKMARKs on idle streams: a broken socket is only
+        # detected on write, so without periodic writes a watcher whose
+        # client vanished (controller rollout) would leak its handler
+        # thread + Store registration forever on a quiet resource.
+        import queue as _queue
+
+        while True:
+            try:
+                item = watcher.queue.get(timeout=15.0)
+            except _queue.Empty:
+                yield json.dumps({"type": "BOOKMARK", "object": {}}).encode() + b"\n"
+                continue
+            if item is None:
+                return
+            yield json.dumps({"type": item.type, "object": item.object}).encode() + b"\n"
 
     return StreamingResponse(
         chunks(),

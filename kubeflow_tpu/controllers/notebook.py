@@ -156,6 +156,7 @@ class NotebookReconciler(Reconciler):
         if self.config.use_istio:
             rh.reconcile_object(client, self._generate_virtual_service(nb), nb)
 
+        self._gang_recovery(client, nb)
         self._update_status(client, nb)
         self._update_running_gauge(client, req.namespace)
 
@@ -288,6 +289,37 @@ class NotebookReconciler(Reconciler):
 
             vs["spec"]["http"][0]["headers"] = {"request": {"set": json.loads(headers)}}
         return vs
+
+    # -- gang recovery -------------------------------------------------------
+    def _gang_recovery(self, client: Client, nb: Dict[str, Any]) -> None:
+        """Slice atomicity (SURVEY §7 hard part — no reference analog): a
+        multi-host JAX program is all-or-nothing; once one host fails, the
+        surviving workers are wedged in dead collectives. Restart the WHOLE
+        gang: delete every pod of the slice so the StatefulSet recreates them
+        together and `jax.distributed` re-initializes across fresh workers —
+        the control-plane half of elastic recovery (workload-side resume
+        comes from checkpoints on the PVC home dir)."""
+        topo = tpu_topology_of(nb)
+        if topo is None or topo.num_hosts <= 1 or is_stopped(nb):
+            return
+        name, ns = apimeta.name_of(nb), apimeta.namespace_of(nb)
+        # Server-side selector: don't pull the namespace's whole pod list
+        # over the REST boundary every reconcile.
+        pods = client.list("v1", "Pod", ns, label_selector={NOTEBOOK_NAME_LABEL: name})
+        failed = [p for p in pods if p.get("status", {}).get("phase") == "Failed"]
+        if not failed:
+            return
+        for p in pods:
+            client.delete_opt("v1", "Pod", apimeta.name_of(p), ns)
+        METRICS.counter("notebook_slice_recovery_total").inc()
+        client.emit_event(
+            nb,
+            "SliceRecovery",
+            f"host(s) {', '.join(apimeta.name_of(p) for p in failed)} failed; "
+            f"restarting all {topo.num_hosts} hosts of the {topo.generation} "
+            f"{topo.label} slice together",
+            type_="Warning",
+        )
 
     # -- status / events -----------------------------------------------------
     def _update_status(self, client: Client, nb: Dict[str, Any]) -> None:

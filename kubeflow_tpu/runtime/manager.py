@@ -182,6 +182,15 @@ class _Controller:
                     continue
                 with self._watchers_lock:
                     self._watchers.append(watcher)
+                # Re-check after registration: stop() may have snapshotted
+                # the watcher list between our loop check and the append —
+                # without this, a freshly opened remote stream leaks.
+                if self._stopped.is_set():
+                    watcher.close()
+                    with self._watchers_lock:
+                        if watcher in self._watchers:
+                            self._watchers.remove(watcher)
+                    return
                 try:
                     for event in watcher:
                         try:

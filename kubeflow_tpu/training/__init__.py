@@ -6,6 +6,7 @@ Everything compiles to one XLA program per step: optimizer update included,
 donated state, shardings from kubeflow_tpu.parallel.
 """
 
+from kubeflow_tpu.training.checkpoint import Checkpointer  # noqa: F401
 from kubeflow_tpu.training.classifier import (  # noqa: F401
     ClassifierTask,
     TrainState,

@@ -190,6 +190,54 @@ def test_terminal_pods_release_tpu_capacity(platform):
     assert pod_b["spec"]["nodeName"] == "tpu-node-0"
 
 
+def test_gang_recovery_restarts_whole_slice(platform):
+    """One failed host wedges a multi-host JAX program in dead collectives —
+    the controller must restart the WHOLE slice (SURVEY §7 'slice atomicity'
+    hard part), not just the failed pod."""
+    platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+    assert platform.wait_idle()
+    pods = platform.client.list("v1", "Pod", "team-a")
+    assert len(pods) == 2 and all(p["status"]["phase"] == "Running" for p in pods)
+    survivor_uid = next(p["metadata"]["uid"] for p in pods if p["metadata"]["name"] == "nb-0")
+
+    # host 1 dies
+    dead = platform.client.get("v1", "Pod", "nb-1", "team-a")
+    dead["status"]["phase"] = "Failed"
+    platform.client.update_status(dead)
+    assert platform.wait_idle()
+
+    # both pods were replaced (fresh uids), slice is Running again
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        pods = platform.client.list("v1", "Pod", "team-a")
+        if (
+            len(pods) == 2
+            and all(p["status"].get("phase") == "Running" for p in pods)
+            and all(p["metadata"]["uid"] != survivor_uid for p in pods)
+        ):
+            break
+        time.sleep(0.05)
+    assert len(pods) == 2, pods
+    assert all(p["metadata"]["uid"] != survivor_uid for p in pods), "survivor was not restarted"
+    assert all(p["status"]["phase"] == "Running" for p in pods)
+    events = platform.client.list("v1", "Event", "team-a")
+    assert any(e.get("reason") == "SliceRecovery" for e in events)
+    assert METRICS.value("notebook_slice_recovery_total") >= 1
+
+
+def test_single_host_failure_no_gang_recovery(platform):
+    """Single-host notebooks restart in place (kubelet semantics) — gang
+    recovery must not fire."""
+    platform.client.create(mknotebook(name="solo"))
+    assert platform.wait_idle()
+    pod = platform.client.get("v1", "Pod", "solo-0", "team-a")
+    pod["status"]["phase"] = "Failed"
+    platform.client.update_status(pod)
+    assert platform.wait_idle()
+    events = platform.client.list("v1", "Event", "team-a")
+    assert not any(e.get("reason") == "SliceRecovery" for e in events)
+
+
 def test_stop_annotation_scales_to_zero_and_restart(platform):
     platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
     assert platform.wait_idle()
