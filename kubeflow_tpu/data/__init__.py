@@ -1,0 +1,16 @@
+"""Input pipeline: host-side prefetch + device double-buffering.
+
+The training-side data path (the reference delegates data entirely to
+workload images): keep the TPU fed by overlapping host work (decode,
+augment, batch assembly) with device compute, and place each batch onto
+the mesh with the right sharding before the step needs it.
+"""
+
+from .pipeline import DataPipeline, device_prefetch, per_host_shard, synthetic_classifier_source
+
+__all__ = [
+    "DataPipeline",
+    "device_prefetch",
+    "per_host_shard",
+    "synthetic_classifier_source",
+]

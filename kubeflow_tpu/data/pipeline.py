@@ -1,0 +1,147 @@
+"""Prefetching input pipeline for sharded training.
+
+Design (the standard TPU input recipe):
+
+- a background thread pulls batches from the (CPU-bound) source and
+  ``jax.device_put``s them with the target sharding — dispatch is async, so
+  the H2D copy of batch N+1 overlaps the compute of batch N,
+- a small bounded buffer (default 2 = double buffering) keeps host memory
+  flat while hiding host latency spikes,
+- multi-host: each process feeds only its addressable shard of the global
+  batch (``per_host_shard`` → ``jax.make_array_from_process_local_data``),
+  the same contract a grain/tf.data per-worker reader satisfies.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def per_host_shard(global_batch: int, *, process_index: Optional[int] = None,
+                   process_count: Optional[int] = None) -> Tuple[int, int]:
+    """(start, size) of this host's rows in the global batch — which examples
+    this process's reader must produce."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if global_batch % pc != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {pc} hosts")
+    size = global_batch // pc
+    return pi * size, size
+
+
+def device_prefetch(
+    source: Iterable[Any],
+    sharding: Optional[Any] = None,
+    buffer_size: int = 2,
+) -> Iterator[Any]:
+    """Iterate ``source`` with async device placement, ``buffer_size`` deep.
+
+    Each item is a pytree of numpy arrays; it is ``device_put`` (with
+    ``sharding`` if given) on a background thread, so the returned device
+    buffers are usually already resident when the consumer asks.
+    """
+    q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, buffer_size))
+    _END = object()
+    error: list = []
+    stop = threading.Event()
+
+    def _put(item: Any) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for item in source:
+                if stop.is_set():
+                    return
+                if sharding is not None:
+                    item = jax.device_put(item, sharding)
+                else:
+                    item = jax.device_put(item)
+                if not _put(item):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            error.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=produce, name="data-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if error:
+                    raise error[0]
+                return
+            yield item
+    finally:
+        # Abandoned mid-epoch (break / GeneratorExit): release the producer —
+        # it must not stay blocked on a full queue pinning device buffers.
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
+class DataPipeline:
+    """Source → (optional transform) → prefetched, sharded device batches.
+
+    ``source_fn(epoch) -> iterable of batches`` lets epochs reshuffle;
+    ``transform`` runs on the host thread (augmentation, casting).
+    """
+
+    def __init__(
+        self,
+        source_fn: Callable[[int], Iterable[Any]],
+        sharding: Optional[Any] = None,
+        transform: Optional[Callable[[Any], Any]] = None,
+        buffer_size: int = 2,
+    ):
+        self.source_fn = source_fn
+        self.sharding = sharding
+        self.transform = transform
+        self.buffer_size = buffer_size
+
+    def epoch(self, epoch: int = 0) -> Iterator[Any]:
+        source: Iterable[Any] = self.source_fn(epoch)
+        if self.transform is not None:
+            transform = self.transform
+            source = (transform(item) for item in source)
+        return device_prefetch(source, self.sharding, self.buffer_size)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self.epoch(0)
+
+
+def synthetic_classifier_source(
+    batch: int,
+    image_shape: Tuple[int, ...] = (224, 224, 3),
+    num_classes: int = 1000,
+    steps: int = 100,
+    seed: int = 0,
+) -> Callable[[int], Iterable[Any]]:
+    """Deterministic synthetic (images, labels) batches — bench/smoke data
+    with zero I/O (the compute path isolation bench.py relies on)."""
+
+    def source(epoch: int):
+        rng = np.random.default_rng(seed + epoch)
+        for _ in range(steps):
+            yield {
+                "images": rng.standard_normal((batch, *image_shape), dtype=np.float32),
+                "labels": rng.integers(0, num_classes, size=(batch,), dtype=np.int32),
+            }
+
+    return source

@@ -69,16 +69,51 @@ class ServedModel:
 
 
 class ModelServer:
-    """Hosts ServedModels over the predict API; servable with app.serve()."""
+    """Hosts ServedModels over the predict API; servable with app.serve().
 
-    def __init__(self):
+    ``batching=True`` coalesces concurrent requests per model into one
+    padded forward (serving/batching.py) — the TPU-shaped default for
+    production; off by default so single-request paths stay trivial."""
+
+    def __init__(self, batching: bool = False, max_batch: int = BATCH_BUCKETS[-1],
+                 max_wait_ms: float = 5.0):
+        if max_batch > BATCH_BUCKETS[-1]:
+            # A combined batch above the largest serving bucket would 413 on
+            # every co-batched request.
+            raise ValueError(f"max_batch {max_batch} exceeds largest bucket {BATCH_BUCKETS[-1]}")
         self.models: Dict[str, ServedModel] = {}
         self.app = App("model-server")
+        self._batching = batching
+        self._max_batch = max_batch
+        self._max_wait_ms = max_wait_ms
+        self._batchers: Dict[str, "DynamicBatcher"] = {}
         self._register_routes()
 
     def add(self, model: ServedModel) -> "ModelServer":
         self.models[model.name] = model
+        if self._batching:
+            from .batching import DynamicBatcher
+
+            old = self._batchers.pop(model.name, None)
+            if old is not None:
+                old.close()  # model reload: stop the old worker, release params
+            self._batchers[model.name] = DynamicBatcher(
+                model.predict,
+                max_batch=self._max_batch,
+                max_wait_ms=self._max_wait_ms,
+                name=model.name,
+            )
         return self
+
+    def _predict(self, model: ServedModel, instances) -> List[Any]:
+        batcher = self._batchers.get(model.name)
+        if batcher is not None:
+            return batcher.predict(instances)
+        return model.predict(instances)
+
+    def close(self) -> None:
+        for b in self._batchers.values():
+            b.close()
 
     def _model(self, name: str) -> ServedModel:
         model = self.models.get(name)
@@ -113,7 +148,7 @@ class ModelServer:
 
             t0 = time.perf_counter()
             try:
-                predictions = model.predict(instances)
+                predictions = self._predict(model, instances)
             except HttpError:
                 raise
             except Exception as e:

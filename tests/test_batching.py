@@ -1,0 +1,155 @@
+"""Dynamic serving batcher: coalescing, result routing, error isolation,
+latency bound, and the HTTP integration (concurrent predicts share one
+forward — the TPU-shaped serving behavior)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serving.batching import DynamicBatcher
+from kubeflow_tpu.serving.server import ModelServer, ServedModel
+
+
+class CountingModel:
+    """predict() that records calls and row counts; result = row * 10."""
+
+    def __init__(self, delay: float = 0.0, fail_on=None):
+        self.calls = []
+        self.delay = delay
+        self.fail_on = fail_on
+        self.lock = threading.Lock()
+
+    def predict(self, instances):
+        with self.lock:
+            self.calls.append(len(instances))
+        if self.fail_on is not None and any(i == self.fail_on for i in instances):
+            raise ValueError("poison row")
+        if self.delay:
+            time.sleep(self.delay)
+        return [i * 10 for i in instances]
+
+
+class TestDynamicBatcher:
+    def test_single_request_roundtrip(self):
+        m = CountingModel()
+        b = DynamicBatcher(m.predict, max_batch=8, max_wait_ms=1.0)
+        assert b.predict([1, 2, 3]) == [10, 20, 30]
+        b.close()
+
+    def test_concurrent_requests_coalesce(self):
+        m = CountingModel(delay=0.01)
+        b = DynamicBatcher(m.predict, max_batch=64, max_wait_ms=30.0)
+        results = {}
+
+        def client(i):
+            results[i] = b.predict([i])
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: [i * 10] for i in range(8)}  # exact routing
+        # fewer forwards than requests = coalescing happened
+        assert len(m.calls) < 8, m.calls
+        assert sum(m.calls) == 8
+
+    def test_max_batch_caps_combined_rows(self):
+        m = CountingModel(delay=0.05)
+        b = DynamicBatcher(m.predict, max_batch=4, max_wait_ms=50.0)
+        threads = [threading.Thread(target=lambda: b.predict([0, 0])) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(c <= 4 for c in m.calls), m.calls
+
+    def test_oversized_request_bypasses_queue(self):
+        m = CountingModel()
+        b = DynamicBatcher(m.predict, max_batch=4, max_wait_ms=5.0)
+        out = b.predict(list(range(10)))
+        assert out == [i * 10 for i in range(10)]
+        b.close()
+
+    def test_latency_bound_without_load(self):
+        m = CountingModel()
+        b = DynamicBatcher(m.predict, max_batch=1024, max_wait_ms=20.0)
+        t0 = time.perf_counter()
+        b.predict([1])
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.0, f"single request waited {elapsed}s"
+        b.close()
+
+    def test_batch_failure_routes_to_all_members_and_recovers(self):
+        m = CountingModel(fail_on=99)
+        b = DynamicBatcher(m.predict, max_batch=8, max_wait_ms=1.0)
+        with pytest.raises(ValueError, match="poison"):
+            b.predict([99])
+        # batcher survives and serves the next request
+        assert b.predict([1]) == [10]
+        b.close()
+
+    def test_closed_batcher_rejects(self):
+        b = DynamicBatcher(lambda x: x, max_batch=8)
+        b.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            b.predict([1])
+
+
+class TestServerIntegration:
+    def test_http_concurrent_predicts_share_forwards(self):
+        import json
+        import urllib.request
+
+        model = ServedModel(name="m", apply_fn=lambda params, batch: batch * 2.0, params=None)
+        # Count real predict() executions (a jitted apply_fn only runs
+        # Python at trace time, so instrument above the jit boundary).
+        predict_calls = []
+        real_predict = model.predict
+
+        def counting_predict(instances):
+            predict_calls.append(len(instances))
+            return real_predict(instances)
+
+        model.predict = counting_predict
+        server = ModelServer(batching=True, max_wait_ms=25.0).add(model)
+        http = server.serve(0)
+        base = f"http://127.0.0.1:{http.port}"
+        outs = {}
+
+        def client(i):
+            req = urllib.request.Request(
+                base + "/v1/models/m:predict",
+                json.dumps({"instances": [[float(i)]]}).encode(),
+                {"content-type": "application/json"},
+            )
+            outs[i] = json.loads(urllib.request.urlopen(req, timeout=10).read())["predictions"]
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outs == {i: [[2.0 * i]] for i in range(6)}
+        # fewer forwards than requests = requests actually coalesced
+        assert len(predict_calls) < 6, predict_calls
+        assert sum(predict_calls) == 6
+        http.close()
+        server.close()
+
+    def test_max_batch_validated_against_buckets(self):
+        with pytest.raises(ValueError, match="exceeds largest bucket"):
+            ModelServer(batching=True, max_batch=1024)
+
+    def test_model_reload_closes_old_batcher(self):
+        model_a = ServedModel(name="m", apply_fn=lambda p, b: b, params=None)
+        server = ModelServer(batching=True).add(model_a)
+        old = server._batchers["m"]
+        model_b = ServedModel(name="m", apply_fn=lambda p, b: b + 1.0, params=None)
+        server.add(model_b)
+        with pytest.raises(RuntimeError, match="closed"):
+            old.predict([np.zeros((1,))])
+        assert server._batchers["m"] is not old
+        server.close()
