@@ -1,0 +1,196 @@
+"""GPT-style decoder-only causal LM — the long-context flagship.
+
+The platform's transformer training family (BASELINE's BERT covers the
+serving/MLM path; this covers autoregressive training at long sequence
+lengths). TPU-first choices:
+
+- attention runs the Pallas flash kernel (ops/flash_attention) by default —
+  fused, O(L) memory, causal masking inside the kernel; the attention fn is
+  injectable so ring attention (parallel/ring_attention) drops in for
+  sequence parallelism over the ``seq`` mesh axis,
+- rotary position embeddings (no learned position table to shard),
+- pre-LN blocks, bf16 activations / f32 params + norms,
+- parameter names follow kubeflow_tpu.parallel.sharding's logical-axis
+  conventions (query/key/value → heads, up_proj/down_proj → mlp,
+  embedding → vocab/embed), so dp/fsdp/tp placement is a rules swap,
+- optional MoE FFN (parallel/moe) for expert parallelism,
+- optional per-block remat (``jax.checkpoint``) — trade recompute for HBM
+  at long context.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.flash_attention import flash_attention
+
+
+@dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 32000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    # MoE: num_experts=0 = dense FFN; >0 replaces the MLP every block.
+    num_experts: int = 0
+    moe_k: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls) -> "GptConfig":
+        return cls(vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_seq=128)
+
+    @classmethod
+    def small(cls) -> "GptConfig":
+        return cls(d_model=768, n_layers=12, n_heads=12, d_ff=3072)  # ~GPT-2 124M
+
+    @classmethod
+    def base(cls) -> "GptConfig":
+        return cls(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)  # ~GPT-2 medium
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [b, L, heads, head_dim]; positions: [L]."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [L, half]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def causal_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    return flash_attention(q, k, v, causal=True)
+
+
+class GptAttention(nn.Module):
+    cfg: GptConfig
+    attention_fn: Callable = causal_flash_attention
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = functools.partial(
+            nn.DenseGeneral,
+            features=(cfg.n_heads, cfg.head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            use_bias=False,
+        )
+        q = rope(dense(name="query")(x), positions, cfg.rope_theta)
+        k = rope(dense(name="key")(x), positions, cfg.rope_theta)
+        v = dense(name="value")(x)
+        ctx = self.attention_fn(q, k, v)  # [b, L, heads, head_dim]
+        return nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            use_bias=False,
+            name="out_proj",
+        )(ctx)
+
+
+class GptMlp(nn.Module):
+    cfg: GptConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     use_bias=False, name="up_proj")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+                        use_bias=False, name="down_proj")(h)
+
+
+class GptBlock(nn.Module):
+    cfg: GptConfig
+    attention_fn: Callable = causal_flash_attention
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
+        x = x + GptAttention(cfg, self.attention_fn, name="attention")(
+            ln(name="ln_attn")(x).astype(cfg.dtype), positions
+        )
+        normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
+        if cfg.num_experts > 0:
+            from kubeflow_tpu.parallel.moe import MoEMlp
+
+            ffn = MoEMlp(
+                num_experts=cfg.num_experts,
+                d_ff=cfg.d_ff,
+                k=cfg.moe_k,
+                mesh=self.mesh,
+                dtype=cfg.dtype,
+                name="moe",
+            )(normed)
+        else:
+            ffn = GptMlp(cfg, name="mlp")(normed)
+        return x + ffn
+
+
+class GptLM(nn.Module):
+    """Decoder-only LM. input_ids [b, L] -> logits [b, L, vocab] (f32).
+
+    The output projection ties to the input embedding (standard GPT-2
+    weight tying — halves the largest parameter and its gradient traffic).
+    """
+
+    cfg: GptConfig
+    attention_fn: Callable = causal_flash_attention
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            name="embedding",
+        )
+        x = embed(input_ids)
+        positions = jnp.arange(input_ids.shape[1])
+        block = GptBlock
+        if cfg.remat:
+            block = nn.remat(GptBlock, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.attention_fn, self.mesh, name=f"block_{i}")(x, positions)
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
+        # tied LM head in f32 (embed.attend would compute in the module's
+        # bf16 dtype; the final softmax wants full precision)
+        logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
+        return logits
+
+
+def causal_lm_loss(logits: jax.Array, input_ids: jax.Array) -> jax.Array:
+    """Next-token cross entropy; position t predicts token t+1."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    targets = input_ids[:, 1:]
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)

@@ -35,6 +35,7 @@ from ..runtime.metrics import METRICS
 @dataclass
 class _Pending:
     instances: Sequence[Any]
+    shape_sig: Any  # (per-instance shape, dtype) — only like-shaped requests co-batch
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[Any]] = None
     error: Optional[BaseException] = None
@@ -68,11 +69,20 @@ class DynamicBatcher:
         self._worker.start()
 
     # -- client side ---------------------------------------------------------
+    @staticmethod
+    def _signature(instances: Sequence[Any]):
+        """Per-instance (shape, dtype); raises ValueError for ragged input so
+        a malformed request fails ALONE, never inside someone else's batch."""
+        import numpy as np
+
+        arr = np.asarray(instances)  # raises on inhomogeneous shapes
+        return arr.shape[1:], str(arr.dtype)
+
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         if len(instances) >= self.max_batch:
             # Oversized requests run alone — no point queueing behind them.
             return self.predict_fn(instances)
-        pending = _Pending(instances)
+        pending = _Pending(instances, self._signature(instances))
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher closed")
@@ -106,14 +116,23 @@ class DynamicBatcher:
             # Take only what fits under max_batch; the rest stays queued for
             # the next forward (otherwise a burst would exceed the largest
             # serving bucket in a single combined batch).
-            # Every queued pending has < max_batch rows (oversized requests
-            # bypass the queue), so this always takes at least one.
+            # Take like-shaped pendings only (mixed shapes cannot share one
+            # array), up to max_batch rows. Every queued pending has
+            # < max_batch rows, so this always takes at least one; other
+            # shapes stay queued for the next round.
             batch: List[_Pending] = []
             rows = 0
-            while self._queue and rows + len(self._queue[0].instances) <= self.max_batch:
-                p = self._queue.pop(0)
-                batch.append(p)
-                rows += len(p.instances)
+            sig = self._queue[0].shape_sig
+            remaining_queue: List[_Pending] = []
+            for p in self._queue:
+                if p.shape_sig == sig and rows + len(p.instances) <= self.max_batch:
+                    batch.append(p)
+                    rows += len(p.instances)
+                else:
+                    remaining_queue.append(p)
+            self._queue = remaining_queue
+            if remaining_queue:
+                self._lock.notify()  # wake for the next round immediately
             return batch
 
     def _run(self) -> None:

@@ -108,7 +108,14 @@ class ModelServer:
     def _predict(self, model: ServedModel, instances) -> List[Any]:
         batcher = self._batchers.get(model.name)
         if batcher is not None:
-            return batcher.predict(instances)
+            try:
+                return batcher.predict(instances)
+            except RuntimeError as e:
+                if "closed" not in str(e):
+                    raise
+                # Model hot-reload raced this request: the batcher we fetched
+                # was closed by add(). Serve directly — correctness over
+                # coalescing for the handful of in-flight requests.
         return model.predict(instances)
 
     def close(self) -> None:

@@ -91,6 +91,31 @@ class TestDynamicBatcher:
         assert b.predict([1]) == [10]
         b.close()
 
+    def test_mixed_shapes_do_not_poison_each_other(self):
+        """Two valid requests with different instance shapes must both
+        succeed — only like-shaped requests share a combined array."""
+        import numpy as np
+
+        def predict(instances):
+            arr = np.asarray(instances)  # raises on ragged input
+            return [row.tolist() for row in arr]
+
+        b = DynamicBatcher(predict, max_batch=16, max_wait_ms=20.0)
+        results = {}
+        threads = [
+            threading.Thread(target=lambda: results.update(a=b.predict([[1.0]]))),
+            threading.Thread(target=lambda: results.update(bb=b.predict([[1.0, 2.0]]))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results["a"] == [[1.0]] and results["bb"] == [[1.0, 2.0]]
+        # a ragged request fails alone, at enqueue time
+        with pytest.raises(ValueError):
+            b.predict([[1.0], [1.0, 2.0]])
+        b.close()
+
     def test_closed_batcher_rejects(self):
         b = DynamicBatcher(lambda x: x, max_batch=8)
         b.close()
