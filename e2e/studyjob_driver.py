@@ -57,9 +57,13 @@ def run_studyjob_e2e(
     parallel: int = 2,
     timeout: float = 120.0,
 ) -> Dict[str, Any]:
-    """Create a StudyJob, drive it to completion, return its final status."""
+    """Create a StudyJob, drive it to completion, return its final status
+    (including measured trials/hour — the BASELINE Katib metric)."""
+    import time as _time
+
     with E2ECluster(trial_runner=InProcessTrialRunner(OBJECTIVES[objective])) as cluster:
         ns = cluster.create_profile("katib-e2e@example.com", unique_namespace("katib"))
+        t_start = _time.perf_counter()
         cluster.client.create(studyjob_cr("study-e2e", ns, max_trials, parallel))
 
         def get_phase() -> str:
@@ -90,6 +94,9 @@ def run_studyjob_e2e(
         ]
         observed = [v for v in observed if v is not None]
         assert abs(best - max(observed)) < 1e-9, (best, max(observed))
+        elapsed = _time.perf_counter() - t_start
+        status["elapsedSeconds"] = round(elapsed, 3)
+        status["trialsPerHour"] = round(max_trials / elapsed * 3600.0, 1)
         return status
 
 

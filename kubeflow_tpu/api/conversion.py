@@ -1,0 +1,73 @@
+"""API version conversion: hub-and-spoke, served at the REST layer.
+
+The reference's Notebook CRD serves three versions converting through a
+storage hub (api/v1/notebook_conversion.go:24-69 — v1 and v1alpha1 convert
+to/from v1beta1; the schemas are structurally identical, so conversion is
+the apiVersion stamp plus any registered field mappers). Same model here:
+spoke versions are registered REST surfaces; objects are STORED at the hub
+version only; the apiserver converts on the way in and out.
+
+In-process clients (controllers) always speak the hub version — conversion
+is an API-server concern, exactly as in Kubernetes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import meta as apimeta
+from .meta import REGISTRY, Resource
+
+#: (group, kind) -> hub (storage) version
+_HUBS: Dict[Tuple[str, str], str] = {}
+
+#: (group, kind, from_version, to_version) -> field mapper (post stamp-swap)
+_MAPPERS: Dict[Tuple[str, str, str, str], Callable[[Dict[str, Any]], Dict[str, Any]]] = {}
+
+
+def register_spokes(group: str, kind: str, hub_version: str, *spoke_versions: str) -> None:
+    """Declare spoke versions for a kind whose hub Resource is registered."""
+    hub = REGISTRY.for_gvk(apimeta.GroupVersionKind(group, hub_version, kind))
+    _HUBS[(group, kind)] = hub_version
+    for version in spoke_versions:
+        REGISTRY.register(
+            Resource(group, version, kind, hub.plural, namespaced=hub.namespaced,
+                     list_kind=hub.list_kind)
+        )
+
+
+def register_mapper(group: str, kind: str, from_version: str, to_version: str,
+                    fn: Callable[[Dict[str, Any]], Dict[str, Any]]) -> None:
+    _MAPPERS[(group, kind, from_version, to_version)] = fn
+
+
+def hub_version(group: str, kind: str) -> Optional[str]:
+    return _HUBS.get((group, kind))
+
+
+def hub_resource(res: Resource) -> Resource:
+    """The storage Resource for ``res`` (itself if it IS the hub or has none)."""
+    hub = _HUBS.get((res.group, res.kind))
+    if hub is None or hub == res.version:
+        return res
+    return REGISTRY.for_gvk(apimeta.GroupVersionKind(res.group, hub, res.kind))
+
+
+def convert(obj: Dict[str, Any], group: str, kind: str, to_version: str) -> Dict[str, Any]:
+    """Convert between served versions: stamp swap + registered mapper."""
+    current = apimeta.gvk_of(obj).version
+    if current == to_version:
+        return obj
+    out = apimeta.deepcopy(obj)
+    out["apiVersion"] = f"{group}/{to_version}" if group else to_version
+    mapper = _MAPPERS.get((group, kind, current, to_version))
+    if mapper is not None:
+        out = mapper(out)
+    return out
+
+
+# --- platform registrations --------------------------------------------------
+# Notebook: hub v1beta1, spokes v1alpha1 + v1 (reference hub-and-spoke —
+# notebook-controller registers 3 API versions, main.go:40-47; conversion is
+# structural identity, api/v1/notebook_conversion.go).
+register_spokes("kubeflow.org", "Notebook", "v1beta1", "v1alpha1", "v1")

@@ -25,6 +25,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from ..api import meta as apimeta
+from ..api.conversion import convert, hub_resource
 from ..api.meta import REGISTRY, Resource
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .store import ApiError, Forbidden, Store
@@ -116,6 +117,9 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         store.register_admission(webhook_admission_hook(webhook_url))
 
     def res_of(req: Request) -> Resource:
+        """Resource addressed by the URL. May be a SPOKE version — handlers
+        store/watch via ``hub_resource(res)`` and convert responses back to
+        the requested version (hub-and-spoke, conversion.py)."""
         group = req.params.get("group", "")
         version = req.params["version"]
         api_version = f"{group}/{version}" if group else version
@@ -123,6 +127,21 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
             return REGISTRY.for_plural(api_version, req.params["plural"])
         except KeyError as e:
             raise HttpError(404, str(e)) from None
+
+    def outbound(obj: Dict[str, Any], res: Resource) -> Dict[str, Any]:
+        return convert(obj, res.group, res.kind, res.version)
+
+    def inbound(obj: Dict[str, Any], res: Resource) -> Dict[str, Any]:
+        # The body must name the version the endpoint serves — blind
+        # restamping would accept bogus versions and skip the registered
+        # (endpoint-version → hub) field mappers.
+        body_version = obj.get("apiVersion", "")
+        if body_version != res.api_version:
+            raise HttpError(
+                400,
+                f"body apiVersion {body_version!r} does not match endpoint {res.api_version!r}",
+            )
+        return convert(obj, res.group, res.kind, hub_resource(res).version)
 
     def error(e: ApiError) -> JsonResponse:
         return JsonResponse(e.to_status(), status=e.code)
@@ -135,14 +154,14 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         if req.query1("watch") in ("true", "1"):
             return _watch_stream(store, res, ns, selector, req)
         try:
-            items = store.list(res, namespace=ns, label_selector=selector)
+            items = store.list(hub_resource(res), namespace=ns, label_selector=selector)
         except ApiError as e:
             return error(e)
         return {
             "apiVersion": res.api_version,
             "kind": res.list_kind or f"{res.kind}List",
             "metadata": {"resourceVersion": str(store.backend.current_rv())},
-            "items": items,
+            "items": [outbound(o, res) for o in items],
         }
 
     def create(req: Request):
@@ -153,13 +172,14 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         if req.params.get("ns"):
             obj.setdefault("metadata", {}).setdefault("namespace", req.params["ns"])
         try:
-            return JsonResponse(store.create(obj), status=201)
+            return JsonResponse(outbound(store.create(inbound(obj, res)), res), status=201)
         except ApiError as e:
             return error(e)
 
     def get_item(req: Request):
+        res = res_of(req)
         try:
-            return store.get(res_of(req), req.params["name"], req.params.get("ns"))
+            return outbound(store.get(hub_resource(res), req.params["name"], req.params.get("ns")), res)
         except ApiError as e:
             return error(e)
 
@@ -176,30 +196,42 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
             )
 
     def put_item(req: Request):
+        res = res_of(req)
         obj = req.json or {}
         _check_body_matches_path(req, obj)
         try:
-            return store.update(obj)
+            return outbound(store.update(inbound(obj, res)), res)
         except ApiError as e:
             return error(e)
 
     def put_status(req: Request):
+        res = res_of(req)
         obj = req.json or {}
         _check_body_matches_path(req, obj)
         try:
-            return store.update_status(obj)
+            return outbound(store.update_status(inbound(obj, res)), res)
         except ApiError as e:
             return error(e)
 
     def patch_item(req: Request):
+        res = res_of(req)
+        patch = dict(req.json or {})
+        # apiVersion/kind are endpoint-determined; merging a spoke version
+        # into the stored hub object would corrupt its storage key.
+        patch.pop("apiVersion", None)
+        patch.pop("kind", None)
         try:
-            return store.patch(res_of(req), req.params["name"], req.json or {}, req.params.get("ns"))
+            return outbound(
+                store.patch(hub_resource(res), req.params["name"], patch, req.params.get("ns")),
+                res,
+            )
         except ApiError as e:
             return error(e)
 
     def delete_item(req: Request):
+        res = res_of(req)
         try:
-            return store.delete(res_of(req), req.params["name"], req.params.get("ns"))
+            return outbound(store.delete(hub_resource(res), req.params["name"], req.params.get("ns")), res)
         except ApiError as e:
             return error(e)
 
@@ -247,7 +279,7 @@ def _watch_stream(
     send_initial = req.query1("sendInitial") in ("true", "1")
     try:
         watcher = store.watch(
-            res,
+            hub_resource(res),
             namespace=ns,
             label_selector=selector,
             send_initial=send_initial,
@@ -271,7 +303,8 @@ def _watch_stream(
                 continue
             if item is None:
                 return
-            yield json.dumps({"type": item.type, "object": item.object}).encode() + b"\n"
+            obj = convert(item.object, res.group, res.kind, res.version)
+            yield json.dumps({"type": item.type, "object": obj}).encode() + b"\n"
 
     return StreamingResponse(
         chunks(),

@@ -32,6 +32,10 @@ from typing import Any, List, Optional, Sequence
 from ..runtime.metrics import METRICS
 
 
+class BatcherClosed(RuntimeError):
+    """The batcher was shut down (model reload/unload) — retry unbatched."""
+
+
 @dataclass
 class _Pending:
     instances: Sequence[Any]
@@ -63,6 +67,7 @@ class DynamicBatcher:
         self._lock = threading.Condition()
         self._queue: List[_Pending] = []
         self._closed = False
+        self._flush_leftovers = False
         self._worker = threading.Thread(
             target=self._run, name=f"batcher-{name}", daemon=True
         )
@@ -85,7 +90,7 @@ class DynamicBatcher:
         pending = _Pending(instances, self._signature(instances))
         with self._lock:
             if self._closed:
-                raise RuntimeError("batcher closed")
+                raise BatcherClosed("batcher closed")
             self._queue.append(pending)
             self._lock.notify()
         pending.done.wait()
@@ -106,16 +111,16 @@ class DynamicBatcher:
                 self._lock.wait()
             if self._closed and not self._queue:
                 return []
-            deadline = time.monotonic() + self.max_wait_s
-            while True:
-                rows = sum(len(p.instances) for p in self._queue)
-                remaining = deadline - time.monotonic()
-                if rows >= self.max_batch or remaining <= 0 or self._closed:
-                    break
-                self._lock.wait(remaining)
-            # Take only what fits under max_batch; the rest stays queued for
-            # the next forward (otherwise a burst would exceed the largest
-            # serving bucket in a single combined batch).
+            # Leftovers from a mixed-shape round already waited their
+            # window — serve them immediately instead of a fresh max_wait.
+            if not self._flush_leftovers:
+                deadline = time.monotonic() + self.max_wait_s
+                while True:
+                    rows = sum(len(p.instances) for p in self._queue)
+                    remaining = deadline - time.monotonic()
+                    if rows >= self.max_batch or remaining <= 0 or self._closed:
+                        break
+                    self._lock.wait(remaining)
             # Take like-shaped pendings only (mixed shapes cannot share one
             # array), up to max_batch rows. Every queued pending has
             # < max_batch rows, so this always takes at least one; other
@@ -131,8 +136,7 @@ class DynamicBatcher:
                 else:
                     remaining_queue.append(p)
             self._queue = remaining_queue
-            if remaining_queue:
-                self._lock.notify()  # wake for the next round immediately
+            self._flush_leftovers = bool(remaining_queue)
             return batch
 
     def _run(self) -> None:

@@ -106,16 +106,17 @@ class ModelServer:
         return self
 
     def _predict(self, model: ServedModel, instances) -> List[Any]:
+        from .batching import BatcherClosed
+
         batcher = self._batchers.get(model.name)
         if batcher is not None:
             try:
                 return batcher.predict(instances)
-            except RuntimeError as e:
-                if "closed" not in str(e):
-                    raise
+            except BatcherClosed:
                 # Model hot-reload raced this request: the batcher we fetched
                 # was closed by add(). Serve directly — correctness over
                 # coalescing for the handful of in-flight requests.
+                pass
         return model.predict(instances)
 
     def close(self) -> None:

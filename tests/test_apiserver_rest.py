@@ -329,6 +329,99 @@ class TestWebhookLoop:
             api_server.close()
 
 
+class TestVersionConversion:
+    """Hub-and-spoke API versions (reference notebook CRD: v1alpha1/v1beta1/
+    v1 converting through the v1beta1 hub — conversion at the API server)."""
+
+    def test_create_at_spoke_read_at_hub_and_other_spoke(self, rest):
+        store, remote, base = rest
+        v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        alpha = REGISTRY.for_kind("kubeflow.org/v1alpha1", "Notebook")
+        remote.create(
+            new_object("kubeflow.org/v1", "Notebook", "conv", "default",
+                       spec={"template": {"spec": {"containers": [{"name": "c"}]}}})
+        )
+        # stored at the hub version
+        assert store.get(hub, "conv", "default")["apiVersion"] == "kubeflow.org/v1beta1"
+        # readable at every served version, stamped accordingly
+        assert remote.get(v1, "conv", "default")["apiVersion"] == "kubeflow.org/v1"
+        assert remote.get(alpha, "conv", "default")["apiVersion"] == "kubeflow.org/v1alpha1"
+        assert remote.get(hub, "conv", "default")["apiVersion"] == "kubeflow.org/v1beta1"
+        # lists convert too
+        items = remote.list(v1, "default")
+        assert items and all(o["apiVersion"] == "kubeflow.org/v1" for o in items)
+
+    def test_spoke_update_roundtrip(self, rest):
+        store, remote, base = rest
+        v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+        remote.create(new_object("kubeflow.org/v1", "Notebook", "upd", "default", spec={"template": {}}))
+        obj = remote.get(v1, "upd", "default")
+        obj["spec"]["tpu"] = {"generation": "v5e", "topology": "2x2"}
+        updated = remote.update(obj)
+        assert updated["apiVersion"] == "kubeflow.org/v1"
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        assert store.get(hub, "upd", "default")["spec"]["tpu"]["topology"] == "2x2"
+
+    def test_spoke_watch_converts_events(self, rest):
+        store, remote, base = rest
+        v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+        watcher = remote.watch(v1, namespace="default", send_initial=True)
+        remote.create(new_object("kubeflow.org/v1beta1", "Notebook", "w", "default", spec={}))
+        first = next(iter(watcher))
+        assert first.object["apiVersion"] == "kubeflow.org/v1"
+        watcher.close()
+
+    def test_bogus_body_api_version_rejected(self, rest):
+        store, remote, base = rest
+        req = urllib.request.Request(
+            base + "/apis/kubeflow.org/v1/namespaces/default/notebooks",
+            json.dumps(
+                {"apiVersion": "kubeflow.org/v999", "kind": "Notebook",
+                 "metadata": {"name": "bad", "namespace": "default"}, "spec": {}}
+            ).encode(),
+            {"content-type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        with pytest.raises(NotFound):
+            remote.get(hub, "bad", "default")
+
+    def test_spoke_patch_with_api_version_in_body(self, rest):
+        """kubectl-style merge patches carry apiVersion/kind; they must not
+        corrupt the stored hub object's identity."""
+        store, remote, base = rest
+        v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+        remote.create(new_object("kubeflow.org/v1", "Notebook", "pv", "default", spec={}))
+        out = remote.patch(
+            v1, "pv",
+            {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+             "metadata": {"annotations": {"a": "1"}}},
+            "default",
+        )
+        assert out["apiVersion"] == "kubeflow.org/v1"
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        stored = store.get(hub, "pv", "default")
+        assert stored["apiVersion"] == "kubeflow.org/v1beta1"
+        assert stored["metadata"]["annotations"] == {"a": "1"}
+        # still reachable/patachable again at the spoke (storage key intact)
+        assert remote.get(v1, "pv", "default")["metadata"]["annotations"] == {"a": "1"}
+
+    def test_spoke_events_reach_hub_controllers(self, rest):
+        """A controller watching the hub must see CRs created at any spoke."""
+        store, remote, base = rest
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        w = store.watch(hub, send_initial=False)
+        remote.create(new_object("kubeflow.org/v1alpha1", "Notebook", "legacy", "default", spec={}))
+        w.close()
+        events = list(w)
+        assert any(e.object["metadata"]["name"] == "legacy" for e in events)
+
+
 class TestJsonPatch:
     def test_apply_ops(self):
         obj = {"a": {"b": 1}, "arr": [1, 2]}
