@@ -66,6 +66,22 @@ def convert(obj: Dict[str, Any], group: str, kind: str, to_version: str) -> Dict
     return out
 
 
+def convert_fragment(
+    fragment: Dict[str, Any], group: str, kind: str, from_version: str, to_version: str
+) -> Dict[str, Any]:
+    """Convert a PARTIAL object (merge-patch body) between versions.
+
+    Mappers must tolerate partial objects (missing sections untouched) —
+    the contract a merge patch at a spoke endpoint needs so version-specific
+    field renames apply before the merge into hub storage."""
+    if from_version == to_version:
+        return fragment
+    mapper = _MAPPERS.get((group, kind, from_version, to_version))
+    if mapper is None:
+        return fragment
+    return mapper(apimeta.deepcopy(fragment))
+
+
 # --- platform registrations --------------------------------------------------
 # Notebook: hub v1beta1, spokes v1alpha1 + v1 (reference hub-and-spoke —
 # notebook-controller registers 3 API versions, main.go:40-47; conversion is

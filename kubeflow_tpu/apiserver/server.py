@@ -25,7 +25,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from ..api import meta as apimeta
-from ..api.conversion import convert, hub_resource
+from ..api.conversion import convert, convert_fragment, hub_resource
 from ..api.meta import REGISTRY, Resource
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .store import ApiError, Forbidden, Store
@@ -220,6 +220,10 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         # into the stored hub object would corrupt its storage key.
         patch.pop("apiVersion", None)
         patch.pop("kind", None)
+        # spoke→hub field mappers apply to the fragment before the merge
+        patch = convert_fragment(
+            patch, res.group, res.kind, res.version, hub_resource(res).version
+        )
         try:
             return outbound(
                 store.patch(hub_resource(res), req.params["name"], patch, req.params.get("ns")),

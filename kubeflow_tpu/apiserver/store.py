@@ -28,9 +28,22 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..api import conversion
 from ..api import meta as apimeta
 from ..api.meta import REGISTRY, Resource
 from .backend import DictBackend, JournalExpired, NativeBackend, default_backend  # noqa: F401
+
+
+def _to_hub(obj: Dict[str, Any]) -> Tuple[Resource, Dict[str, Any]]:
+    """Resolve an object's Resource, routing spoke versions to the storage
+    hub — a spoke-stamped object must never land in a spoke bucket where hub
+    controllers and the REST surface would not see it (split-brain)."""
+    res = REGISTRY.for_object(obj)
+    hub = conversion.hub_resource(res)
+    if hub is not res:
+        obj = conversion.convert(obj, res.group, res.kind, hub.version)
+        res = hub
+    return res, obj
 
 
 class ApiError(Exception):
@@ -176,7 +189,7 @@ class Store:
 
     # -- CRUD ---------------------------------------------------------------
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        res = REGISTRY.for_object(obj)
+        res, obj = _to_hub(obj)
         obj = apimeta.deepcopy(obj)
         md = obj.setdefault("metadata", {})
         name = md.get("name") or ""
@@ -204,6 +217,7 @@ class Store:
             return apimeta.deepcopy(obj)
 
     def get(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        res = conversion.hub_resource(res)
         with self._lock:
             ns, name = self._obj_key(res, namespace, name)
             obj = self.backend.get(res.key, ns, name)
@@ -219,6 +233,7 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
         field_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
+        res = conversion.hub_resource(res)
         with self._lock:
             ns = namespace if (res.namespaced and namespace is not None) else None
             out = self.backend.list(res.key, ns, label_selector)
@@ -227,7 +242,7 @@ class Store:
             return out
 
     def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
-        res = REGISTRY.for_object(obj)
+        res, obj = _to_hub(obj)
         obj = apimeta.deepcopy(obj)
         md = obj.setdefault("metadata", {})
         with self._lock:
@@ -293,6 +308,7 @@ class Store:
             return self.update(merged)
 
     def delete(self, res: Resource, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
+        res = conversion.hub_resource(res)
         with self._lock:
             ns, name = self._obj_key(res, namespace, name)
             obj = self.backend.get(res.key, ns, name)
@@ -337,6 +353,8 @@ class Store:
         journal (native backend only) before going live — etcd watch-window
         semantics; raises Expired (410) when the window has been trimmed, in
         which case the caller relists (informer resync)."""
+        if res is not None:
+            res = conversion.hub_resource(res)
         key = res.key if res else "*"
         w = _Watcher(key, namespace, label_selector)
         with self._lock:

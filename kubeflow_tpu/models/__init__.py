@@ -11,5 +11,5 @@ by swapping rule tables.
 
 from kubeflow_tpu.models.resnet import ResNet50, ResNet18  # noqa: F401
 from kubeflow_tpu.models.bert import BertConfig, BertEncoder, BertForMaskedLM  # noqa: F401
-from kubeflow_tpu.models.gpt import GptConfig, GptLM, causal_lm_loss  # noqa: F401
+from kubeflow_tpu.models.gpt import GptConfig, GptLM, causal_lm_loss, generate  # noqa: F401
 from kubeflow_tpu.models.mnist import MnistCNN  # noqa: F401

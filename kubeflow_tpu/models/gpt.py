@@ -85,6 +85,7 @@ def causal_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Arra
 class GptAttention(nn.Module):
     cfg: GptConfig
     attention_fn: Callable = causal_flash_attention
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -97,18 +98,68 @@ class GptAttention(nn.Module):
             param_dtype=jnp.float32,
             use_bias=False,
         )
+        if self.decode:
+            return self._decode_attention(x, dense)
         q = rope(dense(name="query")(x), positions, cfg.rope_theta)
         k = rope(dense(name="key")(x), positions, cfg.rope_theta)
         v = dense(name="value")(x)
         ctx = self.attention_fn(q, k, v)  # [b, L, heads, head_dim]
+        return self._out_proj(ctx)
+
+    def _out_proj(self, ctx: jax.Array) -> jax.Array:
         return nn.DenseGeneral(
-            features=cfg.d_model,
+            features=self.cfg.d_model,
             axis=(-2, -1),
-            dtype=cfg.dtype,
+            dtype=self.cfg.dtype,
             param_dtype=jnp.float32,
             use_bias=False,
             name="out_proj",
         )(ctx)
+
+    def _decode_attention(self, x: jax.Array, dense) -> jax.Array:
+        """Incremental attention against a KV cache (prefill: L>1 from
+        position 0; decode steps: L==1 appended at the cache cursor).
+        Static shapes throughout — the cache is [b, max_seq, h, d] and the
+        validity mask makes unwritten slots invisible."""
+        cfg = self.cfg
+        b, seg_len = x.shape[0], x.shape[1]
+        cache_k = self.variable(
+            "cache", "k", jnp.zeros, (b, cfg.max_seq, cfg.n_heads, cfg.head_dim), cfg.dtype
+        )
+        cache_v = self.variable(
+            "cache", "v", jnp.zeros, (b, cfg.max_seq, cfg.n_heads, cfg.head_dim), cfg.dtype
+        )
+        cursor = self.variable("cache", "cursor", lambda: jnp.zeros((), jnp.int32))
+        start = cursor.value
+        seg_positions = start + jnp.arange(seg_len)
+
+        q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
+        k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
+        v = dense(name="value")(x)
+        keys = jax.lax.dynamic_update_slice(cache_k.value, k, (0, start, 0, 0))
+        values = jax.lax.dynamic_update_slice(cache_v.value, v, (0, start, 0, 0))
+        # flax init runs the forward once for shapes/params — the cache must
+        # not advance then, or the first real prefill starts mid-cache.
+        if not self.is_initializing():
+            cache_k.value = keys
+            cache_v.value = values
+            cursor.value = start + seg_len
+
+        scale = cfg.head_dim**-0.5
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(jnp.float32),
+                keys.astype(jnp.float32),
+            )
+            * scale
+        )
+        key_positions = jnp.arange(cfg.max_seq)
+        mask = key_positions[None, None, None, :] <= seg_positions[None, None, :, None]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, values.astype(jnp.float32))
+        return self._out_proj(ctx.astype(cfg.dtype))
 
 
 class GptMlp(nn.Module):
@@ -128,12 +179,13 @@ class GptBlock(nn.Module):
     cfg: GptConfig
     attention_fn: Callable = causal_flash_attention
     mesh: Optional[Any] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
-        x = x + GptAttention(cfg, self.attention_fn, name="attention")(
+        x = x + GptAttention(cfg, self.attention_fn, self.decode, name="attention")(
             ln(name="ln_attn")(x).astype(cfg.dtype), positions
         )
         normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
@@ -163,6 +215,7 @@ class GptLM(nn.Module):
     cfg: GptConfig
     attention_fn: Callable = causal_flash_attention
     mesh: Optional[Any] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, input_ids: jax.Array) -> jax.Array:
@@ -175,12 +228,14 @@ class GptLM(nn.Module):
             name="embedding",
         )
         x = embed(input_ids)
-        positions = jnp.arange(input_ids.shape[1])
+        positions = jnp.arange(input_ids.shape[1])  # decode path derives its own
         block = GptBlock
         if cfg.remat:
             block = nn.remat(GptBlock, static_argnums=())
         for i in range(cfg.n_layers):
-            x = block(cfg, self.attention_fn, self.mesh, name=f"block_{i}")(x, positions)
+            x = block(cfg, self.attention_fn, self.mesh, self.decode, name=f"block_{i}")(
+                x, positions
+            )
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
         # tied LM head in f32 (embed.attend would compute in the module's
         # bf16 dtype; the final softmax wants full precision)
@@ -194,3 +249,74 @@ def causal_lm_loss(logits: jax.Array, input_ids: jax.Array) -> jax.Array:
     targets = input_ids[:, 1:]
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(picked)
+
+
+@functools.lru_cache(maxsize=64)
+def _generate_fn(cfg: GptConfig, max_new_tokens: int, temperature: float):
+    """One compiled decode program per (config, token budget, temperature);
+    prompt shape differences re-specialize inside the same jit cache."""
+    model = GptLM(cfg, decode=True)
+
+    def sample(logits: jax.Array, key: jax.Array) -> jax.Array:
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    @jax.jit
+    def run(params, cache, prompt_ids, rng):
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, prompt_ids, mutable=["cache"]
+        )
+        rng, key = jax.random.split(rng)
+        tok = sample(logits[:, -1], key)
+
+        def step(carry, _):
+            cache, tok, rng = carry
+            logits, updated = model.apply(
+                {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+            )
+            rng, key = jax.random.split(rng)
+            nxt = sample(logits[:, -1], key)
+            return (updated["cache"], nxt, rng), tok
+
+        (cache, last, rng), toks = jax.lax.scan(
+            step, (updated["cache"], tok, rng), None, length=max_new_tokens - 1
+        )
+        generated = jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+        return jnp.concatenate([prompt_ids.astype(jnp.int32), generated], axis=1)
+
+    return model, run
+
+
+def generate(
+    cfg: GptConfig,
+    params: Any,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Autoregressive decoding with a KV cache: one prefill forward over the
+    prompt, then `lax.scan` single-token steps — static shapes throughout
+    (the TPU decoding recipe), with the compiled program cached across calls
+    per (config, max_new_tokens, temperature, prompt shape).
+    ``temperature=0`` is greedy; otherwise samples.
+
+    Returns [batch, prompt_len + max_new_tokens] token ids (int32).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    total = prompt_ids.shape[1] + max_new_tokens
+    if total > cfg.max_seq:
+        raise ValueError(f"prompt+new = {total} exceeds max_seq {cfg.max_seq}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    model, run = _generate_fn(cfg, max_new_tokens, float(temperature))
+    # Fresh zeroed KV cache built from shapes only (no parameter init trace).
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), prompt_ids)
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+    return run(params, cache, prompt_ids, rng)

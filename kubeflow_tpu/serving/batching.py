@@ -43,6 +43,7 @@ class _Pending:
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[List[Any]] = None
     error: Optional[BaseException] = None
+    waited: bool = False  # sat through a full coalescing window already
 
 
 class DynamicBatcher:
@@ -67,7 +68,6 @@ class DynamicBatcher:
         self._lock = threading.Condition()
         self._queue: List[_Pending] = []
         self._closed = False
-        self._flush_leftovers = False
         self._worker = threading.Thread(
             target=self._run, name=f"batcher-{name}", daemon=True
         )
@@ -111,9 +111,10 @@ class DynamicBatcher:
                 self._lock.wait()
             if self._closed and not self._queue:
                 return []
-            # Leftovers from a mixed-shape round already waited their
-            # window — serve them immediately instead of a fresh max_wait.
-            if not self._flush_leftovers:
+            # A head pending that already sat through a full window (left
+            # over from a mixed-shape round) serves immediately; fresh
+            # arrivals get the normal coalescing window.
+            if not self._queue[0].waited:
                 deadline = time.monotonic() + self.max_wait_s
                 while True:
                     rows = sum(len(p.instances) for p in self._queue)
@@ -121,6 +122,8 @@ class DynamicBatcher:
                     if rows >= self.max_batch or remaining <= 0 or self._closed:
                         break
                     self._lock.wait(remaining)
+                for p in self._queue:
+                    p.waited = True
             # Take like-shaped pendings only (mixed shapes cannot share one
             # array), up to max_batch rows. Every queued pending has
             # < max_batch rows, so this always takes at least one; other
@@ -136,7 +139,6 @@ class DynamicBatcher:
                 else:
                     remaining_queue.append(p)
             self._queue = remaining_queue
-            self._flush_leftovers = bool(remaining_queue)
             return batch
 
     def _run(self) -> None:

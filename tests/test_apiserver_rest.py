@@ -411,6 +411,19 @@ class TestVersionConversion:
         # still reachable/patachable again at the spoke (storage key intact)
         assert remote.get(v1, "pv", "default")["metadata"]["annotations"] == {"a": "1"}
 
+    def test_in_process_spoke_write_routes_to_hub(self, rest):
+        """Store-level writes of spoke-stamped objects must land in the hub
+        bucket — never a shadow spoke bucket invisible to controllers."""
+        store, remote, base = rest
+        store.create(new_object("kubeflow.org/v1", "Notebook", "direct", "default", spec={}))
+        hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+        stored = store.get(hub, "direct", "default")
+        assert stored["apiVersion"] == "kubeflow.org/v1beta1"
+        # spoke-Resource reads on the store also resolve to the hub
+        v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+        assert store.get(v1, "direct", "default")["metadata"]["name"] == "direct"
+        assert len(store.list(v1, "default")) == len(store.list(hub, "default"))
+
     def test_spoke_events_reach_hub_controllers(self, rest):
         """A controller watching the hub must see CRs created at any spoke."""
         store, remote, base = rest

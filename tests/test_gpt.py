@@ -150,6 +150,37 @@ class TestGptTraining:
         assert np.isfinite(float(loss))
         assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree_util.tree_leaves(grads))
 
+    def test_greedy_decode_matches_full_forward(self, model_and_params):
+        """KV-cache decoding must produce exactly the tokens that repeated
+        full forwards + argmax would (teacher-forcing its own output)."""
+        from kubeflow_tpu.models.gpt import generate
+
+        model, params = model_and_params
+        prompt = jax.random.randint(jax.random.PRNGKey(20), (2, 8), 0, CFG.vocab_size)
+        out = generate(CFG, params, prompt, max_new_tokens=6, temperature=0.0)
+        assert out.shape == (2, 8 + 6)
+        np.testing.assert_array_equal(np.asarray(out[:, :8]), np.asarray(prompt))
+
+        # reference: grow the sequence with full (non-cached) forwards
+        seq = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(seq.dtype)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+    def test_sampled_decode_shapes_and_bounds(self, model_and_params):
+        from kubeflow_tpu.models.gpt import generate
+
+        _, params = model_and_params
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = generate(CFG, params, prompt, max_new_tokens=5,
+                       rng=jax.random.PRNGKey(1), temperature=1.0)
+        assert out.shape == (1, 9)
+        assert (np.asarray(out) >= 0).all() and (np.asarray(out) < CFG.vocab_size).all()
+        with pytest.raises(ValueError, match="exceeds max_seq"):
+            generate(CFG, params, jnp.zeros((1, CFG.max_seq), jnp.int32), max_new_tokens=1)
+
     def test_ring_attention_sequence_parallel(self):
         """Long-context: ring attention over the seq axis, causal, inside the
         GPT block (the injectable-attention contract)."""
