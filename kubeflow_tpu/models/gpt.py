@@ -311,12 +311,23 @@ def generate(
         raise ValueError(f"prompt+new = {total} exceeds max_seq {cfg.max_seq}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    model, run = _generate_fn(cfg, max_new_tokens, float(temperature))
-    # Fresh zeroed KV cache built from shapes only (no parameter init trace).
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), prompt_ids)
-    )["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-    )
-    return run(params, cache, prompt_ids, rng)
+    _, run = _generate_fn(cfg, max_new_tokens, float(temperature))
+    return run(params, _fresh_cache(cfg, prompt_ids.shape[0]), prompt_ids, rng)
+
+
+def _fresh_cache(cfg: GptConfig, batch: int) -> Any:
+    """Zeroed KV cache in the exact structure GptLM(decode=True) owns —
+    closed-form from the config, no tracing on the request path. (Module
+    naming drift would break `generate` outright, which the decode tests
+    catch.)"""
+    kv_shape = (batch, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+    return {
+        f"block_{i}": {
+            "attention": {
+                "k": jnp.zeros(kv_shape, cfg.dtype),
+                "v": jnp.zeros(kv_shape, cfg.dtype),
+                "cursor": jnp.zeros((), jnp.int32),
+            }
+        }
+        for i in range(cfg.n_layers)
+    }

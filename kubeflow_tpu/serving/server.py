@@ -172,6 +172,66 @@ class ModelServer:
         return self.app.serve(port)
 
 
+@dataclass
+class GenerativeModel(ServedModel):
+    """Serves autoregressive generation through the predict surface:
+    instances = equal-length token-id prompts, predictions = full generated
+    sequences. Decoding manages its own compilation cache (models/gpt.py
+    generate), so the bucket-jit path is bypassed."""
+
+    cfg: Any = None
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+
+    def predict(self, instances: Sequence[Any]) -> List[Any]:
+        from kubeflow_tpu.models.gpt import generate
+
+        if not instances:
+            return []
+        prompts = np.asarray(instances, dtype=np.int32)
+        if prompts.ndim != 2:
+            raise HttpError(400, "instances must be equal-length token-id lists")
+        # Batch-bucket like ServedModel.predict: arbitrary client batch
+        # sizes must not mint unbounded XLA compilations.
+        n = prompts.shape[0]
+        bucket = next((b for b in BATCH_BUCKETS if b >= n), None)
+        if bucket is None:
+            raise HttpError(413, f"batch of {n} exceeds max {BATCH_BUCKETS[-1]}")
+        if bucket != n:
+            prompts = np.concatenate([prompts, np.repeat(prompts[:1], bucket - n, axis=0)])
+        out = generate(
+            self.cfg,
+            self.params,
+            jnp.asarray(prompts),
+            self.max_new_tokens,
+            temperature=self.temperature,
+        )
+        return np.asarray(out)[:n].tolist()
+
+
+def gpt_served_model(
+    name: str = "gpt",
+    tiny: bool = True,
+    max_new_tokens: int = 16,
+    temperature: float = 0.0,
+) -> GenerativeModel:
+    """GPT text-generation servable (``tiny`` for CPU CI; ``tiny=False``
+    builds the GPT-2-small-class config)."""
+    from kubeflow_tpu.models.gpt import GptConfig, GptLM
+
+    cfg = GptConfig.tiny() if tiny else GptConfig.small()
+    sample = jnp.zeros((1, 8), jnp.int32)
+    params = GptLM(cfg).init(jax.random.PRNGKey(0), sample)["params"]
+    return GenerativeModel(
+        name=name,
+        apply_fn=None,
+        params=params,
+        cfg=cfg,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+    )
+
+
 def bert_served_model(name: str = "bert", tiny: bool = True) -> ServedModel:
     """BERT MLM logits server (the BASELINE 'tf-serving -> JAX BERT' config).
 

@@ -411,6 +411,35 @@ class TestVersionConversion:
         # still reachable/patachable again at the spoke (storage key intact)
         assert remote.get(v1, "pv", "default")["metadata"]["annotations"] == {"a": "1"}
 
+    def test_registered_mapper_runs_on_spoke_patch_fragment(self, rest):
+        """A real (partial-tolerant) field mapper must apply to merge-patch
+        fragments at spoke endpoints before they merge into hub storage."""
+        from kubeflow_tpu.api import conversion
+
+        def v1_to_beta(obj):
+            spec = obj.get("spec")
+            if spec and "tpuSlice" in spec:  # v1 name -> hub name
+                spec["tpu"] = spec.pop("tpuSlice")
+            return obj
+
+        key = ("kubeflow.org", "Notebook", "v1", "v1beta1")
+        conversion._MAPPERS[key] = v1_to_beta
+        try:
+            store, remote, base = rest
+            v1 = REGISTRY.for_kind("kubeflow.org/v1", "Notebook")
+            remote.create(new_object("kubeflow.org/v1", "Notebook", "mapped", "default", spec={}))
+            remote.patch(
+                v1, "mapped",
+                {"spec": {"tpuSlice": {"generation": "v5e", "topology": "2x2"}}},
+                "default",
+            )
+            hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+            stored = store.get(hub, "mapped", "default")
+            assert stored["spec"].get("tpu") == {"generation": "v5e", "topology": "2x2"}
+            assert "tpuSlice" not in stored["spec"]
+        finally:
+            conversion._MAPPERS.pop(key, None)
+
     def test_in_process_spoke_write_routes_to_hub(self, rest):
         """Store-level writes of spoke-stamped objects must land in the hub
         bucket — never a shadow spoke bucket invisible to controllers."""

@@ -26,7 +26,12 @@ from kubeflow_tpu.hpo.suggest import (
 from kubeflow_tpu.hpo.trials import mnist_objective, quadratic_objective
 from kubeflow_tpu.platform import build_platform
 from kubeflow_tpu.serving.controller import SERVING_API
-from kubeflow_tpu.serving.server import ModelServer, ServedModel, bert_served_model
+from kubeflow_tpu.serving.server import (
+    ModelServer,
+    ServedModel,
+    bert_served_model,
+    gpt_served_model,
+)
 
 SPECS = [
     ParamSpec("lr", "double", min=1e-4, max=1.0, log_scale=True),
@@ -205,6 +210,26 @@ class TestServing:
         assert server.app.call("POST", "/v1/models/none:predict", {"instances": []}).status == 404
         server.add(bert_served_model("b"))
         assert server.app.call("POST", "/v1/models/b:predict", {"nope": 1}).status == 400
+
+    def test_gpt_generation_through_predict_surface(self):
+        """Text generation served through the same predict API: equal-length
+        token prompts in, full generated sequences out, deterministic at
+        temperature 0."""
+        server = ModelServer().add(gpt_served_model("gen", max_new_tokens=4))
+        resp = server.app.call(
+            "POST", "/v1/models/gen:predict", {"instances": [[1, 2, 3], [4, 5, 6]]}
+        )
+        assert resp.status == 200
+        preds = resp.body["predictions"]
+        assert len(preds) == 2 and all(len(p) == 3 + 4 for p in preds)
+        assert preds[0][:3] == [1, 2, 3]
+        again = server.app.call(
+            "POST", "/v1/models/gen:predict", {"instances": [[1, 2, 3], [4, 5, 6]]}
+        ).body["predictions"]
+        assert again == preds  # greedy = deterministic
+        # ragged prompts are a client error, not a 500
+        bad = server.app.call("POST", "/v1/models/gen:predict", {"instances": [[1], [2, 3]]})
+        assert bad.status == 400
 
     def test_tf_serving_shaped_e2e_over_http(self):
         """The test_tf_serving.py analog: retries + tolerance compare."""
