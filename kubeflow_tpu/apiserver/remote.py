@@ -28,7 +28,14 @@ _ERRORS = {404: NotFound, 409: Conflict, 422: Invalid, 403: Forbidden, 410: Expi
 
 def _raise_for(status_body: Dict[str, Any], code: int) -> None:
     cls = _ERRORS.get(code, ApiError)
-    raise cls(status_body.get("message", f"HTTP {code}"))
+    err = cls(status_body.get("message", f"HTTP {code}"))
+    # Codes without a dedicated class (e.g. server-side 400s) must keep their
+    # original status, not inherit ApiError's class-level 500 — a client
+    # error reported as InternalError misleads retry/alerting logic.
+    if cls is ApiError:
+        err.code = code
+        err.reason = status_body.get("reason", err.reason)
+    raise err
 
 
 class RemoteWatch:

@@ -154,13 +154,15 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         if req.query1("watch") in ("true", "1"):
             return _watch_stream(store, res, ns, selector, req)
         try:
-            items = store.list(hub_resource(res), namespace=ns, label_selector=selector)
+            items, rv = store.list_with_rv(hub_resource(res), namespace=ns, label_selector=selector)
         except ApiError as e:
             return error(e)
         return {
             "apiVersion": res.api_version,
             "kind": res.list_kind or f"{res.kind}List",
-            "metadata": {"resourceVersion": str(store.backend.current_rv())},
+            # RV captured atomically with the snapshot (store.list_with_rv) so
+            # list+watch-from-RV never misses interleaved writes.
+            "metadata": {"resourceVersion": str(rv)},
             "items": [outbound(o, res) for o in items],
         }
 

@@ -233,13 +233,32 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
         field_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
+        return self.list_with_rv(res, namespace, label_selector, field_selector)[0]
+
+    def list_with_rv(
+        self,
+        res: Resource,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Dict[str, Any]], int]:
+        """Snapshot list plus the store resourceVersion AT the snapshot.
+
+        The RV is read under the same lock as the list so a client doing
+        list→watch(resourceVersion=<list RV>) observes every write that lands
+        after the snapshot (etcd returns the revision atomically with a range
+        read for the same reason). Reading ``backend.current_rv()`` after the
+        lock is released would open a gap in which writes are permanently
+        missed by the informer pattern.
+        """
         res = conversion.hub_resource(res)
         with self._lock:
             ns = namespace if (res.namespaced and namespace is not None) else None
             out = self.backend.list(res.key, ns, label_selector)
+            rv = self.backend.current_rv()
             if field_selector:
                 out = [o for o in out if _match_fields(o, field_selector)]
-            return out
+            return out, rv
 
     def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
         res, obj = _to_hub(obj)

@@ -110,8 +110,16 @@ class StudyJobReconciler(Reconciler):
             goal_reached = best.objective >= float(goal) if maximize else best.objective <= float(goal)
 
         done = len(completed) + len(failed)
-        exhausted = isinstance(suggester, GridSuggester) and False  # grid exhaustion handled below
-        if (done >= max_trials or goal_reached) and not active:
+        exhausted = False
+        if isinstance(suggester, GridSuggester):
+            # Fast-forward the deterministic grid cursor past every point a
+            # trial has already been created for. If that reaches the end of
+            # the grid, the search space is exhausted: the study completes as
+            # soon as the in-flight trials finish, even when the grid is
+            # smaller than maxTrialCount (otherwise it would never complete).
+            suggester.ask(len(trials))
+            exhausted = suggester.exhausted
+        if (done >= max_trials or goal_reached or exhausted) and not active:
             new_status = {
                 "phase": "Completed",
                 "trialsTotal": len(trials),
@@ -119,6 +127,8 @@ class StudyJobReconciler(Reconciler):
                 "trialsFailed": len(failed),
                 "goalReached": goal_reached,
             }
+            if exhausted and not goal_reached and done < max_trials:
+                new_status["reason"] = "SearchSpaceExhausted"
             if best:
                 new_status["currentOptimalTrial"] = {
                     "parameterAssignments": best.params,
@@ -132,14 +142,14 @@ class StudyJobReconciler(Reconciler):
         if not goal_reached:
             budget_left = max_trials - done - len(active)
             want_new = max(0, min(parallel - len(active), budget_left))
+        created = 0
         if want_new:
-            # Grid suggester must skip already-asked points: fast-forward by
-            # total trials created so far (deterministic order).
-            if isinstance(suggester, GridSuggester):
-                suggester.ask(len(trials))
+            # The grid cursor was already fast-forwarded above; an exhausted
+            # grid returns fewer (possibly zero) points than asked.
             for params in suggester.ask(want_new):
                 self._create_trial(client, study, params, index=len(trials))
                 trials.append({})  # count for naming
+                created += 1
                 METRICS.counter("studyjob_trials_created_total").inc()
 
         new_status = {
@@ -147,7 +157,7 @@ class StudyJobReconciler(Reconciler):
             "trialsTotal": len(trials),
             "trialsSucceeded": len(completed),
             "trialsFailed": len(failed),
-            "trialsRunning": len(active) + want_new,
+            "trialsRunning": len(active) + created,
         }
         if best:
             new_status["currentOptimalTrial"] = {

@@ -27,7 +27,11 @@ from ..runtime.manager import Reconciler, Request, Result
 from ..runtime import reconcile as rh
 
 TB_API = "tensorboard.kubeflow.org/v1alpha1"
-DEFAULT_IMAGE = "tensorflow/tensorflow:2.5.1"
+# TensorBoard + JAX profile plugin (images/tensorboard-jax/) — the TPU-native
+# replacement for the reference's tensorflow/tensorflow:2.5.1 deployment
+# (tensorboard_controller.go generateDeployment): JAX scalars + profiler
+# traces need the xprof plugin, not the TF runtime.
+DEFAULT_IMAGE = "kubeflow-tpu/tensorboard-jax:latest"
 
 
 @dataclass

@@ -51,7 +51,7 @@ def top_k_routing(
     # higher-priority (choice-major, then position) assignments.
     flat_mask = expert_mask.transpose(1, 0, 2).reshape(k * tokens, num_experts)
     pos_in_expert = jnp.cumsum(flat_mask, axis=0) - flat_mask  # [k*tokens, E]
-    pos = (pos_in_expert * flat_mask).sum(-1).reshape(k, tokens).T  # [tokens, k]
+    pos = (pos_in_expert * flat_mask).sum(-1).reshape(k, tokens).T.astype(jnp.int32)  # [tokens, k]
     keep = (pos < capacity) & (gate_vals > 0)
 
     # aux loss: mean fraction of tokens routed to e * mean router prob for e

@@ -52,12 +52,12 @@ def _ring_attention_local(
     q_pos = axis_idx * lq + jnp.arange(lq)  # global query positions
 
     # Accumulators in f32 regardless of input dtype (bf16-safe softmax).
-    # pvary marks them device-varying over the ring axis so the fori_loop
-    # carry type stays fixed once ppermute'd blocks mix in.
+    # pcast-to-varying marks them device-varying over the ring axis so the
+    # fori_loop carry type stays fixed once ppermute'd blocks mix in.
     vary = vary_axes or (BATCH_AXES + (axis_name,))
-    o = lax.pvary(jnp.zeros((b, h, lq, d), jnp.float32), vary)
-    m = lax.pvary(jnp.full((b, h, lq), _NEG_BIG, jnp.float32), vary)
-    l = lax.pvary(jnp.zeros((b, h, lq), jnp.float32), vary)
+    o = lax.pcast(jnp.zeros((b, h, lq, d), jnp.float32), vary, to="varying")
+    m = lax.pcast(jnp.full((b, h, lq), _NEG_BIG, jnp.float32), vary, to="varying")
+    l = lax.pcast(jnp.zeros((b, h, lq), jnp.float32), vary, to="varying")
 
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry

@@ -77,17 +77,30 @@ class DynamicBatcher:
     @staticmethod
     def _signature(instances: Sequence[Any]):
         """Per-instance (shape, dtype); raises ValueError for ragged input so
-        a malformed request fails ALONE, never inside someone else's batch."""
+        a malformed request fails ALONE, never inside someone else's batch.
+
+        Returns ``None`` for object-dtype input (list-of-dict instances for
+        models with a preprocess fn, or ragged nests numpy tolerates as
+        object arrays): such requests have no usable structural signature,
+        so co-batching them would let one malformed request fail strangers'
+        requests — they serve unbatched instead."""
         import numpy as np
 
         arr = np.asarray(instances)  # raises on inhomogeneous shapes
+        if arr.dtype == object:
+            return None
         return arr.shape[1:], str(arr.dtype)
 
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         if len(instances) >= self.max_batch:
-            # Oversized requests run alone — no point queueing behind them.
+            # Oversized requests run alone — no point queueing behind them
+            # (and no point paying for a signature they won't use).
             return self.predict_fn(instances)
-        pending = _Pending(instances, self._signature(instances))
+        sig = self._signature(instances)
+        if sig is None:
+            # Unsignaturable (object-dtype) requests also run alone.
+            return self.predict_fn(instances)
+        pending = _Pending(instances, sig)
         with self._lock:
             if self._closed:
                 raise BatcherClosed("batcher closed")

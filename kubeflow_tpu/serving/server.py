@@ -15,6 +15,7 @@ TPU-first serving decisions:
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -183,6 +184,16 @@ class GenerativeModel(ServedModel):
     max_new_tokens: int = 16
     temperature: float = 0.0
 
+    def __post_init__(self):
+        # Per-request sampling state: a base key seeded from OS entropy folded
+        # with a monotone counter gives distinct draws per request without
+        # re-seeding numpy/jax global state.
+        self._rng_lock = threading.Lock()
+        self._rng_counter = 0
+        self._base_rng = jax.random.PRNGKey(
+            int.from_bytes(os.urandom(4), "little")
+        )
+
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         from kubeflow_tpu.models.gpt import generate
 
@@ -199,11 +210,22 @@ class GenerativeModel(ServedModel):
             raise HttpError(413, f"batch of {n} exceeds max {BATCH_BUCKETS[-1]}")
         if bucket != n:
             prompts = np.concatenate([prompts, np.repeat(prompts[:1], bucket - n, axis=0)])
+        # Temperature sampling needs a fresh key per request — a fixed key
+        # would return the identical sample for identical prompts.
+        rng = None
+        if self.temperature > 0.0:
+            with self._rng_lock:
+                self._rng_counter += 1
+                counter = self._rng_counter
+            # fold_in dispatches device work — keep it outside the lock so
+            # concurrent sampled requests don't serialize on it.
+            rng = jax.random.fold_in(self._base_rng, counter)
         out = generate(
             self.cfg,
             self.params,
             jnp.asarray(prompts),
             self.max_new_tokens,
+            rng=rng,
             temperature=self.temperature,
         )
         return np.asarray(out)[:n].tolist()

@@ -274,6 +274,23 @@ class TestRequestValidation:
             assert e.code == 400
         assert "nodeName" not in remote.get(PODS, "victim", "default")["spec"]
 
+    def test_remote_error_mapping_preserves_status(self):
+        # Codes without a dedicated ApiError subclass (server-side 400s) must
+        # keep their original status, not collapse to the class-level 500
+        # (ADVICE r1): a client error reported as InternalError misleads
+        # retry logic. Mapped codes keep their subclass.
+        from kubeflow_tpu.apiserver.remote import _raise_for
+        from kubeflow_tpu.apiserver.store import ApiError, Conflict
+
+        try:
+            _raise_for({"message": "body/path mismatch", "reason": "BadRequest"}, 400)
+            raise AssertionError("expected raise")
+        except ApiError as e:
+            assert type(e) is ApiError
+            assert e.code == 400 and e.reason == "BadRequest"
+        with pytest.raises(Conflict):
+            _raise_for({"message": "rv mismatch"}, 409)
+
     def test_bad_resource_version_is_400(self, rest):
         store, remote, base = rest
         try:

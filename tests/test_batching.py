@@ -116,6 +116,44 @@ class TestDynamicBatcher:
             b.predict([[1.0], [1.0, 2.0]])
         b.close()
 
+    def test_object_dtype_instances_serve_unbatched(self):
+        """List-of-dict instances (models with a preprocess fn) produce
+        object-dtype arrays with no structural signature: they must NOT
+        co-batch (one malformed request would fail strangers' requests,
+        breaking the fails-ALONE contract — ADVICE r1), and must still be
+        served, alone."""
+        calls = []
+
+        def predict(instances):
+            calls.append(list(instances))
+            if any(not isinstance(i, dict) or "x" not in i for i in instances):
+                raise ValueError("malformed")
+            return [i["x"] * 2 for i in instances]
+
+        b = DynamicBatcher(predict, max_batch=16, max_wait_ms=50.0)
+        results = {}
+        errors = {}
+
+        def run(key, payload):
+            try:
+                results[key] = b.predict(payload)
+            except Exception as e:  # noqa: BLE001
+                errors[key] = e
+
+        threads = [
+            threading.Thread(target=run, args=("good", [{"x": 2}])),
+            threading.Thread(target=run, args=("bad", [{"y": 1}])),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results["good"] == [4]
+        assert isinstance(errors["bad"], ValueError)
+        # Never combined into one predict call.
+        assert all(len(c) == 1 for c in calls)
+        b.close()
+
     def test_closed_batcher_rejects(self):
         b = DynamicBatcher(lambda x: x, max_batch=8)
         b.close()
