@@ -108,6 +108,9 @@ for _res in [
         "rbac.authorization.k8s.io", "v1", "ClusterRoleBinding", "clusterrolebindings", namespaced=False
     ),
     Resource("storage.k8s.io", "v1", "StorageClass", "storageclasses", namespaced=False),
+    # Controller HA leases (reference: -enable-leader-election on every
+    # controller binary, notebook-controller/main.go:55-66).
+    Resource("coordination.k8s.io", "v1", "Lease", "leases"),
     # Istio objects the controllers emit (stored as unstructured, same as the
     # reference does via the dynamic client — notebook_controller.go:401-496).
     Resource("networking.istio.io", "v1beta1", "VirtualService", "virtualservices"),
@@ -146,6 +149,14 @@ def new_object(
     obj: Dict[str, Any] = {"apiVersion": api_version, "kind": kind, "metadata": meta}
     obj.update(top_level)
     return obj
+
+
+def now_rfc3339() -> str:
+    """RFC3339 with microseconds (metav1.MicroTime) — Lease renewTime needs
+    sub-second resolution so rapid renewals are distinguishable."""
+    import datetime as _dt
+
+    return _dt.datetime.now(_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
 
 def gvk_of(obj: Dict[str, Any]) -> GroupVersionKind:

@@ -103,17 +103,42 @@ def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
 
 
 def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> None:
-    """Standard controller-role main: connect, reconcile, expose ops, block."""
+    """Standard controller-role main: connect, reconcile, expose ops, block.
+
+    With ``ENABLE_LEADER_ELECTION=true`` (reference flag
+    ``-enable-leader-election``, notebook-controller/main.go:55-66) the
+    manager only reconciles while holding the role's Lease in
+    ``LEADER_ELECTION_NAMESPACE``; replicas > 1 give hot standbys.
+    """
+    from ..apiserver.client import Client
+    from ..utils import env_flag
+    from .leader import LeaderElector
+
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
     store = connect(url)
     mgr = Manager(store=store)
     for rec in reconcilers:
         mgr.add(rec)
-    mgr.start()
+    elector: Optional[LeaderElector] = None
+    if env_flag("ENABLE_LEADER_ELECTION"):
+        elector = LeaderElector(
+            Client(store),
+            name=f"{name}-leader",
+            namespace=os.environ.get("LEADER_ELECTION_NAMESPACE", "kubeflow-system"),
+            lease_duration=float(os.environ.get("LEASE_DURATION", "15")),
+            renew_interval=float(os.environ.get("LEASE_RENEW_INTERVAL", "2")),
+            on_started_leading=mgr.start,
+            on_stopped_leading=mgr.stop,
+        ).start()
+    else:
+        mgr.start()
     ops = serve_ops_endpoints(name)
     log.info("%s running against %s (ops :%d)", name, store.base_url, ops.port)
     try:
         block_forever()
     finally:
-        mgr.stop()
+        if elector is not None:
+            elector.stop()  # stops the manager via on_stopped_leading
+        else:
+            mgr.stop()
         ops.close()

@@ -1,0 +1,236 @@
+"""Leader election over coordination.k8s.io Lease objects.
+
+Controller HA: the manifests run each controller role with >1 replica for
+fast failover, but exactly one replica may reconcile at a time — two active
+copies of a controller would fight over owned objects. The reference enables
+this per binary via controller-runtime's leaderelection package
+(notebook-controller/main.go:55-66, flags ``-enable-leader-election`` /
+``-leader-election-namespace``); this is the same protocol re-implemented
+against the platform apiserver:
+
+- a Lease object per role (``spec.holderIdentity``, ``renewTime``,
+  ``leaseDurationSeconds``, ``leaseTransitions``),
+- the holder renews every ``renew_interval``; renewals and takeovers are
+  optimistic-concurrency updates, so two candidates racing for an expired
+  lease conflict on resourceVersion and exactly one wins,
+- a standby acquires only after ``lease_duration`` passes without a renewal,
+- a leader that cannot renew within ``lease_duration`` (apiserver partition,
+  paused process) steps down and stops its manager — by the time the lease
+  could have been taken over it is no longer reconciling (the Go
+  implementation exits the process; stepping down to standby is equivalent
+  under a Deployment, which would restart the exited pod into standby).
+
+Wall-clock note: expiry is judged by each candidate's local reading of the
+renewTime it last OBSERVED CHANGING, not by parsing the holder's timestamps
+— the same trick client-go uses so leader election tolerates clock skew
+between replicas.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..apiserver.store import ApiError, Conflict, NotFound
+from .metrics import METRICS
+
+LEASE_API = "coordination.k8s.io/v1"
+
+log = logging.getLogger("kubeflow_tpu.leader")
+
+
+def default_identity() -> str:
+    """hostname_uuid — unique per process, stable within it (client-go shape)."""
+    return f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+
+
+class LeaderElector:
+    """Run callbacks while holding a named Lease.
+
+    ``on_started_leading`` fires when the lease is acquired;
+    ``on_stopped_leading`` fires when leadership is lost or released.
+    Both run on the elector thread and must return promptly.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        name: str,
+        namespace: str = "kubeflow-system",
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_interval: float = 2.0,
+        retry_interval: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        if renew_interval >= lease_duration:
+            raise ValueError("renew_interval must be < lease_duration")
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.retry_interval = retry_interval
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self._leading = False
+        self._thread: Optional[threading.Thread] = None
+        # Local-clock view of the observed lease: (holder, renewTime string)
+        # and when WE saw that renewTime change. Expiry = no observed change
+        # for lease_duration — immune to cross-replica clock skew.
+        self._observed_record: Optional[tuple] = None
+        self._observed_at = 0.0
+        # When leading: last successful renew on OUR clock.
+        self._last_renew = 0.0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(
+            target=self._run, name=f"leader-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True) -> None:
+        """Stop electing; optionally release the lease for instant failover."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self._leading:
+            self._set_leading(False)
+            if release:
+                self._release()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    # -- protocol ------------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except ApiError as e:
+                log.warning("leader %s: apiserver error: %s", self.name, e)
+                if self._leading and time.monotonic() - self._last_renew > self.lease_duration:
+                    # Could not renew for a full lease window: someone else
+                    # may legitimately hold the lease now. Step down first.
+                    self._set_leading(False)
+            self._stop.wait(self.renew_interval if self._leading else self.retry_interval)
+
+    def _tick(self) -> None:
+        lease = self.client.get_opt(LEASE_API, "Lease", self.name, self.namespace)
+        now = time.monotonic()
+        if lease is None:
+            created = self._try(self._create_lease)
+            if created is not None:
+                self._won(created)
+            return
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        record = (holder, spec.get("renewTime"))
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+
+        if holder == self.identity:
+            renewed = self._try(lambda: self._renew(lease))
+            if renewed is not None:
+                self._last_renew = now
+                if not self._leading:
+                    self._set_leading(True)
+            elif self._leading and now - self._last_renew > self.lease_duration:
+                self._set_leading(False)
+            return
+
+        # Someone else holds it. We must not be leading.
+        if self._leading:
+            self._set_leading(False)
+        if holder and now - self._observed_at < self.lease_duration:
+            return  # holder is live
+        taken = self._try(lambda: self._take_over(lease))
+        if taken is not None:
+            self._won(taken)
+
+    def _won(self, lease) -> None:
+        self._observed_record = (self.identity, lease.get("spec", {}).get("renewTime"))
+        self._observed_at = time.monotonic()
+        self._last_renew = time.monotonic()
+        self._set_leading(True)
+
+    def _set_leading(self, leading: bool) -> None:
+        if leading == self._leading:
+            return
+        self._leading = leading
+        METRICS.gauge("leader_is_leader", lease=self.name).set(1.0 if leading else 0.0)
+        log.info(
+            "leader %s: %s (%s)",
+            self.name,
+            "acquired" if leading else "lost",
+            self.identity,
+        )
+        cb = self.on_started_leading if leading else self.on_stopped_leading
+        if cb:
+            cb()
+
+    @staticmethod
+    def _try(fn):
+        """Optimistic-concurrency attempt: Conflict/NotFound = lost the race."""
+        try:
+            return fn()
+        except (Conflict, NotFound):
+            return None
+
+    # -- lease object manipulation ------------------------------------------
+    def _lease_spec(self, transitions: int) -> dict:
+        now = apimeta.now_rfc3339()
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "acquireTime": now,
+            "renewTime": now,
+            "leaseTransitions": transitions,
+        }
+
+    def _create_lease(self) -> dict:
+        return self.client.create(
+            apimeta.new_object(
+                LEASE_API, "Lease", self.name, self.namespace,
+                spec=self._lease_spec(transitions=0),
+            )
+        )
+
+    def _renew(self, lease: dict) -> dict:
+        lease = apimeta.deepcopy(lease)
+        lease["spec"]["renewTime"] = apimeta.now_rfc3339()
+        return self.client.update(lease)
+
+    def _take_over(self, lease: dict) -> dict:
+        lease = apimeta.deepcopy(lease)
+        prev = lease["spec"].get("leaseTransitions", 0) or 0
+        lease["spec"] = self._lease_spec(transitions=prev + 1)
+        METRICS.counter("leader_transitions_total", lease=self.name).inc()
+        return self.client.update(lease)
+
+    def _release(self) -> None:
+        try:
+            lease = self.client.get_opt(LEASE_API, "Lease", self.name, self.namespace)
+            if lease and lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease = apimeta.deepcopy(lease)
+                lease["spec"]["holderIdentity"] = ""
+                # Zero renewTime so a standby's freshness window doesn't
+                # make it wait out the full lease_duration.
+                lease["spec"]["renewTime"] = None
+                self.client.update(lease)
+        except ApiError:
+            pass

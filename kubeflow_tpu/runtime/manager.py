@@ -274,6 +274,13 @@ class Manager:
     def start(self) -> "Manager":
         if self._started:
             return self
+        if self._stop.is_set():
+            # Restarting after stop() (a leader-election standby regaining
+            # the lease): stopped controllers' queues and watch streams are
+            # terminally shut down, so rebuild them around the same
+            # reconcilers with a fresh stop event.
+            self._stop = threading.Event()
+            self._controllers = [_Controller(self, c.reconciler) for c in self._controllers]
         self._started = True
         for c in self._controllers:
             c.start()
@@ -289,6 +296,9 @@ class Manager:
                 log.exception("gc sweep failed")
 
     def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
         self._stop.set()
         for c in self._controllers:
             c.stop()

@@ -1,0 +1,196 @@
+"""Leader election: Lease protocol, single active reconciler, standby takeover.
+
+Reference behavior: controller-runtime leader election enabled per binary via
+-enable-leader-election (notebook-controller/main.go:55-66) — replicas > 1,
+exactly one reconciles, standby takes over within the lease TTL.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.store import ApiError, Store
+from kubeflow_tpu.runtime.leader import LEASE_API, LeaderElector
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Request, Result
+
+FAST = dict(lease_duration=0.8, renew_interval=0.1, retry_interval=0.1)
+
+
+def wait_for(cond, timeout=5.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+class Counting(Reconciler):
+    FOR = ("kubeflow.org/v1beta1", "Notebook")
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.seen = []
+
+    def reconcile(self, client: Client, req: Request) -> Result:
+        self.seen.append(req)
+        obj = client.get_opt(*self.FOR, req.name, req.namespace)
+        if obj is not None:
+            ann = obj["metadata"].setdefault("annotations", {})
+            if ann.get("reconciled-by") != self.tag:
+                ann["reconciled-by"] = self.tag
+                client.update(obj)
+        return Result()
+
+
+class TestLeaseProtocol:
+    def test_exactly_one_of_two_candidates_leads(self):
+        store = Store()
+        a = LeaderElector(Client(store), "ctrl", identity="a", **FAST).start()
+        b = LeaderElector(Client(store), "ctrl", identity="b", **FAST).start()
+        try:
+            assert wait_for(lambda: a.is_leader or b.is_leader)
+            time.sleep(0.3)  # a few renew cycles: must stay single-leader
+            assert a.is_leader != b.is_leader
+            lease = Client(store).get(LEASE_API, "Lease", "ctrl", "kubeflow-system")
+            holder = lease["spec"]["holderIdentity"]
+            assert holder == ("a" if a.is_leader else "b")
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_takeover_after_leader_death_within_ttl(self):
+        store = Store()
+        a = LeaderElector(Client(store), "ctrl", identity="a", **FAST).start()
+        assert wait_for(lambda: a.is_leader)
+        b = LeaderElector(Client(store), "ctrl", identity="b", **FAST).start()
+        try:
+            time.sleep(0.3)
+            assert not b.is_leader  # live leader blocks takeover
+            a.stop(release=False)  # crash: no release, lease left behind
+            t0 = time.monotonic()
+            assert wait_for(lambda: b.is_leader)
+            took = time.monotonic() - t0
+            # Takeover must wait out the TTL (not steal a live lease) but
+            # arrive promptly after it.
+            assert took < FAST["lease_duration"] + 1.0
+            lease = Client(store).get(LEASE_API, "Lease", "ctrl", "kubeflow-system")
+            assert lease["spec"]["holderIdentity"] == "b"
+            assert lease["spec"]["leaseTransitions"] == 1
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_graceful_release_gives_instant_failover(self):
+        store = Store()
+        a = LeaderElector(Client(store), "ctrl", identity="a", **FAST).start()
+        assert wait_for(lambda: a.is_leader)
+        a.stop()  # graceful: releases the lease
+        b = LeaderElector(Client(store), "ctrl", identity="b", **FAST).start()
+        try:
+            t0 = time.monotonic()
+            assert wait_for(lambda: b.is_leader)
+            # No TTL wait: released leases hand over immediately.
+            assert time.monotonic() - t0 < FAST["lease_duration"]
+        finally:
+            b.stop()
+
+    def test_leader_steps_down_when_apiserver_unreachable(self):
+        store = Store()
+
+        class FlakyClient(Client):
+            def __init__(self, store):
+                super().__init__(store)
+                self.broken = False
+
+            def get_opt(self, *a, **kw):
+                if self.broken:
+                    raise ApiError("partitioned")
+                return super().get_opt(*a, **kw)
+
+            def update(self, *a, **kw):
+                if self.broken:
+                    raise ApiError("partitioned")
+                return super().update(*a, **kw)
+
+        cl = FlakyClient(store)
+        a = LeaderElector(cl, "ctrl", identity="a", **FAST).start()
+        try:
+            assert wait_for(lambda: a.is_leader)
+            cl.broken = True
+            # Within a full lease window it cannot renew → steps down, so it
+            # is no longer reconciling by the time a standby could take over.
+            assert wait_for(lambda: not a.is_leader, timeout=5.0)
+        finally:
+            a.stop()
+
+    def test_callbacks_fire_on_transition(self):
+        store = Store()
+        events = []
+        a = LeaderElector(
+            Client(store), "ctrl", identity="a", **FAST,
+            on_started_leading=lambda: events.append("start"),
+            on_stopped_leading=lambda: events.append("stop"),
+        ).start()
+        assert wait_for(lambda: a.is_leader)
+        a.stop()
+        assert events == ["start", "stop"]
+
+
+class TestHAControllers:
+    def test_only_leader_reconciles_then_standby_takes_over(self):
+        """The VERDICT item-4 'done' test: two managers, one store; only the
+        leader reconciles; kill it; the standby takes over within the TTL."""
+        store = Store()
+        recs = {}
+        mgrs = {}
+        electors = {}
+        for tag in ("a", "b"):
+            recs[tag] = Counting(tag)
+            mgrs[tag] = Manager(store=store).add(recs[tag])
+            electors[tag] = LeaderElector(
+                Client(store), "notebook-ctrl", identity=tag, **FAST,
+                on_started_leading=mgrs[tag].start,
+                on_stopped_leading=mgrs[tag].stop,
+            )
+        electors["a"].start()
+        assert wait_for(lambda: electors["a"].is_leader)
+        electors["b"].start()
+
+        client = Client(store)
+        client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb1", "default", spec={}))
+        assert wait_for(lambda: len(recs["a"].seen) > 0)
+        time.sleep(0.3)
+        assert recs["b"].seen == []  # standby never reconciles
+        assert (
+            client.get("kubeflow.org/v1beta1", "Notebook", "nb1", "default")
+            ["metadata"]["annotations"]["reconciled-by"] == "a"
+        )
+
+        electors["a"].stop(release=False)  # crash the leader
+        assert wait_for(lambda: electors["b"].is_leader, timeout=5.0)
+        client.create(new_object("kubeflow.org/v1beta1", "Notebook", "nb2", "default", spec={}))
+        assert wait_for(lambda: Request("default", "nb2") in recs["b"].seen)
+        assert wait_for(
+            lambda: (client.get("kubeflow.org/v1beta1", "Notebook", "nb2", "default")
+                     ["metadata"].get("annotations") or {}).get("reconciled-by") == "b"
+        )
+        electors["b"].stop()
+
+    def test_manager_restarts_after_stop(self):
+        """Leadership regained: a stopped manager must come back to life."""
+        store = Store()
+        rec = Counting("x")
+        mgr = Manager(store=store).add(rec)
+        mgr.start()
+        client = Client(store)
+        client.create(new_object("kubeflow.org/v1beta1", "Notebook", "r1", "default", spec={}))
+        assert wait_for(lambda: Request("default", "r1") in rec.seen)
+        mgr.stop()
+        mgr.start()
+        client.create(new_object("kubeflow.org/v1beta1", "Notebook", "r2", "default", spec={}))
+        assert wait_for(lambda: Request("default", "r2") in rec.seen)
+        mgr.stop()
