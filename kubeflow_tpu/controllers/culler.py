@@ -1,0 +1,139 @@
+"""Production idleness prober for notebook culling.
+
+The reference culler polls each notebook's Jupyter server over HTTP —
+``http://<nb>.<ns>.svc.<domain>/notebook/<ns>/<nb>/api/status`` — and parses
+the ``last_activity`` timestamp out of the JSON body
+(components/notebook-controller/pkg/culler/culler.go:138-189). The TPU
+re-targeting changes one thing structurally: a slice notebook is *multi-host*
+(one Jupyter kernel host per TPU VM), so idleness must aggregate across every
+host of the slice — the slice is idle only if ALL hosts are idle, i.e. the
+slice's last activity is the max over per-host last activities (SURVEY.md §7
+"culling a multi-host notebook" hard part).
+
+Unreachable hosts are treated as "cannot determine" → the prober returns
+``None`` and the controller requeues without culling, exactly as the
+reference refuses to cull when the status endpoint errors
+(culler.go:145-168).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional
+
+from ..api import meta as apimeta
+
+log = logging.getLogger(__name__)
+
+#: Jupyter container port probed directly through per-pod headless DNS.
+#: (The reference probes the ClusterIP Service on :80 — culler.go:141-143 —
+#: but per-host probing must bypass the service VIP to reach each host.)
+NOTEBOOK_PORT = 8888
+
+DEFAULT_TIMEOUT_SECONDS = 5.0
+
+
+def parse_last_activity(body: bytes | str) -> Optional[float]:
+    """Parse Jupyter's ``/api/status`` JSON → epoch seconds of last activity.
+
+    The reference parses ``{"last_activity": "2006-01-02T15:04:05Z"}`` with a
+    fixed layout (culler.go:171-189); Jupyter emits RFC3339 with optional
+    fractional seconds, so accept both.
+    """
+    try:
+        doc = json.loads(body)
+    except (ValueError, TypeError):
+        return None
+    stamp = doc.get("last_activity") if isinstance(doc, dict) else None
+    if not isinstance(stamp, str):
+        return None
+    text = stamp.strip()
+    if text.endswith("Z"):
+        text = text[:-1] + "+00:00"
+    try:
+        parsed = datetime.datetime.fromisoformat(text)
+    except ValueError:
+        return None
+    if parsed.tzinfo is None:
+        parsed = parsed.replace(tzinfo=datetime.timezone.utc)
+    return parsed.timestamp()
+
+
+def _default_http_get(url: str, timeout: float) -> Optional[bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+            if resp.status != 200:
+                return None
+            return resp.read()
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+class HttpActivityProber:
+    """Default ``NotebookConfig.activity_prober``: probe every slice host.
+
+    Called with the Notebook CR dict; returns the epoch seconds of the
+    *slice-wide* last activity (max across hosts), or ``None`` when idleness
+    cannot be determined (any host unreachable / unparseable).
+
+    ``url_for`` is injectable for tests and unusual network layouts:
+    ``(notebook, host_index) -> url``. The default builds the per-pod
+    headless-service DNS name ``<name>-<i>.<name>.<ns>.svc.<domain>`` and the
+    reference's status path ``/notebook/<ns>/<name>/api/status``
+    (culler.go:141-143).
+    """
+
+    def __init__(
+        self,
+        cluster_domain: str = "cluster.local",
+        timeout: float = DEFAULT_TIMEOUT_SECONDS,
+        url_for: Optional[Callable[[Dict[str, Any], int], str]] = None,
+        http_get: Optional[Callable[[str, float], Optional[bytes]]] = None,
+    ):
+        self.cluster_domain = cluster_domain
+        self.timeout = timeout
+        self._url_for = url_for or self._default_url_for
+        self._http_get = http_get or _default_http_get
+
+    def _default_url_for(self, nb: Dict[str, Any], host: int) -> str:
+        name = apimeta.name_of(nb)
+        ns = apimeta.namespace_of(nb)
+        pod_dns = f"{name}-{host}.{name}.{ns}.svc.{self.cluster_domain}"
+        return f"http://{pod_dns}:{NOTEBOOK_PORT}/notebook/{ns}/{name}/api/status"
+
+    def _num_hosts(self, nb: Dict[str, Any]) -> int:
+        from .notebook import tpu_topology_of
+
+        topo = tpu_topology_of(nb)
+        return topo.num_hosts if topo else 1
+
+    def _probe_one(self, nb: Dict[str, Any], host: int) -> Optional[float]:
+        url = self._url_for(nb, host)
+        body = self._http_get(url, self.timeout)
+        if body is None:
+            log.debug("culling probe unreachable: %s", url)
+            return None
+        stamp = parse_last_activity(body)
+        if stamp is None:
+            log.debug("culling probe unparseable: %s", url)
+        return stamp
+
+    def __call__(self, nb: Dict[str, Any]) -> Optional[float]:
+        n = self._num_hosts(nb)
+        if n == 1:
+            return self._probe_one(nb, 0)
+        # Probe hosts concurrently: this runs on the controller's reconcile
+        # worker, so a big slice with unreachable hosts must cost ~one
+        # timeout, not num_hosts stacked timeouts.
+        with ThreadPoolExecutor(max_workers=min(n, 16), thread_name_prefix="cull-probe") as pool:
+            activities = list(pool.map(lambda h: self._probe_one(nb, h), range(n)))
+        if any(a is None for a in activities):
+            return None
+        # Idle only if ALL hosts are idle: the most recent activity anywhere
+        # on the slice is the slice's last activity.
+        return max(activities)

@@ -1,0 +1,422 @@
+"""Cloud IAM plugin bodies for the profile controller.
+
+The reference profile-controller binds each profile namespace's
+``default-editor`` KSA to cloud identity two ways:
+
+- **GCP workload identity** — adds a ``roles/iam.workloadIdentityUser``
+  binding for ``serviceAccount:<project>.svc.id.goog[<ns>/<ksa>]`` on the
+  target GCP service account's IAM policy
+  (components/profile-controller/controllers/plugin_workload_identity.go:44-51,
+  135-163).
+- **AWS IRSA** — edits the IAM role's *assume-role trust policy* JSON so the
+  OIDC federated statement's ``<issuer>:sub`` condition includes
+  ``system:serviceaccount:<ns>:<ksa>``
+  (plugin_iam.go:34-50, 131-244).
+
+This module implements both as **pure policy-document transforms** (dict in →
+dict out, no I/O) plus a ``CloudIamBackend`` that plugs into
+``ProfileConfig.iam_backend`` and performs the cloud round-trip through
+injectable transports. The default transports are stdlib-only: AWS calls are
+SigV4-signed ``urllib`` requests (no boto3 in the image), GCP calls use a
+bearer token from the environment or the GCE metadata server (no
+google-auth). Tests exercise the transforms and the backend with fake
+transports — no cloud calls, parity with the reference's
+plugin_iam_test.go:1-303.
+
+Deliberate fix over the reference: ``add_workload_identity_binding`` is
+idempotent — the reference's ``addBinding`` appends a duplicate binding on
+every reconcile (plugin_workload_identity.go:135-143).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import logging
+import os
+import re
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+# Annotation keys (reference: plugin_workload_identity.go:33, plugin_iam.go:23).
+GCP_ANNOTATION_KEY = "iam.gke.io/gcp-service-account"
+AWS_ANNOTATION_KEY = "eks.amazonaws.com/role-arn"
+
+GCP_SA_SUFFIX = ".iam.gserviceaccount.com"
+WORKLOAD_IDENTITY_ROLE = "roles/iam.workloadIdentityUser"
+AWS_TRUST_IDENTITY_SUBJECT = "system:serviceaccount:{ns}:{ksa}"
+AWS_DEFAULT_AUDIENCE = "sts.amazonaws.com"
+
+JsonDict = Dict[str, Any]
+
+
+# =============================================================================
+# GCP workload identity: policy transforms
+# =============================================================================
+
+def gcp_project_of(gcp_service_account: str) -> str:
+    """``name@<project>.iam.gserviceaccount.com`` → ``<project>``.
+
+    Reference: GetProjectID, plugin_workload_identity.go:53-64.
+    """
+    if not gcp_service_account.endswith(GCP_SA_SUFFIX):
+        raise ValueError(f"{gcp_service_account!r} is not a valid GCP service account")
+    m = re.search(r"@(.*?)\.", gcp_service_account)
+    if m is None:
+        raise ValueError(f"cannot extract project id from {gcp_service_account!r}")
+    return m.group(1)
+
+
+def workload_identity_member(project: str, namespace: str, ksa: str) -> str:
+    """The workload-identity pool member string (plugin_workload_identity.go:123)."""
+    return f"serviceAccount:{project}.svc.id.goog[{namespace}/{ksa}]"
+
+
+def add_workload_identity_binding(policy: JsonDict, member: str) -> JsonDict:
+    """Add ``member`` to the workloadIdentityUser binding. Idempotent."""
+    out = json.loads(json.dumps(policy))  # deep copy, JSON-typed
+    bindings: List[JsonDict] = out.setdefault("bindings", [])
+    for b in bindings:
+        if b.get("role") == WORKLOAD_IDENTITY_ROLE:
+            members = b.setdefault("members", [])
+            if member not in members:
+                members.append(member)
+            return out
+    bindings.append({"role": WORKLOAD_IDENTITY_ROLE, "members": [member]})
+    return out
+
+
+def remove_workload_identity_binding(policy: JsonDict, member: str) -> JsonDict:
+    """Remove ``member`` from every workloadIdentityUser binding; drop
+    bindings that become empty (the reference leaves empty bindings behind —
+    plugin_workload_identity.go:146-153 — which GCP rejects on set)."""
+    out = json.loads(json.dumps(policy))
+    kept: List[JsonDict] = []
+    for b in out.get("bindings", []):
+        if b.get("role") == WORKLOAD_IDENTITY_ROLE:
+            b["members"] = [m for m in b.get("members", []) if m != member]
+            if not b["members"]:
+                continue
+        kept.append(b)
+    out["bindings"] = kept
+    return out
+
+
+# =============================================================================
+# AWS IRSA: trust-policy transforms
+# =============================================================================
+
+def role_name_from_arn(arn: str) -> str:
+    """``arn:aws:iam::<acct>:role/<name>`` → ``<name>`` (plugin_iam.go:250)."""
+    return arn.rsplit("/", 1)[-1]
+
+
+def issuer_from_provider_arn(arn: str) -> str:
+    """``arn:aws:iam::<acct>:oidc-provider/<issuer>`` → ``<issuer>``
+    (plugin_iam.go:246-248: everything after the FIRST slash)."""
+    return arn.split("/", 1)[1] if "/" in arn else arn
+
+
+def _federated_statement(doc: JsonDict) -> JsonDict:
+    """The reference operates only on Statement[0] (plugin_iam.go:146-147)."""
+    statements = doc.get("Statement") or []
+    if not statements:
+        raise ValueError("trust policy has no Statement")
+    return statements[0]
+
+
+def _sub_list(statement: JsonDict, key: str) -> List[str]:
+    val = (statement.get("Condition") or {}).get("StringEquals", {}).get(key)
+    if val is None:
+        return []
+    return [val] if isinstance(val, str) else list(val)
+
+
+def add_trust_subject(doc: JsonDict, namespace: str, ksa: str) -> JsonDict:
+    """Add ``system:serviceaccount:<ns>:<ksa>`` to statement 0's OIDC
+    ``:sub`` condition. Returns the document unchanged if already present
+    (the reference's ConditionExistError skip, plugin_iam.go:155-164).
+
+    Deliberate fix over the reference: the transform edits statement 0
+    in place instead of rebuilding the whole document
+    (MakePolicyDocument, plugin_iam.go:253-270), which on a shared role
+    would silently delete Statement[1:], non-StringEquals conditions, and
+    any custom ``:aud`` values.
+    """
+    out = json.loads(json.dumps(doc))  # deep copy, JSON-typed
+    statement = _federated_statement(out)
+    provider_arn = (statement.get("Principal") or {}).get("Federated", "")
+    issuer = issuer_from_provider_arn(provider_arn)
+    subject = AWS_TRUST_IDENTITY_SUBJECT.format(ns=namespace, ksa=ksa)
+    subjects = _sub_list(statement, f"{issuer}:sub")
+    if subject in subjects:
+        return out
+    subjects.append(subject)
+    equals = statement.setdefault("Condition", {}).setdefault("StringEquals", {})
+    equals[f"{issuer}:sub"] = subjects
+    # The reference pins the audience; only fill it when absent so a custom
+    # audience on an existing role survives.
+    equals.setdefault(f"{issuer}:aud", [AWS_DEFAULT_AUDIENCE])
+    return out
+
+
+def remove_trust_subject(doc: JsonDict, namespace: str, ksa: str) -> JsonDict:
+    """Remove the namespace/ksa subject from statement 0; when the ``:sub``
+    list becomes empty the key is dropped entirely (a bare ``null``/``[]``
+    breaks IAM policy validation — plugin_iam.go:216-236). Everything else
+    in the document is preserved."""
+    out = json.loads(json.dumps(doc))
+    statement = _federated_statement(out)
+    provider_arn = (statement.get("Principal") or {}).get("Federated", "")
+    issuer = issuer_from_provider_arn(provider_arn)
+    subject = AWS_TRUST_IDENTITY_SUBJECT.format(ns=namespace, ksa=ksa)
+    key = f"{issuer}:sub"
+    subjects = [s for s in _sub_list(statement, key) if s != subject]
+    equals = statement.setdefault("Condition", {}).setdefault("StringEquals", {})
+    if subjects:
+        equals[key] = subjects
+    else:
+        equals.pop(key, None)
+    return out
+
+
+# =============================================================================
+# Stdlib transports (no boto3 / google-auth in the image)
+# =============================================================================
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    body: bytes,
+    service: str,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    session_token: Optional[str] = None,
+    now: Optional[datetime.datetime] = None,
+    extra_headers: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    """AWS Signature Version 4 request signing, pure stdlib.
+
+    Replaces the aws-sdk-go session the reference leans on
+    (plugin_iam.go:70-76). Deterministic given ``now`` — unit-tested against
+    the published AWS SigV4 example vector.
+    """
+    parsed = urllib.parse.urlsplit(url)
+    host = parsed.netloc
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+
+    canonical_uri = parsed.path or "/"
+    # Canonical query: sorted by key, RFC3986-encoded.
+    query_pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(query_pairs)
+    )
+    payload_hash = hashlib.sha256(body).hexdigest()
+    headers = {"host": host, "x-amz-date": amz_date}
+    for k, v in (extra_headers or {}).items():
+        headers[k.lower()] = v.strip()
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    signed_headers = ";".join(sorted(headers))
+    canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+    canonical_request = "\n".join(
+        [method, canonical_uri, canonical_query, canonical_headers, signed_headers, payload_hash]
+    )
+
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k_date = _hmac(f"AWS4{secret_key}".encode(), datestamp)
+    k_region = _hmac(k_date, region)
+    k_service = _hmac(k_region, service)
+    k_signing = _hmac(k_service, "aws4_request")
+    signature = hmac.new(k_signing, string_to_sign.encode(), hashlib.sha256).hexdigest()
+
+    out = {
+        "X-Amz-Date": amz_date,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        ),
+    }
+    if session_token:
+        out["X-Amz-Security-Token"] = session_token
+    return out
+
+
+class AwsIamTransport:
+    """GetRole / UpdateAssumeRolePolicy over the IAM query API with SigV4."""
+
+    ENDPOINT = "https://iam.amazonaws.com/"
+
+    def __init__(self, region: str = "us-east-1"):
+        self.region = region
+
+    def _call(self, params: Dict[str, str]) -> str:
+        access_key = os.environ.get("AWS_ACCESS_KEY_ID")
+        secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        if not access_key or not secret_key:
+            raise RuntimeError(
+                "AwsIamForServiceAccount plugin needs AWS_ACCESS_KEY_ID / "
+                "AWS_SECRET_ACCESS_KEY in the controller environment"
+            )
+        body = urllib.parse.urlencode({**params, "Version": "2010-05-08"}).encode()
+        content_type = "application/x-www-form-urlencoded; charset=utf-8"
+        headers = sigv4_headers(
+            "POST",
+            self.ENDPOINT,
+            body,
+            service="iam",
+            region=self.region,
+            access_key=access_key,
+            secret_key=secret_key,
+            session_token=os.environ.get("AWS_SESSION_TOKEN"),
+            extra_headers={"content-type": content_type},
+        )
+        headers["Content-Type"] = content_type
+        req = urllib.request.Request(self.ENDPOINT, data=body, headers=headers, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:  # noqa: S310
+            return resp.read().decode()
+
+    def get_trust_policy(self, role_name: str) -> JsonDict:
+        xml = self._call({"Action": "GetRole", "RoleName": role_name})
+        m = re.search(
+            r"<AssumeRolePolicyDocument>(.*?)</AssumeRolePolicyDocument>", xml, re.S
+        )
+        if m is None:
+            raise RuntimeError(f"GetRole({role_name}): no AssumeRolePolicyDocument in response")
+        # The API returns the document URL-encoded (plugin_iam.go:86-89).
+        return json.loads(urllib.parse.unquote(m.group(1)))
+
+    def update_trust_policy(self, role_name: str, doc: JsonDict) -> None:
+        self._call(
+            {
+                "Action": "UpdateAssumeRolePolicy",
+                "RoleName": role_name,
+                "PolicyDocument": json.dumps(doc),
+            }
+        )
+
+
+class GcpIamTransport:
+    """getIamPolicy / setIamPolicy on iam.googleapis.com with a bearer token
+    from ``GOOGLE_OAUTH_ACCESS_TOKEN`` or the GCE metadata server."""
+
+    ENDPOINT = "https://iam.googleapis.com/v1"
+    METADATA_TOKEN_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/instance/service-accounts/default/token"
+    )
+
+    def _token(self) -> str:
+        tok = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        if tok:
+            return tok
+        req = urllib.request.Request(
+            self.METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            # Short timeout: on credential-less clusters this failing fast
+            # keeps a misconfigured IAM plugin from stalling reconciles.
+            with urllib.request.urlopen(req, timeout=2) as resp:  # noqa: S310
+                return json.loads(resp.read())["access_token"]
+        except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
+            raise RuntimeError(
+                "WorkloadIdentity plugin needs GOOGLE_OAUTH_ACCESS_TOKEN or a "
+                "reachable GCE metadata server"
+            ) from e
+
+    def _call(self, method: str, path: str, payload: Optional[JsonDict] = None) -> JsonDict:
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            f"{self.ENDPOINT}/{path}",
+            data=data,
+            headers={
+                "Authorization": f"Bearer {self._token()}",
+                "Content-Type": "application/json",
+            },
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:  # noqa: S310
+            return json.loads(resp.read() or b"{}")
+
+    def get_policy(self, sa_resource: str) -> JsonDict:
+        return self._call("POST", f"{sa_resource}:getIamPolicy")
+
+    def set_policy(self, sa_resource: str, policy: JsonDict) -> None:
+        self._call("POST", f"{sa_resource}:setIamPolicy", {"policy": policy})
+
+
+# =============================================================================
+# The backend ProfileConfig.iam_backend expects
+# =============================================================================
+
+class CloudIamBackend:
+    """Callable ``(action, kind, spec, namespace)`` — the profile
+    controller's cloud half of plugin apply/revoke. Transports are
+    injectable; defaults are the stdlib implementations above."""
+
+    KSA = "default-editor"  # reference: DEFAULT_EDITOR in both plugins
+
+    def __init__(
+        self,
+        aws: Optional[AwsIamTransport] = None,
+        gcp: Optional[GcpIamTransport] = None,
+        ksa_project: Optional[str] = None,
+    ):
+        self.aws = aws or AwsIamTransport()
+        self.gcp = gcp or GcpIamTransport()
+        # The identity-pool project may differ from the GSA's project when
+        # binding across projects (plugin_workload_identity.go:118-123).
+        self.ksa_project = ksa_project or os.environ.get("WORKLOAD_IDENTITY_PROJECT")
+
+    def __call__(self, action: str, kind: str, spec: JsonDict, namespace: str) -> None:
+        if action not in ("apply", "revoke"):
+            raise ValueError(f"unknown IAM action {action!r}")
+        if kind == "WorkloadIdentity":
+            self._gcp(action, spec.get("gcpServiceAccount", ""), namespace)
+        elif kind == "AwsIamForServiceAccount":
+            self._aws(action, spec.get("awsIamRole", ""), namespace)
+        else:
+            raise ValueError(f"unknown plugin kind {kind!r}")
+
+    def _gcp(self, action: str, gcp_sa: str, namespace: str) -> None:
+        project = gcp_project_of(gcp_sa)
+        sa_resource = f"projects/{project}/serviceAccounts/{gcp_sa}"
+        member = workload_identity_member(self.ksa_project or project, namespace, self.KSA)
+        policy = self.gcp.get_policy(sa_resource)
+        transform = (
+            add_workload_identity_binding if action == "apply" else remove_workload_identity_binding
+        )
+        updated = transform(policy, member)
+        if updated != policy:
+            self.gcp.set_policy(sa_resource, updated)
+        log.info("workload identity %s: %s on %s", action, member, gcp_sa)
+
+    def _aws(self, action: str, role_arn: str, namespace: str) -> None:
+        role_name = role_name_from_arn(role_arn)
+        doc = self.aws.get_trust_policy(role_name)
+        transform = add_trust_subject if action == "apply" else remove_trust_subject
+        updated = transform(doc, namespace, self.KSA)
+        if updated != doc:
+            self.aws.update_trust_policy(role_name, updated)
+        log.info("IRSA trust policy %s: ns=%s role=%s", action, namespace, role_name)

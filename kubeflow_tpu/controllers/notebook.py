@@ -57,8 +57,8 @@ class NotebookConfig:
     culling_check_period_minutes: int = 1
     add_fsgroup: bool = True
     # Idleness prober: (notebook) -> last_activity epoch seconds or None.
-    # Production probes Jupyter's /api/status over HTTP per host
-    # (culler.go:138-169); tests inject a fake.
+    # Production (from_env) defaults to the per-host HTTP prober over Jupyter's
+    # /api/status (culler.go:138-169, culler.py); tests inject a fake.
     activity_prober: Optional[Callable[[Dict[str, Any]], Optional[float]]] = None
 
     @classmethod
@@ -67,15 +67,18 @@ class NotebookConfig:
         import os
 
         from ..utils import env_flag
+        from .culler import HttpActivityProber
 
+        cluster_domain = os.environ.get("CLUSTER_DOMAIN", "cluster.local")
         return cls(
             use_istio=env_flag("USE_ISTIO", True),
             istio_gateway=os.environ.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
-            cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"),
+            cluster_domain=cluster_domain,
             enable_culling=env_flag("ENABLE_CULLING", False),
             idle_time_minutes=int(os.environ.get("IDLE_TIME", "1440")),
             culling_check_period_minutes=int(os.environ.get("CULLING_CHECK_PERIOD", "1")),
             add_fsgroup=env_flag("ADD_FSGROUP", True),
+            activity_prober=HttpActivityProber(cluster_domain=cluster_domain),
         )
 
 

@@ -63,12 +63,20 @@ class ProfileConfig:
     def from_env(cls) -> "ProfileConfig":
         import os
 
+        from ..utils import env_flag
+        from .iam import CloudIamBackend
+
         chips = os.environ.get("DEFAULT_TPU_QUOTA_CHIPS", "")
+        # ENABLE_CLOUD_IAM=false opts out for clusters without cloud creds;
+        # with it on (default), plugin apply/revoke edits real IAM policy
+        # documents through the stdlib transports (iam.py).
+        backend = CloudIamBackend() if env_flag("ENABLE_CLOUD_IAM", True) else None
         return cls(
             userid_header=os.environ.get("USERID_HEADER", "kubeflow-userid"),
             userid_prefix=os.environ.get("USERID_PREFIX", ""),
             workload_identity=os.environ.get("WORKLOAD_IDENTITY", ""),
             default_tpu_chips=int(chips) if chips else None,
+            iam_backend=backend,
         )
 
 

@@ -68,6 +68,7 @@ class LeaderElector:
         lease_duration: float = 15.0,
         renew_interval: float = 2.0,
         retry_interval: float = 2.0,
+        renew_deadline: Optional[float] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
     ):
@@ -80,11 +81,21 @@ class LeaderElector:
         self.lease_duration = lease_duration
         self.renew_interval = renew_interval
         self.retry_interval = retry_interval
+        # A leader whose renewals stall past this (client-go renewDeadline,
+        # default 2/3 of the lease) steps down BEFORE a standby could take
+        # over — enforced by a watchdog thread because a renew hung inside
+        # urlopen (RemoteStore timeout 30s > lease 15s) cannot observe its
+        # own staleness.
+        self.renew_deadline = renew_deadline or lease_duration * (2.0 / 3.0)
+        if self.renew_deadline >= lease_duration:
+            raise ValueError("renew_deadline must be < lease_duration")
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._stop = threading.Event()
         self._leading = False
+        self._lead_lock = threading.Lock()  # _set_leading from elector + watchdog
         self._thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
         # Local-clock view of the observed lease: (holder, renewTime string)
         # and when WE saw that renewTime change. Expiry = no observed change
         # for lease_duration — immune to cross-replica clock skew.
@@ -99,6 +110,10 @@ class LeaderElector:
             target=self._run, name=f"leader-{self.name}", daemon=True
         )
         self._thread.start()
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog, name=f"leader-{self.name}-watchdog", daemon=True
+        )
+        self._watchdog_thread.start()
         return self
 
     def stop(self, release: bool = True) -> None:
@@ -120,13 +135,26 @@ class LeaderElector:
         while not self._stop.is_set():
             try:
                 self._tick()
-            except ApiError as e:
-                log.warning("leader %s: apiserver error: %s", self.name, e)
-                if self._leading and time.monotonic() - self._last_renew > self.lease_duration:
-                    # Could not renew for a full lease window: someone else
-                    # may legitimately hold the lease now. Step down first.
-                    self._set_leading(False)
+            except Exception as e:  # noqa: BLE001 — URLError/OSError from a
+                # RemoteStore partition must not kill the election loop: a
+                # dead elector thread with _leading=True is permanent
+                # split-brain once a standby takes over.
+                log.warning("leader %s: apiserver unreachable: %s", self.name, e)
             self._stop.wait(self.renew_interval if self._leading else self.retry_interval)
+
+    def _watchdog(self) -> None:
+        """Step down when renewals stall past renew_deadline, even while the
+        elector thread is stuck inside a hung request. If that hung renew
+        later SUCCEEDS, optimistic concurrency guarantees the lease never
+        changed hands meanwhile, so re-acquiring leadership is safe."""
+        while not self._stop.is_set():
+            if self._leading and time.monotonic() - self._last_renew > self.renew_deadline:
+                log.warning(
+                    "leader %s: no renewal for %.1fs (deadline %.1fs); stepping down",
+                    self.name, time.monotonic() - self._last_renew, self.renew_deadline,
+                )
+                self._set_leading(False)
+            self._stop.wait(self.renew_interval / 2.0)
 
     def _tick(self) -> None:
         lease = self.client.get_opt(LEASE_API, "Lease", self.name, self.namespace)
@@ -135,6 +163,11 @@ class LeaderElector:
             created = self._try(self._create_lease)
             if created is not None:
                 self._won(created)
+            elif self._leading:
+                # Our lease was deleted externally and another candidate won
+                # the re-create race: stop reconciling NOW, don't wait for
+                # the next tick to observe the new holder.
+                self._set_leading(False)
             return
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity")
@@ -169,19 +202,20 @@ class LeaderElector:
         self._set_leading(True)
 
     def _set_leading(self, leading: bool) -> None:
-        if leading == self._leading:
-            return
-        self._leading = leading
-        METRICS.gauge("leader_is_leader", lease=self.name).set(1.0 if leading else 0.0)
-        log.info(
-            "leader %s: %s (%s)",
-            self.name,
-            "acquired" if leading else "lost",
-            self.identity,
-        )
-        cb = self.on_started_leading if leading else self.on_stopped_leading
-        if cb:
-            cb()
+        with self._lead_lock:
+            if leading == self._leading:
+                return
+            self._leading = leading
+            METRICS.gauge("leader_is_leader", lease=self.name).set(1.0 if leading else 0.0)
+            log.info(
+                "leader %s: %s (%s)",
+                self.name,
+                "acquired" if leading else "lost",
+                self.identity,
+            )
+            cb = self.on_started_leading if leading else self.on_stopped_leading
+            if cb:
+                cb()
 
     @staticmethod
     def _try(fn):

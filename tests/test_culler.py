@@ -1,0 +1,217 @@
+"""Production culling prober: per-host HTTP /api/status probing with
+slice-wide idleness aggregation (idle only if ALL hosts idle).
+
+Integration tests run REAL per-host fake Jupyter servers (http.server on
+localhost) behind the default HttpActivityProber — the analog of the
+reference culler's HTTP poll (culler.go:138-189) with the multi-host
+aggregation SURVEY.md §7 calls out as having no reference analog.
+"""
+
+import datetime
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_tpu.controllers.culler import (
+    HttpActivityProber,
+    parse_last_activity,
+)
+from kubeflow_tpu.controllers.notebook import STOP_ANNOTATION, NotebookConfig
+from kubeflow_tpu.platform import build_platform
+
+from test_notebook_controller import mknotebook
+
+
+# -- parse_last_activity ------------------------------------------------------
+
+def iso(epoch: float, fractional: bool = False) -> str:
+    dt = datetime.datetime.fromtimestamp(epoch, tz=datetime.timezone.utc)
+    fmt = "%Y-%m-%dT%H:%M:%S.%fZ" if fractional else "%Y-%m-%dT%H:%M:%SZ"
+    return dt.strftime(fmt)
+
+
+def test_parse_last_activity_reference_layout():
+    # The reference's fixed layout "2006-01-02T15:04:05Z" (culler.go:171-189).
+    assert parse_last_activity(b'{"last_activity": "2026-01-02T15:04:05Z"}') == pytest.approx(
+        datetime.datetime(2026, 1, 2, 15, 4, 5, tzinfo=datetime.timezone.utc).timestamp()
+    )
+
+
+def test_parse_last_activity_fractional_and_offset():
+    t = 1750000000.25
+    assert parse_last_activity(json.dumps({"last_activity": iso(t, fractional=True)})) == pytest.approx(t)
+    # Explicit offset form.
+    assert parse_last_activity(b'{"last_activity": "2026-01-02T16:04:05+01:00"}') == pytest.approx(
+        datetime.datetime(2026, 1, 2, 15, 4, 5, tzinfo=datetime.timezone.utc).timestamp()
+    )
+
+
+def test_parse_last_activity_garbage():
+    assert parse_last_activity(b"not json") is None
+    assert parse_last_activity(b"[]") is None
+    assert parse_last_activity(b'{"last_activity": 42}') is None
+    assert parse_last_activity(b'{"last_activity": "yesterday-ish"}') is None
+    assert parse_last_activity(b"{}") is None
+
+
+# -- prober aggregation (injected transport) ----------------------------------
+
+def test_prober_single_host_default_url():
+    nb = mknotebook()
+    seen = []
+
+    def fake_get(url, timeout):
+        seen.append(url)
+        return json.dumps({"last_activity": iso(1000.0)}).encode()
+
+    prober = HttpActivityProber(cluster_domain="cluster.local", http_get=fake_get)
+    assert prober(nb) == pytest.approx(1000.0)
+    # Reference URL shape (culler.go:141-143), per-pod headless DNS variant.
+    assert seen == ["http://nb-0.nb.team-a.svc.cluster.local:8888/notebook/team-a/nb/api/status"]
+
+
+def test_prober_aggregates_max_across_hosts():
+    nb = mknotebook(tpu={"generation": "v5e", "topology": "4x8"})  # 8 hosts
+    base = 1000.0
+
+    def fake_get(url, timeout):
+        # host i reports activity at base + i; slice-wide = max = base + 7
+        host = int(url.split(".")[0].rsplit("-", 1)[1])
+        return json.dumps({"last_activity": iso(base + host)}).encode()
+
+    prober = HttpActivityProber(http_get=fake_get)
+    assert prober(nb) == pytest.approx(base + 7)
+
+
+def test_prober_unreachable_host_means_unknown():
+    nb = mknotebook(tpu={"generation": "v5e", "topology": "4x8"})
+
+    def fake_get(url, timeout):
+        if "nb-3." in url:
+            return None  # one host unreachable
+        return json.dumps({"last_activity": iso(1000.0)}).encode()
+
+    assert HttpActivityProber(http_get=fake_get)(nb) is None
+
+
+def test_prober_unparseable_body_means_unknown():
+    assert HttpActivityProber(http_get=lambda u, t: b"<html>502</html>")(mknotebook()) is None
+
+
+def test_from_env_wires_default_http_prober(monkeypatch):
+    monkeypatch.setenv("ENABLE_CULLING", "true")
+    monkeypatch.setenv("CLUSTER_DOMAIN", "example.local")
+    cfg = NotebookConfig.from_env()
+    assert isinstance(cfg.activity_prober, HttpActivityProber)
+    assert cfg.activity_prober.cluster_domain == "example.local"
+
+
+# -- integration: real per-host fake Jupyter servers --------------------------
+
+class _FakeJupyter:
+    """One fake Jupyter server per slice host serving /api/status."""
+
+    def __init__(self):
+        self.last_activity = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if not self.path.endswith("/api/status"):
+                    self.send_error(404)
+                    return
+                body = json.dumps(
+                    {"started": iso(0), "last_activity": iso(outer.last_activity, fractional=True)}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def slice_hosts():
+    hosts = [_FakeJupyter() for _ in range(2)]
+    yield hosts
+    for h in hosts:
+        h.close()
+
+
+def _run_culling_platform(hosts, idle_minutes=1):
+    def url_for(nb, host):
+        ns, name = nb["metadata"]["namespace"], nb["metadata"]["name"]
+        return f"http://127.0.0.1:{hosts[host].port}/notebook/{ns}/{name}/api/status"
+
+    config = NotebookConfig(
+        enable_culling=True,
+        idle_time_minutes=idle_minutes,
+        culling_check_period_minutes=0.0005,
+        activity_prober=HttpActivityProber(url_for=url_for),
+    )
+    return build_platform(notebook_config=config).start()
+
+
+def test_all_idle_slice_is_stopped(slice_hosts):
+    for h in slice_hosts:
+        h.last_activity = time.time() - 3600  # every host idle for an hour
+    mgr = _run_culling_platform(slice_hosts)
+    try:
+        mgr.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+            if STOP_ANNOTATION in (nb["metadata"].get("annotations") or {}):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("all-idle slice was not culled")
+        mgr.wait_idle()
+        sts = mgr.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+        assert sts["spec"]["replicas"] == 0
+    finally:
+        mgr.stop()
+
+
+def test_mixed_activity_slice_stays_up(slice_hosts):
+    slice_hosts[0].last_activity = time.time() - 3600  # host 0 idle
+    slice_hosts[1].last_activity = time.time() + 3600  # host 1 active (future-proof vs test runtime)
+    mgr = _run_culling_platform(slice_hosts)
+    try:
+        mgr.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+        time.sleep(0.7)  # many culling periods
+        nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+        assert STOP_ANNOTATION not in (nb["metadata"].get("annotations") or {})
+        sts = mgr.client.get("apps/v1", "StatefulSet", "nb", "team-a")
+        assert sts["spec"]["replicas"] == 2
+    finally:
+        mgr.stop()
+
+
+def test_unreachable_host_prevents_culling(slice_hosts):
+    for h in slice_hosts:
+        h.last_activity = time.time() - 3600
+    slice_hosts[1].close()  # host 1 gone: idleness unknowable
+    mgr = _run_culling_platform(slice_hosts)
+    try:
+        mgr.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
+        time.sleep(0.7)
+        nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+        assert STOP_ANNOTATION not in (nb["metadata"].get("annotations") or {})
+    finally:
+        mgr.stop()

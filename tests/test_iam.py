@@ -1,0 +1,336 @@
+"""Cloud IAM plugin bodies: pure policy-document transforms + backend wiring.
+
+Table-driven, zero cloud calls — parity with the reference's
+plugin_iam_test.go:1-303 and plugin_workload_identity_test.go, plus the
+SigV4 signer checked against AWS's published example vector.
+"""
+
+import datetime
+import json
+
+import pytest
+
+from kubeflow_tpu.controllers.iam import (
+    AWS_DEFAULT_AUDIENCE,
+    CloudIamBackend,
+    add_trust_subject,
+    add_workload_identity_binding,
+    gcp_project_of,
+    issuer_from_provider_arn,
+    remove_trust_subject,
+    remove_workload_identity_binding,
+    role_name_from_arn,
+    sigv4_headers,
+    workload_identity_member,
+)
+
+ISSUER = "oidc.eks.us-west-2.amazonaws.com/id/D48675832CA65BD10A532F597OIDCID"
+PROVIDER_ARN = f"arn:aws:iam::123456789012:oidc-provider/{ISSUER}"
+
+
+def trust_policy(subjects=None):
+    cond = {"StringEquals": {f"{ISSUER}:aud": [AWS_DEFAULT_AUDIENCE]}}
+    if subjects is not None:
+        cond["StringEquals"][f"{ISSUER}:sub"] = subjects
+    return {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Action": "sts:AssumeRoleWithWebIdentity",
+                "Principal": {"Federated": PROVIDER_ARN},
+                "Condition": cond,
+            }
+        ],
+    }
+
+
+# -- ARN parsing (reference: TestGetIssuerUrlFromRoleArn / ...RoleNameFrom...) --
+
+def test_issuer_from_provider_arn():
+    assert issuer_from_provider_arn(PROVIDER_ARN) == ISSUER
+
+
+def test_role_name_from_arn():
+    assert role_name_from_arn("arn:aws:iam::123456789012:role/my-irsa-role") == "my-irsa-role"
+
+
+# -- AWS trust-policy transforms (TestAdd/RemoveServiceAccountInAssumeRolePolicy) --
+
+ADD_CASES = [
+    # (name, initial subjects (None = no :sub key), ns, expected subjects)
+    ("first-subject", None, "team-a", ["system:serviceaccount:team-a:default-editor"]),
+    (
+        "append-to-existing",
+        ["system:serviceaccount:team-a:default-editor"],
+        "team-b",
+        [
+            "system:serviceaccount:team-a:default-editor",
+            "system:serviceaccount:team-b:default-editor",
+        ],
+    ),
+    (
+        "string-valued-sub-promoted-to-list",
+        "system:serviceaccount:team-a:default-editor",
+        "team-b",
+        [
+            "system:serviceaccount:team-a:default-editor",
+            "system:serviceaccount:team-b:default-editor",
+        ],
+    ),
+]
+
+
+@pytest.mark.parametrize("name,initial,ns,expected", ADD_CASES, ids=[c[0] for c in ADD_CASES])
+def test_add_trust_subject(name, initial, ns, expected):
+    doc = trust_policy(initial)
+    out = add_trust_subject(doc, ns, "default-editor")
+    cond = out["Statement"][0]["Condition"]["StringEquals"]
+    assert cond[f"{ISSUER}:sub"] == expected
+    assert cond[f"{ISSUER}:aud"] == [AWS_DEFAULT_AUDIENCE]
+    assert out["Statement"][0]["Action"] == "sts:AssumeRoleWithWebIdentity"
+    assert out["Statement"][0]["Principal"]["Federated"] == PROVIDER_ARN
+
+
+def test_add_trust_subject_idempotent():
+    # ConditionExistError path (plugin_iam.go:155-164): already present → unchanged.
+    doc = trust_policy(["system:serviceaccount:team-a:default-editor"])
+    out = add_trust_subject(doc, "team-a", "default-editor")
+    assert out == doc
+    assert out is not doc  # but still a copy, never an alias
+
+
+REMOVE_CASES = [
+    (
+        "remove-one-of-two",
+        [
+            "system:serviceaccount:team-a:default-editor",
+            "system:serviceaccount:team-b:default-editor",
+        ],
+        "team-a",
+        ["system:serviceaccount:team-b:default-editor"],
+    ),
+    # When the last subject goes, :sub is dropped entirely — a bare null/[]
+    # breaks IAM policy validation (plugin_iam.go:216-227).
+    ("remove-last-drops-sub-key", ["system:serviceaccount:team-a:default-editor"], "team-a", None),
+    ("remove-absent-is-noop", ["system:serviceaccount:team-b:default-editor"], "team-a",
+     ["system:serviceaccount:team-b:default-editor"]),
+]
+
+
+@pytest.mark.parametrize("name,initial,ns,expected", REMOVE_CASES, ids=[c[0] for c in REMOVE_CASES])
+def test_remove_trust_subject(name, initial, ns, expected):
+    out = remove_trust_subject(trust_policy(initial), ns, "default-editor")
+    cond = out["Statement"][0]["Condition"]["StringEquals"]
+    if expected is None:
+        assert f"{ISSUER}:sub" not in cond
+    else:
+        assert cond[f"{ISSUER}:sub"] == expected
+    assert cond[f"{ISSUER}:aud"] == [AWS_DEFAULT_AUDIENCE]
+
+
+def test_trust_roundtrip_add_then_remove_restores_shape():
+    doc = trust_policy(None)
+    added = add_trust_subject(doc, "team-a", "default-editor")
+    removed = remove_trust_subject(added, "team-a", "default-editor")
+    assert f"{ISSUER}:sub" not in removed["Statement"][0]["Condition"]["StringEquals"]
+
+
+def test_transforms_preserve_shared_role_document():
+    """A real shared role: extra statements, StringLike wildcard condition,
+    custom audience. The transforms must touch ONLY statement 0's :sub list
+    (the reference's full-document rebuild would wipe all of this)."""
+    ec2_statement = {
+        "Effect": "Allow",
+        "Action": "sts:AssumeRole",
+        "Principal": {"Service": "ec2.amazonaws.com"},
+    }
+    doc = trust_policy(["system:serviceaccount:team-a:default-editor"])
+    doc["Statement"][0]["Condition"]["StringEquals"][f"{ISSUER}:aud"] = ["custom-audience"]
+    doc["Statement"][0]["Condition"]["StringLike"] = {f"{ISSUER}:sub": "system:serviceaccount:ml-*:*"}
+    doc["Statement"].append(ec2_statement)
+
+    added = add_trust_subject(doc, "team-b", "default-editor")
+    assert added["Statement"][1] == ec2_statement
+    cond = added["Statement"][0]["Condition"]
+    assert cond["StringEquals"][f"{ISSUER}:aud"] == ["custom-audience"]
+    assert cond["StringLike"] == {f"{ISSUER}:sub": "system:serviceaccount:ml-*:*"}
+    assert cond["StringEquals"][f"{ISSUER}:sub"] == [
+        "system:serviceaccount:team-a:default-editor",
+        "system:serviceaccount:team-b:default-editor",
+    ]
+
+    removed = remove_trust_subject(added, "team-a", "default-editor")
+    assert removed["Statement"][1] == ec2_statement
+    assert removed["Statement"][0]["Condition"]["StringLike"] == {
+        f"{ISSUER}:sub": "system:serviceaccount:ml-*:*"
+    }
+    assert removed["Statement"][0]["Condition"]["StringEquals"][f"{ISSUER}:sub"] == [
+        "system:serviceaccount:team-b:default-editor"
+    ]
+
+
+def test_empty_statement_rejected():
+    with pytest.raises(ValueError):
+        add_trust_subject({"Version": "2012-10-17", "Statement": []}, "a", "b")
+
+
+# -- GCP workload-identity transforms ----------------------------------------
+
+def test_gcp_project_of():
+    assert gcp_project_of("kf-user@my-proj.iam.gserviceaccount.com") == "my-proj"
+    with pytest.raises(ValueError):
+        gcp_project_of("kf-user@my-proj.example.com")
+    with pytest.raises(ValueError):
+        gcp_project_of("no-at-sign.iam.gserviceaccount.com".replace("@", ""))
+
+
+def test_workload_identity_member():
+    assert (
+        workload_identity_member("my-proj", "team-a", "default-editor")
+        == "serviceAccount:my-proj.svc.id.goog[team-a/default-editor]"
+    )
+
+
+def test_add_binding_creates_and_is_idempotent():
+    member = workload_identity_member("p", "team-a", "default-editor")
+    p0 = {"etag": "abc", "bindings": [{"role": "roles/owner", "members": ["user:x"]}]}
+    p1 = add_workload_identity_binding(p0, member)
+    assert {"role": "roles/iam.workloadIdentityUser", "members": [member]} in p1["bindings"]
+    assert p1["etag"] == "abc"  # etag preserved for optimistic concurrency
+    # Idempotent — the reference appends a duplicate binding every reconcile
+    # (plugin_workload_identity.go:135-143); we deliberately do not.
+    p2 = add_workload_identity_binding(p1, member)
+    assert p2 == p1
+
+
+def test_add_binding_appends_member_to_existing_role_binding():
+    m1 = workload_identity_member("p", "team-a", "default-editor")
+    m2 = workload_identity_member("p", "team-b", "default-editor")
+    p = add_workload_identity_binding(add_workload_identity_binding({}, m1), m2)
+    wi = [b for b in p["bindings"] if b["role"] == "roles/iam.workloadIdentityUser"]
+    assert len(wi) == 1 and wi[0]["members"] == [m1, m2]
+
+
+def test_remove_binding_drops_empty_binding():
+    member = workload_identity_member("p", "team-a", "default-editor")
+    p = add_workload_identity_binding({"bindings": [{"role": "roles/owner", "members": ["user:x"]}]}, member)
+    out = remove_workload_identity_binding(p, member)
+    assert out["bindings"] == [{"role": "roles/owner", "members": ["user:x"]}]
+
+
+def test_remove_binding_keeps_other_members():
+    m1 = workload_identity_member("p", "team-a", "default-editor")
+    m2 = workload_identity_member("p", "team-b", "default-editor")
+    p = add_workload_identity_binding(add_workload_identity_binding({}, m1), m2)
+    out = remove_workload_identity_binding(p, m1)
+    assert out["bindings"] == [{"role": "roles/iam.workloadIdentityUser", "members": [m2]}]
+
+
+# -- SigV4 signer (AWS published example vector) ------------------------------
+
+def test_sigv4_matches_aws_published_example():
+    # docs.aws.amazon.com "Signature Version 4 signing process" worked example:
+    # GET https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08
+    # at 20150830T123600Z with the documented example credentials.
+    headers = sigv4_headers(
+        "GET",
+        "https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08",
+        b"",
+        service="iam",
+        region="us-east-1",
+        access_key="AKIDEXAMPLE",
+        secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+        now=datetime.datetime(2015, 8, 30, 12, 36, 0, tzinfo=datetime.timezone.utc),
+        extra_headers={"content-type": "application/x-www-form-urlencoded; charset=utf-8"},
+    )
+    assert headers["Authorization"] == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+        "SignedHeaders=content-type;host;x-amz-date, "
+        "Signature=5d672d79c15b13162d9279b0855cfba6789a8edb4c82c400e06b5924a6f2b5d7"
+    )
+
+
+def test_sigv4_session_token_is_signed_header():
+    headers = sigv4_headers(
+        "POST", "https://iam.amazonaws.com/", b"x", service="iam", region="us-east-1",
+        access_key="AKID", secret_key="SK", session_token="TOKEN",
+        now=datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc),
+    )
+    assert headers["X-Amz-Security-Token"] == "TOKEN"
+    assert "x-amz-security-token" in headers["Authorization"]
+
+
+# -- CloudIamBackend orchestration (fake transports) ---------------------------
+
+class FakeAws:
+    def __init__(self, doc):
+        self.doc = doc
+        self.updates = []
+
+    def get_trust_policy(self, role_name):
+        return json.loads(json.dumps(self.doc))
+
+    def update_trust_policy(self, role_name, doc):
+        self.updates.append((role_name, doc))
+        self.doc = doc
+
+
+class FakeGcp:
+    def __init__(self, policy=None):
+        self.policy = policy or {}
+        self.sets = []
+
+    def get_policy(self, sa_resource):
+        return json.loads(json.dumps(self.policy))
+
+    def set_policy(self, sa_resource, policy):
+        self.sets.append((sa_resource, policy))
+        self.policy = policy
+
+
+def test_backend_aws_apply_and_revoke():
+    aws = FakeAws(trust_policy(None))
+    backend = CloudIamBackend(aws=aws, gcp=FakeGcp())
+    spec = {"awsIamRole": "arn:aws:iam::123456789012:role/kf-role"}
+    backend("apply", "AwsIamForServiceAccount", spec, "team-a")
+    assert aws.updates[0][0] == "kf-role"
+    subs = aws.doc["Statement"][0]["Condition"]["StringEquals"][f"{ISSUER}:sub"]
+    assert subs == ["system:serviceaccount:team-a:default-editor"]
+    # Second apply: no-op, no extra cloud write (idempotent reconcile).
+    backend("apply", "AwsIamForServiceAccount", spec, "team-a")
+    assert len(aws.updates) == 1
+    backend("revoke", "AwsIamForServiceAccount", spec, "team-a")
+    assert f"{ISSUER}:sub" not in aws.doc["Statement"][0]["Condition"]["StringEquals"]
+
+
+def test_backend_gcp_apply_and_revoke():
+    gcp = FakeGcp()
+    backend = CloudIamBackend(aws=FakeAws(trust_policy()), gcp=gcp)
+    spec = {"gcpServiceAccount": "kf-user@my-proj.iam.gserviceaccount.com"}
+    backend("apply", "WorkloadIdentity", spec, "team-a")
+    assert gcp.sets[0][0] == "projects/my-proj/serviceAccounts/kf-user@my-proj.iam.gserviceaccount.com"
+    member = "serviceAccount:my-proj.svc.id.goog[team-a/default-editor]"
+    assert gcp.policy["bindings"] == [{"role": "roles/iam.workloadIdentityUser", "members": [member]}]
+    backend("apply", "WorkloadIdentity", spec, "team-a")
+    assert len(gcp.sets) == 1  # idempotent: no duplicate write
+    backend("revoke", "WorkloadIdentity", spec, "team-a")
+    assert gcp.policy["bindings"] == []
+
+
+def test_backend_cross_project_identity_pool():
+    gcp = FakeGcp()
+    backend = CloudIamBackend(aws=FakeAws(trust_policy()), gcp=gcp, ksa_project="cluster-proj")
+    backend("apply", "WorkloadIdentity",
+            {"gcpServiceAccount": "kf-user@sa-proj.iam.gserviceaccount.com"}, "team-a")
+    member = "serviceAccount:cluster-proj.svc.id.goog[team-a/default-editor]"
+    assert gcp.policy["bindings"][0]["members"] == [member]
+
+
+def test_backend_rejects_unknowns():
+    backend = CloudIamBackend(aws=FakeAws(trust_policy()), gcp=FakeGcp())
+    with pytest.raises(ValueError):
+        backend("apply", "AzureThing", {}, "ns")
+    with pytest.raises(ValueError):
+        backend("upsert", "WorkloadIdentity", {}, "ns")

@@ -127,6 +127,101 @@ class TestLeaseProtocol:
         finally:
             a.stop()
 
+    def test_raw_urlerror_does_not_kill_elector(self):
+        """RemoteStore raises raw URLError (not ApiError) on connection
+        failure; the election loop must survive it, step down via the
+        renew-deadline watchdog, and resume when connectivity returns."""
+        import urllib.error
+
+        store = Store()
+
+        class PartitionedClient(Client):
+            def __init__(self, store):
+                super().__init__(store)
+                self.broken = False
+
+            def get_opt(self, *a, **kw):
+                if self.broken:
+                    raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+                return super().get_opt(*a, **kw)
+
+            def update(self, *a, **kw):
+                if self.broken:
+                    raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+                return super().update(*a, **kw)
+
+        cl = PartitionedClient(store)
+        a = LeaderElector(cl, "ctrl", identity="a", **FAST).start()
+        try:
+            assert wait_for(lambda: a.is_leader)
+            cl.broken = True
+            assert wait_for(lambda: not a.is_leader, timeout=5.0)
+            # The loop is still alive: healing the partition resumes leading.
+            cl.broken = False
+            assert wait_for(lambda: a.is_leader, timeout=5.0)
+        finally:
+            a.stop()
+
+    def test_hung_renew_steps_down_before_standby_takeover(self):
+        """A renew stuck inside a slow request (client timeout > lease) must
+        not keep the old leader active past a standby's takeover: the
+        watchdog steps it down at renew_deadline < lease_duration."""
+        store = Store()
+
+        class HangingClient(Client):
+            def __init__(self, store):
+                super().__init__(store)
+                self.hang = False
+
+            def update(self, *a, **kw):
+                if self.hang:
+                    time.sleep(2.5)  # simulated stalled apiserver >> lease
+                return super().update(*a, **kw)
+
+        cl = HangingClient(store)
+        a = LeaderElector(cl, "ctrl", identity="a", **FAST).start()
+        b = LeaderElector(Client(store), "ctrl", identity="b", **FAST).start()
+        try:
+            assert wait_for(lambda: a.is_leader)
+            cl.hang = True
+            t0 = time.monotonic()
+            assert wait_for(lambda: not a.is_leader, timeout=5.0)
+            stepped_down_at = time.monotonic() - t0
+            assert stepped_down_at < FAST["lease_duration"] + 0.3
+            assert wait_for(lambda: b.is_leader, timeout=5.0)
+            assert not a.is_leader  # never two active at once post-takeover
+        finally:
+            cl.hang = False
+            a.stop()
+            b.stop()
+
+    def test_lease_deleted_externally_loser_steps_down_immediately(self):
+        """kubectl delete lease: the old leader that loses the re-create race
+        must step down in the same tick, not linger a full cycle."""
+        from kubeflow_tpu.apiserver.store import Conflict
+
+        store = Store()
+
+        class LosesCreateRace(Client):
+            def __init__(self, store):
+                super().__init__(store)
+                self.lose = False
+
+            def create(self, obj):
+                if self.lose and obj.get("kind") == "Lease":
+                    raise Conflict("lost the re-create race")
+                return super().create(obj)
+
+        cl = LosesCreateRace(store)
+        a = LeaderElector(cl, "ctrl", identity="a", **FAST).start()
+        try:
+            assert wait_for(lambda: a.is_leader)
+            cl.lose = True
+            Client(store).delete(LEASE_API, "Lease", "ctrl", "kubeflow-system")
+            assert wait_for(lambda: not a.is_leader, timeout=2.0)
+        finally:
+            a.stop()
+
     def test_callbacks_fire_on_transition(self):
         store = Store()
         events = []
