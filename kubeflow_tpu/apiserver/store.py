@@ -20,6 +20,7 @@ a resourceVersion via the write journal (etcd watch-window semantics).
 
 from __future__ import annotations
 
+import collections
 import fnmatch
 import queue
 import threading
@@ -106,8 +107,19 @@ class _Watcher:
         self.key = key
         self.namespace = namespace
         self.selector = selector
-        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=4096)
+        # Sized for a 1k-notebook churn wave (~2k pods × several writes
+        # each): overflow closes the watcher and forces a full relist, so
+        # drops must be rare, not routine.
+        self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=16384)
+        # Initial-list / journal-replay events: unbounded, drained before the
+        # live queue. These MUST NOT count against the slow-watcher drop
+        # policy — a collection larger than the queue bound would otherwise
+        # close every watcher mid-relist and informers could never sync.
+        self._preload: "collections.deque[WatchEvent]" = collections.deque()
         self.closed = False
+
+    def preload(self, event: WatchEvent) -> None:
+        self._preload.append(event)
 
     def matches(self, res_key: str, obj: Dict[str, Any]) -> bool:
         if not fnmatch.fnmatch(res_key, self.key):
@@ -146,6 +158,8 @@ class _Watcher:
                     pass
 
     def __iter__(self):
+        while self._preload:
+            yield self._preload.popleft()
         while True:
             item = self.queue.get()
             if item is None:
@@ -159,6 +173,49 @@ class Store:
         self.backend = backend if backend is not None else default_backend()
         self._watchers: List[_Watcher] = []
         self._admission: List[AdmissionHook] = []
+        # GC ownership index, maintained at write time so a sweep never has
+        # to decode the whole store (the old full-scan sweep at 20Hz was the
+        # top cost in the 500-notebook loadtest profile):
+        #   uid -> (res_key, name, namespace) for every live object,
+        #   uid -> [owner uids] only for objects that HAVE ownerReferences.
+        # _gc_dirty gates sweeps: set on any delete (may orphan children)
+        # and on creates/updates that carry ownerReferences.
+        self._gc_uids: Dict[str, Tuple[str, str, Optional[str]]] = {}
+        self._gc_owners: Dict[str, List[str]] = {}
+        self._gc_dirty = True
+        self._gc_index_built = False
+
+    # -- GC index maintenance (caller holds the lock) ------------------------
+    def _gc_track(self, res: Resource, obj: Dict[str, Any]) -> None:
+        md = obj.get("metadata", {})
+        uid = md.get("uid")
+        if not uid:
+            return
+        self._gc_uids[uid] = (res.key, md.get("name", ""), md.get("namespace"))
+        refs = [r.get("uid") for r in (md.get("ownerReferences") or []) if r.get("uid")]
+        if refs:
+            self._gc_owners[uid] = refs
+            self._gc_dirty = True
+        else:
+            self._gc_owners.pop(uid, None)
+
+    def _gc_untrack(self, obj: Dict[str, Any]) -> None:
+        uid = obj.get("metadata", {}).get("uid")
+        if uid:
+            self._gc_uids.pop(uid, None)
+            self._gc_owners.pop(uid, None)
+        self._gc_dirty = True
+
+    def _gc_rebuild(self) -> None:
+        """One full decode at startup for pre-populated (persistent) backends."""
+        self._gc_uids.clear()
+        self._gc_owners.clear()
+        for res_key, obj in self.backend.list_all():
+            res = next((r for r in REGISTRY.all() if r.key == res_key), None)
+            if res is not None:
+                self._gc_track(res, obj)
+        self._gc_index_built = True
+        self._gc_dirty = True
 
     # -- admission ----------------------------------------------------------
     def register_admission(self, hook: AdmissionHook) -> None:
@@ -213,6 +270,7 @@ class Store:
             md["resourceVersion"] = str(rv)
             md.setdefault("generation", 1)
             self.backend.put(res.key, ns, name, obj, rv, "ADDED")
+            self._gc_track(res, obj)
             self._notify(res, WatchEvent("ADDED", obj))
             return apimeta.deepcopy(obj)
 
@@ -302,10 +360,12 @@ class Store:
             rv = self.backend.next_rv()
             md["resourceVersion"] = str(rv)
             self.backend.put(res.key, ns, name, obj, rv, "MODIFIED")
+            self._gc_track(res, obj)
             self._notify(res, WatchEvent("MODIFIED", obj))
             # Finalizer removal on a deleting object completes the delete.
             if md.get("deletionTimestamp") and not md.get("finalizers"):
                 self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
+                self._gc_untrack(obj)
                 self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
 
@@ -344,6 +404,7 @@ class Store:
                     self._notify(res, WatchEvent("MODIFIED", obj))
                 return apimeta.deepcopy(obj)
             self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
+            self._gc_untrack(obj)
             self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
 
@@ -390,10 +451,10 @@ class Store:
                     raise Expired(str(e)) from None
                 for rec in records:
                     if w.matches(rec.bucket, rec.object):
-                        w.send(WatchEvent(rec.type, rec.object))
+                        w.preload(WatchEvent(rec.type, rec.object))
             elif send_initial and res is not None:
                 for obj in self.list(res, namespace=namespace, label_selector=label_selector):
-                    w.send(WatchEvent("ADDED", obj))
+                    w.preload(WatchEvent("ADDED", obj))
             self._watchers.append(w)
         return w
 
@@ -403,19 +464,27 @@ class Store:
 
         Kubernetes runs this in kube-controller-manager; here it is invoked by
         the manager loop so e2e deletes cascade (Notebook → StatefulSet → Pod).
+        Sweeps read the write-time ownership index — no store decode — and
+        no-op entirely unless a write since the last sweep could have
+        orphaned something (``_gc_dirty``).
         """
         deleted = 0
         with self._lock:
-            everything = self.backend.list_all()
-            uids = {obj["metadata"]["uid"] for _, obj in everything}
+            if not self._gc_index_built:
+                self._gc_rebuild()
+            if not self._gc_dirty:
+                return 0
+            self._gc_dirty = False
             doomed: List[Tuple[Resource, str, Optional[str]]] = []
-            for res_key, obj in everything:
-                refs = obj["metadata"].get("ownerReferences") or []
-                if refs and all(r.get("uid") not in uids for r in refs):
+            for uid, owners in self._gc_owners.items():
+                if all(o not in self._gc_uids for o in owners):
+                    res_key, name, ns = self._gc_uids[uid]
                     res = next(r for r in REGISTRY.all() if r.key == res_key)
-                    doomed.append((res, apimeta.name_of(obj), apimeta.namespace_of(obj)))
+                    doomed.append((res, name, ns))
         for res, name, ns in doomed:
             try:
+                # Each delete re-marks dirty, so grandchildren cascade on the
+                # next sweep.
                 self.delete(res, name, ns)
                 deleted += 1
             except NotFound:

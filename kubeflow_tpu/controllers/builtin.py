@@ -16,8 +16,42 @@ from typing import Any, Dict, List, Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
+from ..apiserver.store import Conflict
 from ..runtime.manager import Reconciler, Request, Result
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+
+POD_OWNER_INDEX = "controller-owner-uid"
+
+
+def _pod_owner_keys(pod: Dict[str, Any]) -> List[str]:
+    uid = (apimeta.controller_owner_of(pod) or {}).get("uid")
+    return [uid] if uid else []
+
+
+class _OwnedPodsMixin:
+    """Pods owned by one controller object, via an informer index when the
+    reconciler runs under a Manager — the per-reconcile list of EVERY pod in
+    the namespace was a top cost in the 500-notebook loadtest profile."""
+
+    def _owned_pods(self, client: Client, namespace: Optional[str], owner_uid: str):
+        if self.cache is None:
+            return [
+                p for p in client.list("v1", "Pod", namespace)
+                if (apimeta.controller_owner_of(p) or {}).get("uid") == owner_uid
+            ]
+        inf = self.cache.informer_for("v1", "Pod")
+        inf.add_index(POD_OWNER_INDEX, _pod_owner_keys)
+        inf.wait_synced()
+        return inf.by_index(POD_OWNER_INDEX, owner_uid)
+
+    @staticmethod
+    def _create_pod_tolerant(client: Client, pod: Dict[str, Any]) -> None:
+        """Informer reads lag our own writes by one watch delivery; a
+        same-name Conflict just means the pod already exists."""
+        try:
+            client.create(pod)
+        except Conflict:
+            pass
 
 
 def _pod_for_template(
@@ -39,7 +73,7 @@ def _pod_for_template(
     return pod
 
 
-class StatefulSetReconciler(Reconciler):
+class StatefulSetReconciler(_OwnedPodsMixin, Reconciler):
     """Materializes ordinal pods with stable hostnames + subdomain DNS —
     exactly the properties the JAX coordinator bootstrap relies on."""
 
@@ -56,11 +90,8 @@ class StatefulSetReconciler(Reconciler):
         service_name = spec.get("serviceName") or req.name
         selector_labels = (spec.get("selector") or {}).get("matchLabels") or {}
 
-        existing = {
-            apimeta.name_of(p): p
-            for p in client.list("v1", "Pod", req.namespace)
-            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(sts)
-        }
+        owned = self._owned_pods(client, req.namespace, apimeta.uid_of(sts))
+        existing = {apimeta.name_of(p): p for p in owned}
         want_names = [f"{req.name}-{i}" for i in range(replicas)]
         for i, name in enumerate(want_names):
             if name in existing:
@@ -74,7 +105,7 @@ class StatefulSetReconciler(Reconciler):
             pod["metadata"].setdefault("labels", {})[
                 "statefulset.kubernetes.io/pod-name"
             ] = name
-            client.create(pod)
+            self._create_pod_tolerant(client, pod)
         for name in set(existing) - set(want_names):
             client.delete_opt("v1", "Pod", name, req.namespace)
         # Pod template drift → recreate (simplified rolling update).
@@ -85,11 +116,7 @@ class StatefulSetReconciler(Reconciler):
             if _template_drifted(pod["spec"], template.get("spec", {})):
                 client.delete_opt("v1", "Pod", name, req.namespace)
 
-        pods = [
-            p
-            for p in client.list("v1", "Pod", req.namespace)
-            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(sts)
-        ]
+        pods = self._owned_pods(client, req.namespace, apimeta.uid_of(sts))
         ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
         sts["status"] = {"replicas": len(pods), "readyReplicas": ready, "currentReplicas": len(pods)}
         client.update_status(sts)
@@ -114,7 +141,7 @@ def _template_drifted(live_spec: Dict[str, Any], want_spec: Dict[str, Any]) -> b
     return False
 
 
-class DeploymentReconciler(Reconciler):
+class DeploymentReconciler(_OwnedPodsMixin, Reconciler):
     """Deployment → pods (no ReplicaSet indirection; tensorboards and web
     apps only need replica maintenance)."""
 
@@ -129,22 +156,15 @@ class DeploymentReconciler(Reconciler):
         replicas = spec.get("replicas", 1)
         template = spec.get("template", {})
         selector_labels = (spec.get("selector") or {}).get("matchLabels") or {}
-        existing = {
-            apimeta.name_of(p): p
-            for p in client.list("v1", "Pod", req.namespace)
-            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(dep)
-        }
+        owned = self._owned_pods(client, req.namespace, apimeta.uid_of(dep))
+        existing = {apimeta.name_of(p): p for p in owned}
         want_names = [f"{req.name}-{i}" for i in range(replicas)]
         for name in want_names:
             if name not in existing:
-                client.create(_pod_for_template(dep, name, template, selector_labels))
+                self._create_pod_tolerant(client, _pod_for_template(dep, name, template, selector_labels))
         for name in set(existing) - set(want_names):
             client.delete_opt("v1", "Pod", name, req.namespace)
-        pods = [
-            p
-            for p in client.list("v1", "Pod", req.namespace)
-            if (apimeta.controller_owner_of(p) or {}).get("uid") == apimeta.uid_of(dep)
-        ]
+        pods = self._owned_pods(client, req.namespace, apimeta.uid_of(dep))
         ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
         dep["status"] = {
             "replicas": len(pods),

@@ -103,6 +103,12 @@ class NotebookReconciler(Reconciler):
 
     def __init__(self, config: Optional[NotebookConfig] = None):
         self.config = config or NotebookConfig()
+        # Mirrored-event keys also tracked locally: the informer cache lags
+        # the write we just made by one watch delivery, so two back-to-back
+        # reconciles would double-mirror without this.
+        self._mirrored_keys: set = set()
+        # Lazily-built incremental running-notebook sets per namespace.
+        self._running_by_ns: Optional[Dict[str, set]] = None
 
     def watches(self):
         def map_pod(pod: Dict[str, Any]) -> List[Request]:
@@ -360,16 +366,35 @@ class NotebookReconciler(Reconciler):
             nb["status"] = status
             client.update_status(nb)
 
+    EVENT_INDEX = "notebook-events"
+
+    def _events_for(self, client: Client, ns: str, name: str) -> List[Dict[str, Any]]:
+        """Events touching one notebook: informer index keyed by notebook
+        (reference reads through shared informers the same way —
+        access-management/kfam/api_default.go:71-75). Without a manager
+        (unit tests) fall back to a direct list."""
+        if self.cache is None:
+            return [
+                e for e in client.list("v1", "Event", ns)
+                if _nb_name_from_involved_object(e) == name
+                or (e.get("involvedObject", {}).get("kind") == "Notebook"
+                    and e.get("involvedObject", {}).get("name") == name)
+            ]
+        inf = self.cache.informer_for("v1", "Event")
+        inf.add_index(self.EVENT_INDEX, _event_notebook_keys)
+        inf.wait_synced()
+        return inf.by_index(self.EVENT_INDEX, f"{ns}/{name}")
+
     def _mirror_child_events(self, client: Client, nb: Dict[str, Any]) -> None:
         """Re-emit pod/sts events on the Notebook (reference :90-109)."""
         name, ns = apimeta.name_of(nb), apimeta.namespace_of(nb)
-        events = client.list("v1", "Event", ns)
+        events = self._events_for(client, ns, name)
         mirrored = {
-            (e.get("reason"), e.get("message"))
+            (ns, name, e.get("reason"), e.get("message"))
             for e in events
             if e.get("involvedObject", {}).get("kind") == "Notebook"
             and e.get("involvedObject", {}).get("name") == name
-        }
+        } | self._mirrored_keys
         for ev in events:
             inv = ev.get("involvedObject", {})
             if inv.get("kind") not in ("Pod", "StatefulSet"):
@@ -378,19 +403,45 @@ class NotebookReconciler(Reconciler):
                 continue
             if ev.get("type") != "Warning":
                 continue
-            key = (ev.get("reason"), ev.get("message"))
+            key = (ns, name, ev.get("reason"), ev.get("message"))
             if key in mirrored:
                 continue
             client.emit_event(nb, ev.get("reason", ""), ev.get("message", ""), type_="Warning")
             mirrored.add(key)
+            self._mirrored_keys.add(key)
 
     def _update_running_gauge(self, client: Client, namespace: Optional[str]) -> None:
-        running = 0
-        for sts in client.list("apps/v1", "StatefulSet", namespace):
-            if NOTEBOOK_NAME_LABEL in (sts.get("spec", {}).get("selector", {}).get("matchLabels") or {}):
-                if sts.get("status", {}).get("readyReplicas", 0) > 0:
-                    running += 1
-        METRICS.gauge("notebook_running", namespace=namespace or "").set(running)
+        if self.cache is None:  # no manager: direct scan (unit-test path)
+            running = sum(1 for sts in client.list("apps/v1", "StatefulSet", namespace)
+                          if _is_running_notebook_sts(sts))
+            METRICS.gauge("notebook_running", namespace=namespace or "").set(running)
+            return
+        # Incremental: a handler on the StatefulSet informer maintains the
+        # per-namespace running set; each reconcile reads one dict entry
+        # instead of scanning every StatefulSet (the O(cluster) list the
+        # reference's metrics collector does — pkg/metrics/metrics.go:82-99).
+        if self._running_by_ns is None:
+            self._running_by_ns = {}
+            tracker = self._running_by_ns
+
+            def track(event_type: str, sts: Dict[str, Any]) -> None:
+                sns = apimeta.namespace_of(sts)
+                key = apimeta.name_of(sts)
+                members = tracker.setdefault(sns, set())
+                if event_type != "DELETED" and _is_running_notebook_sts(sts):
+                    members.add(key)
+                else:
+                    members.discard(key)
+                METRICS.gauge("notebook_running", namespace=sns or "").set(len(members))
+
+            inf = self.cache.informer_for("apps/v1", "StatefulSet")
+            inf.add_event_handler(track)
+            inf.wait_synced()
+            for sts in inf.list():
+                track("ADDED", sts)
+        METRICS.gauge("notebook_running", namespace=namespace or "").set(
+            len(self._running_by_ns.get(namespace, set()))
+        )
 
     # -- culling -------------------------------------------------------------
     def _check_culling(self, client: Client, nb: Dict[str, Any]) -> Result:
@@ -418,6 +469,24 @@ class NotebookReconciler(Reconciler):
             client.emit_event(nb, "Culling", f"idle for {idle_seconds:.0f}s; stopping", type_="Normal")
             return Result()
         return Result(requeue_after=period)
+
+
+def _is_running_notebook_sts(sts: Dict[str, Any]) -> bool:
+    return (
+        NOTEBOOK_NAME_LABEL in (sts.get("spec", {}).get("selector", {}).get("matchLabels") or {})
+        and sts.get("status", {}).get("readyReplicas", 0) > 0
+    )
+
+
+def _event_notebook_keys(ev: Dict[str, Any]) -> List[str]:
+    """Index keys ``<ns>/<notebook>`` for an Event: direct Notebook events
+    and Pod/StatefulSet child events both land in the same bucket."""
+    inv = ev.get("involvedObject", {})
+    ns = inv.get("namespace") or apimeta.namespace_of(ev)
+    if inv.get("kind") == "Notebook":
+        return [f"{ns}/{inv.get('name')}"]
+    nb = _nb_name_from_involved_object(ev)
+    return [f"{ns}/{nb}"] if nb else []
 
 
 def _nb_name_from_involved_object(ev: Dict[str, Any]) -> Optional[str]:

@@ -202,14 +202,23 @@ class TrialPodRunner(Reconciler):
 
     The pod carries the trial parameters as JSON in ``TRIAL_PARAMETERS`` env
     plus per-parameter ``PARAM_<NAME>`` vars, the studyjob labels (so TPU
-    PodDefaults match and inject slice env/limits), and reports back through
-    pod phase. Metrics arrive via the trial's results annotation — written
-    by the trial process through the downward-API-less path: a status
-    updater sidecar in production, the executor below in CI.
+    PodDefaults match and inject slice env/limits), and the reporter
+    contract env (``TRIAL_NAME``/``TRIAL_NAMESPACE``/``TRIAL_OBJECTIVE``/
+    ``APISERVER_URL``): the trial entrypoint (images/trial-jax-tpu →
+    ``python -m kubeflow_tpu.hpo.reporter``) runs the objective and PATCHes
+    ``{metric: value}`` back as the ``results`` annotation, which this
+    reconciler folds into trial status. Pod phase carries success/failure.
     """
 
     FOR = (STUDY_API, "Trial")
     OWNS = [("v1", "Pod")]
+
+    def __init__(self, apiserver_url: Optional[str] = None):
+        import os
+
+        from ..runtime.bootstrap import DEFAULT_APISERVER
+
+        self.apiserver_url = apiserver_url or os.environ.get("APISERVER_URL", DEFAULT_APISERVER)
 
     def reconcile(self, client: Client, req: Request) -> Result:
         trial = client.get_opt(*self.FOR, req.name, req.namespace)
@@ -227,7 +236,13 @@ class TrialPodRunner(Reconciler):
                 "name": "trial",
                 "image": template.get("image", "kubeflow-tpu/trial-jax:latest"),
                 "command": template.get("command") or [],
-                "env": [{"name": "TRIAL_PARAMETERS", "value": json.dumps(params, sort_keys=True)}]
+                "env": [
+                    {"name": "TRIAL_PARAMETERS", "value": json.dumps(params, sort_keys=True)},
+                    {"name": "TRIAL_NAME", "value": req.name},
+                    {"name": "TRIAL_NAMESPACE", "value": req.namespace or ""},
+                    {"name": "TRIAL_OBJECTIVE", "value": template.get("objective", "mnist")},
+                    {"name": "APISERVER_URL", "value": self.apiserver_url},
+                ]
                 + [
                     {"name": f"PARAM_{k.upper()}", "value": str(v)}
                     for k, v in sorted(params.items())

@@ -121,6 +121,8 @@ class LeaderElector:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._watchdog_thread:
+            self._watchdog_thread.join(timeout=5)
         if self._leading:
             self._set_leading(False)
             if release:

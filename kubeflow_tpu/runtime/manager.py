@@ -42,7 +42,15 @@ class Result:
 
 
 class Reconciler:
-    """Subclass and override reconcile(); set FOR = (apiVersion, kind)."""
+    """Subclass and override reconcile(); set FOR = (apiVersion, kind).
+
+    ``cache`` is injected by the Manager: a shared InformerCache for
+    watch-backed reads on hot paths (see runtime/informer.py). It is None
+    when the reconciler runs outside a manager (unit tests) — fall back to
+    direct client lists then.
+    """
+
+    cache = None  # set by Manager.add
 
     FOR: Tuple[str, str] = ("", "")
     OWNS: List[Tuple[str, str]] = []
@@ -220,6 +228,12 @@ class _Controller:
             except Exception:
                 pass
         self.queue.shutdown()
+        # Join: a daemon thread still inside a ctypes call into the native
+        # store when the interpreter finalizes gets pthread_exit()ed mid-C++
+        # frame — glibc aborts with "FATAL: exception not rethrown".
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
 
     def _worker(self) -> None:
         client = self.mgr.client
@@ -259,19 +273,25 @@ class Manager:
     kube-controller-manager would."""
 
     def __init__(self, store: Optional[Store] = None):
+        from .informer import InformerCache  # late import: manager ↛ informer cycle
+
         self.store = store or Store()
         self.client = Client(self.store)
+        self.cache = InformerCache(self.client)
         self._controllers: List[_Controller] = []
         self._started = False
         self._stop = threading.Event()
 
     def add(self, reconciler: Reconciler) -> "Manager":
+        reconciler.cache = self.cache
         self._controllers.append(_Controller(self, reconciler))
         if self._started:
             self._controllers[-1].start()
         return self
 
     def start(self) -> "Manager":
+        from .informer import InformerCache
+
         if self._started:
             return self
         if self._stop.is_set():
@@ -281,11 +301,14 @@ class Manager:
             # reconcilers with a fresh stop event.
             self._stop = threading.Event()
             self._controllers = [_Controller(self, c.reconciler) for c in self._controllers]
+            self.cache = InformerCache(self.client)
+            for c in self._controllers:
+                c.reconciler.cache = self.cache
         self._started = True
         for c in self._controllers:
             c.start()
-        t = threading.Thread(target=self._gc_loop, name="garbage-collector", daemon=True)
-        t.start()
+        self._gc_thread = threading.Thread(target=self._gc_loop, name="garbage-collector", daemon=True)
+        self._gc_thread.start()
         return self
 
     def _gc_loop(self) -> None:
@@ -302,6 +325,10 @@ class Manager:
         self._stop.set()
         for c in self._controllers:
             c.stop()
+        self.cache.stop()
+        gc_thread = getattr(self, "_gc_thread", None)
+        if gc_thread is not None:
+            gc_thread.join(timeout=2.0)
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
         """Block until all queues drain and stay drained for ``settle`` seconds.

@@ -20,6 +20,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..controllers.profile import PROFILE_API
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+from ..web.openapi import install_apidocs
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth
 from ..web.http import App, HttpError, JsonResponse, Request
@@ -45,13 +46,22 @@ class TpuMetricsService:
     TPU chip allocation — the platform's duty-cycle stand-in until node
     agents export real utilization."""
 
-    def __init__(self, client: Client):
+    def __init__(self, client: Client, cache: Optional["InformerCache"] = None):
+        from ..runtime.informer import InformerCache
+
         self.client = client
+        # Watch-backed reads: a dashboard poll must not list every pod in
+        # the cluster per request (the reference reads through a shared
+        # informer — kfam/api_default.go:71-75).
+        self.cache = cache or InformerCache(client)
+
+    def _list(self, api_version: str, kind: str, namespace: Optional[str] = None):
+        return self.cache.list(api_version, kind, namespace)
 
     def node_tpu_utilization(self) -> List[Dict[str, Any]]:
         out = []
-        pods = self.client.list("v1", "Pod")
-        for node in self.client.list("v1", "Node"):
+        pods = self._list("v1", "Pod")
+        for node in self._list("v1", "Node"):
             name = apimeta.name_of(node)
             capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
             if capacity <= 0:
@@ -64,7 +74,7 @@ class TpuMetricsService:
         return out
 
     def namespace_tpu_usage(self, namespace: str) -> Dict[str, Any]:
-        used = sum(pod_tpu_chips(p) for p in self.client.list("v1", "Pod", namespace))
+        used = sum(pod_tpu_chips(p) for p in self._list("v1", "Pod", namespace))
         return {"namespace": namespace, "allocatedChips": used}
 
 
@@ -245,6 +255,7 @@ def make_dashboard_app(
         )
         return contributors(req)
 
+    install_apidocs(app)
     install_spa(app, load_ui("dashboard.html"), cfg)
     return app
 

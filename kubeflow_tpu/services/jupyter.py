@@ -33,6 +33,8 @@ from ..tpu.topology import (
     NODE_LABEL_TOPOLOGY,
     RESOURCE_TPU,
 )
+from ..web.openapi import install_apidocs
+from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
 from ..web.http import App, HttpError, JsonResponse, Request
@@ -239,6 +241,8 @@ def make_jupyter_app(
         client.delete(NOTEBOOK_API, "Notebook", name, ns)
         return {"status": "deleted"}
 
+    install_cluster_api(app, client, authorizer)
+    install_apidocs(app)
     install_spa(app, load_ui("jupyter.html"), cfg)
     return app
 

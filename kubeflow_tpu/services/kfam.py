@@ -25,6 +25,7 @@ from ..apiserver.store import Conflict
 from ..controllers.profile import PROFILE_API, ROLE_MAP
 from ..runtime.metrics import METRICS
 from ..web.auth import AuthConfig, Authorizer, install_auth
+from ..web.openapi import install_apidocs
 from ..web.http import App, HttpError, Request
 
 BINDING_ANNOTATION_USER = "user"
@@ -197,6 +198,9 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
         user = req.query1("user") or req.context["user"]
         return authorizer.is_cluster_admin(user)
 
+    # API contract (reference ships access-management/api/swagger.yaml by
+    # hand; ours is generated from the route table so it cannot drift).
+    install_apidocs(app, base_path="/kfam")
     return app
 
 def main() -> None:  # python -m kubeflow_tpu.services.kfam

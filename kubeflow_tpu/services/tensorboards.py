@@ -13,6 +13,8 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
 from ..controllers.tensorboard import TB_API, parse_logspath
+from ..web.openapi import install_apidocs
+from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
 from ..web.http import App, HttpError, JsonResponse, Request
@@ -71,6 +73,8 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
         client.delete(TB_API, "Tensorboard", req.params["name"], req.params["ns"])
         return {"status": "deleted"}
 
+    install_cluster_api(app, client, authorizer)
+    install_apidocs(app)
     install_spa(app, load_ui("tensorboards.html"), cfg)
     return app
 

@@ -13,6 +13,8 @@ from typing import Optional
 from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
+from ..web.openapi import install_apidocs
+from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
 from ..web.http import App, HttpError, JsonResponse, Request
@@ -83,6 +85,8 @@ def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
         client.delete("v1", "PersistentVolumeClaim", name, ns)
         return {"status": "deleted"}
 
+    install_cluster_api(app, client, authorizer)
+    install_apidocs(app)
     install_spa(app, load_ui("volumes.html"), cfg)
     return app
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import hmac
 import secrets
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
@@ -28,7 +28,6 @@ USERID_HEADER = "kubeflow-userid"
 XSRF_COOKIE = "XSRF-TOKEN"
 XSRF_HEADER = "x-xsrf-token"
 UNSAFE = {"POST", "PUT", "PATCH", "DELETE"}
-PROBE_PATHS = ("/healthz",)  # auth-free; /metrics stays authenticated
 
 #: verb sets per platform ClusterRole (reference kfam bindings.go:39-46 role
 #: model + kubeflow-edit/view RBAC manifests).
@@ -100,17 +99,37 @@ class Authorizer:
             )
 
 
-def install_auth(app: App, authorizer: Authorizer, enable_csrf: bool = True) -> None:
+def install_auth(
+    app: App,
+    authorizer: Authorizer,
+    enable_csrf: bool = True,
+    readiness_check: Optional[Callable[[], None]] = None,
+) -> None:
     """Probes bypass + identity (+ CSRF for browser-facing apps), in order.
 
     Server-to-server APIs (KFAM — the dashboard BFF calls it with the user's
     forwarded identity header) skip CSRF, as the reference does: csrf.py
-    lives only in the crud_backend the browser talks to."""
+    lives only in the crud_backend the browser talks to.
+
+    Probe split (reference crud_backend/probes.py:7-16): ``/healthz/liveness``
+    answers whenever the process serves requests; ``/healthz/readiness`` runs
+    ``readiness_check`` (default: one apiserver list round-trip) and returns
+    503 on failure, so manifests can distinguish "up" from "ready". Bare
+    ``/healthz`` stays as the liveness alias."""
     cfg = authorizer.cfg
+    if readiness_check is None:
+        def readiness_check() -> None:  # default: backing apiserver reachable
+            authorizer.client.list("v1", "Namespace")
 
     @app.middleware
     def probes(req: Request) -> Optional[JsonResponse]:
-        if req.path.startswith(PROBE_PATHS):
+        if req.path in ("/healthz", "/healthz/liveness"):
+            return JsonResponse({"status": "ok"})
+        if req.path == "/healthz/readiness":
+            try:
+                readiness_check()
+            except Exception as e:
+                return JsonResponse({"status": "unready", "reason": str(e)}, status=503)
             return JsonResponse({"status": "ok"})
         return None
 

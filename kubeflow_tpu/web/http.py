@@ -127,7 +127,7 @@ def _compile(pattern: str) -> re.Pattern:
 class App:
     def __init__(self, name: str = "app"):
         self.name = name
-        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._routes: List[Tuple[str, str, re.Pattern, Handler]] = []
         self._middleware: List[Middleware] = []
 
     def route(self, pattern: str, methods: Tuple[str, ...] = ("GET",)) -> Callable[[Handler], Handler]:
@@ -135,10 +135,16 @@ class App:
 
         def deco(fn: Handler) -> Handler:
             for m in methods:
-                self._routes.append((m.upper(), rx, fn))
+                self._routes.append((m.upper(), pattern, rx, fn))
             return fn
 
         return deco
+
+    def iter_routes(self):
+        """(method, pattern, handler) triples in registration order —
+        the source for the generated OpenAPI contract (web/openapi.py)."""
+        for method, pattern, _rx, fn in self._routes:
+            yield method, pattern, fn
 
     def middleware(self, fn: Middleware) -> Middleware:
         self._middleware.append(fn)
@@ -168,7 +174,7 @@ class App:
                 short = mw(req)
                 if short is not None:
                     return short
-            for method, rx, fn in self._routes:
+            for method, _pattern, rx, fn in self._routes:
                 if method != req.method:
                     continue
                 m = rx.match(req.path)
@@ -178,7 +184,7 @@ class App:
                     if isinstance(result, (JsonResponse, StreamingResponse)):
                         return result
                     return JsonResponse(result)
-            if any(rx.match(req.path) for _, rx, _ in self._routes):
+            if any(rx.match(req.path) for _, _, rx, _ in self._routes):
                 raise HttpError(405, f"method {req.method} not allowed")
             raise HttpError(404, f"no route for {req.path}")
         except HttpError as e:

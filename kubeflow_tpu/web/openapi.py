@@ -1,0 +1,88 @@
+"""Machine-readable API contracts, generated from the live route table.
+
+The reference publishes a hand-written swagger 2.0 document for KFAM
+(components/access-management/api/swagger.yaml) and nothing for the CRUD
+apps. Here every app built on ``web.http.App`` can serve a generated
+contract at ``/apidocs`` (JSON) and ``/apidocs.yaml`` — derived from the
+actual registered routes, so it can never drift from the implementation.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+from .http import App, JsonResponse, Request
+
+_PARAM_RX = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+
+
+def _swagger_path(pattern: str) -> str:
+    return _PARAM_RX.sub(r"{\1}", pattern)
+
+
+def openapi_document(app: App, base_path: str = "/", version: str = "1.0") -> Dict[str, Any]:
+    """Swagger 2.0 document from the app's route table.
+
+    Handler docstrings (first line) become operation summaries.
+    """
+    paths: Dict[str, Dict[str, Any]] = {}
+    for method, pattern, fn in app.iter_routes():
+        swagger = _swagger_path(pattern)
+        params: List[Dict[str, Any]] = [
+            {"name": name, "in": "path", "required": True, "type": "string"}
+            for name in _PARAM_RX.findall(pattern)
+        ]
+        op: Dict[str, Any] = {
+            "operationId": f"{fn.__name__}_{method.lower()}",
+            "responses": {"200": {"description": "OK"}},
+        }
+        doc = (fn.__doc__ or "").strip().splitlines()
+        if doc:
+            op["summary"] = doc[0].strip()
+        if params:
+            op["parameters"] = params
+        if method in ("POST", "PUT", "PATCH"):
+            op.setdefault("parameters", []).append(
+                {"name": "body", "in": "body", "schema": {"type": "object"}}
+            )
+            op["consumes"] = ["application/json"]
+        paths.setdefault(swagger, {})[method.lower()] = op
+    return {
+        "swagger": "2.0",
+        "info": {"title": app.name, "version": version},
+        "basePath": base_path,
+        "schemes": ["http", "https"],
+        "produces": ["application/json"],
+        "paths": dict(sorted(paths.items())),
+    }
+
+
+def install_apidocs(app: App, base_path: str = "/", version: str = "1.0") -> None:
+    """Serve the generated contract at /apidocs + /apidocs.yaml.
+
+    Registered LAST so the document covers every route added before it;
+    the /apidocs routes themselves are excluded.
+    """
+
+    @app.route("/apidocs")
+    def apidocs(req: Request):
+        return _document_cached()
+
+    @app.route("/apidocs.yaml")
+    def apidocs_yaml(req: Request):
+        import yaml
+
+        text = yaml.safe_dump(_document_cached(), sort_keys=False)
+        return JsonResponse(text, headers={"Content-Type": "application/yaml"})
+
+    _skip = {"apidocs", "apidocs_yaml"}
+    _cache: Dict[str, Any] = {}
+
+    def _document_cached() -> Dict[str, Any]:
+        if not _cache:
+            doc = openapi_document(app, base_path=base_path, version=version)
+            for path in ("/apidocs", "/apidocs.yaml"):
+                doc["paths"].pop(path, None)
+            _cache.update(doc)
+        return _cache

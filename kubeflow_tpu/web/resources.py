@@ -1,0 +1,149 @@
+"""Shared secondary-resource endpoints for the CRUD web apps.
+
+The reference's shared Flask backend exposes more than each app's primary
+kind: secrets, storage classes, nodes, pods and generic custom resources
+(crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/api/
+{secret,storageclass,node,pod,custom_resource}.py) — the volumes form
+consumes storage classes, the spawner shows node capacity, config panels
+list secrets. ``install_cluster_api`` adds the same surface to any app built
+on ``web.http.App``, with the platform's per-call authorization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import meta as apimeta
+from ..apiserver.client import Client
+from ..apiserver.store import ApiError, Conflict, NotFound
+from .auth import Authorizer
+from .http import App, HttpError, Request
+
+
+def install_cluster_api(app: App, client: Client, authorizer: Authorizer) -> None:
+    @app.route("/api/storageclasses")
+    def list_storageclasses(req: Request):
+        """List StorageClasses (volumes form storage-class picker)."""
+        # Cluster-scoped read: any authenticated user may list, like the
+        # reference's storageclass.py (it runs with the backend's own SA).
+        return {
+            "storageClasses": [
+                {
+                    "name": apimeta.name_of(sc),
+                    "provisioner": sc.get("provisioner", ""),
+                    "isDefault": (apimeta.annotations_of(sc).get(
+                        "storageclass.kubernetes.io/is-default-class") == "true"),
+                }
+                for sc in client.list("storage.k8s.io/v1", "StorageClass")
+            ]
+        }
+
+    @app.route("/api/nodes")
+    def list_nodes(req: Request):
+        """List nodes with capacity (TPU/accelerator discovery)."""
+        return {
+            "nodes": [
+                {
+                    "name": apimeta.name_of(n),
+                    "labels": apimeta.labels_of(n),
+                    "capacity": n.get("status", {}).get("capacity", {}),
+                    "allocatable": n.get("status", {}).get("allocatable", {}),
+                }
+                for n in client.list("v1", "Node")
+            ]
+        }
+
+    @app.route("/api/namespaces/<ns>/secrets")
+    def list_secrets(req: Request):
+        """List secret names/types in a namespace (values never leave the server)."""
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "list", ns)
+        return {
+            "secrets": [
+                {
+                    "name": apimeta.name_of(s),
+                    "type": s.get("type", "Opaque"),
+                    "keys": sorted((s.get("data") or {}).keys()),
+                }
+                for s in client.list("v1", "Secret", ns)
+            ]
+        }
+
+    @app.route("/api/namespaces/<ns>/pods")
+    def list_pods(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "list", ns)
+        return {
+            "pods": [
+                {
+                    "name": apimeta.name_of(p),
+                    "phase": p.get("status", {}).get("phase", ""),
+                    "labels": apimeta.labels_of(p),
+                }
+                for p in client.list("v1", "Pod", ns)
+            ]
+        }
+
+    # -- generic custom-resource CRUD (custom_resource.py:1-34) ---------------
+    # apiVersion is split across two path segments (group contains no "/").
+    def _cr(req: Request):
+        group, version = req.params["group"], req.params["version"]
+        return f"{group}/{version}", req.params["kind"]
+
+    @app.route("/api/namespaces/<ns>/customresources/<group>/<version>/<kind>")
+    def list_custom(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "list", ns)
+        api, kind = _cr(req)
+        try:
+            return {"items": client.list(api, kind, ns)}
+        except ApiError as e:
+            raise HttpError(400, str(e)) from None
+
+    @app.route("/api/namespaces/<ns>/customresources/<group>/<version>/<kind>/<name>")
+    def get_custom(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "get", ns)
+        api, kind = _cr(req)
+        try:
+            return client.get(api, kind, req.params["name"], ns)
+        except NotFound:
+            raise HttpError(404, f"{kind} {req.params['name']!r} not found") from None
+
+    @app.route("/api/namespaces/<ns>/customresources/<group>/<version>/<kind>", methods=("POST",))
+    def create_custom(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "create", ns)
+        api, kind = _cr(req)
+        body = req.json
+        if not isinstance(body, dict):
+            raise HttpError(400, "object body required")
+        obj = dict(body)
+        obj.setdefault("apiVersion", api)
+        obj.setdefault("kind", kind)
+        if obj["apiVersion"] != api or obj["kind"] != kind:
+            raise HttpError(400, "body apiVersion/kind must match the path")
+        obj.setdefault("metadata", {}).setdefault("namespace", ns)
+        if obj["metadata"]["namespace"] != ns:
+            raise HttpError(400, "body namespace must match the path")
+        try:
+            return {"status": "created", "object": client.create(obj)}
+        except Conflict:
+            name = obj["metadata"].get("name", "?")
+            raise HttpError(409, f"{kind} {name!r} exists") from None
+        except ApiError as e:
+            raise HttpError(400, str(e)) from None
+
+    @app.route(
+        "/api/namespaces/<ns>/customresources/<group>/<version>/<kind>/<name>",
+        methods=("DELETE",),
+    )
+    def delete_custom(req: Request):
+        ns = req.params["ns"]
+        authorizer.ensure(req.context["user"], "delete", ns)
+        api, kind = _cr(req)
+        try:
+            client.delete(api, kind, req.params["name"], ns)
+        except NotFound:
+            raise HttpError(404, f"{kind} {req.params['name']!r} not found") from None
+        return {"status": "deleted"}

@@ -1,0 +1,179 @@
+"""Shared informers: watch-backed cached listers for reconcile hot paths.
+
+VERDICT item 8: no more O(namespace) listing per reconcile — events, pods
+and statefulsets are read through a watch-fed local mirror (the reference's
+shared-informer pattern, access-management/kfam/api_default.go:71-75).
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.store import Store
+from kubeflow_tpu.platform import build_platform
+from kubeflow_tpu.runtime.informer import InformerCache, SharedInformer
+
+from test_notebook_controller import mknotebook
+
+
+def wait_for(cond, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+class TestSharedInformer:
+    def test_initial_sync_and_live_updates(self):
+        client = Client(Store())
+        client.create(new_object("v1", "Pod", "p0", "ns1", labels={"app": "a"}))
+        inf = SharedInformer(client, "v1", "Pod").start()
+        try:
+            assert inf.wait_synced()
+            assert wait_for(lambda: len(inf) == 1)
+            # Live add
+            client.create(new_object("v1", "Pod", "p1", "ns1", labels={"app": "b"}))
+            assert wait_for(lambda: len(inf) == 2)
+            # Live update
+            p0 = client.get("v1", "Pod", "p0", "ns1")
+            p0["metadata"]["labels"]["app"] = "c"
+            client.update(p0)
+            assert wait_for(lambda: (inf.get("p0", "ns1") or {}).get("metadata", {}).get("labels", {}).get("app") == "c")
+            # Live delete
+            client.delete("v1", "Pod", "p1", "ns1")
+            assert wait_for(lambda: len(inf) == 1)
+        finally:
+            inf.stop()
+
+    def test_namespace_and_label_filtering(self):
+        client = Client(Store())
+        client.create(new_object("v1", "Pod", "a", "ns1", labels={"app": "x"}))
+        client.create(new_object("v1", "Pod", "b", "ns1", labels={"app": "y"}))
+        client.create(new_object("v1", "Pod", "c", "ns2", labels={"app": "x"}))
+        inf = SharedInformer(client, "v1", "Pod").start()
+        try:
+            assert inf.wait_synced()
+            assert wait_for(lambda: len(inf) == 3)
+            assert {p["metadata"]["name"] for p in inf.list("ns1")} == {"a", "b"}
+            assert {p["metadata"]["name"] for p in inf.list(label_selector={"app": "x"})} == {"a", "c"}
+            assert [p["metadata"]["name"] for p in inf.list("ns2", {"app": "x"})] == ["c"]
+        finally:
+            inf.stop()
+
+    def test_event_handlers_fire(self):
+        client = Client(Store())
+        inf = SharedInformer(client, "v1", "Pod").start()
+        seen = []
+        inf.add_event_handler(lambda t, o: seen.append((t, o["metadata"]["name"])))
+        try:
+            assert inf.wait_synced()
+            client.create(new_object("v1", "Pod", "p0", "ns1"))
+            assert wait_for(lambda: ("ADDED", "p0") in seen)
+            client.delete("v1", "Pod", "p0", "ns1")
+            assert wait_for(lambda: ("DELETED", "p0") in seen)
+        finally:
+            inf.stop()
+
+
+class TestInformerCache:
+    def test_lazy_shared_instances(self):
+        cache = InformerCache(Client(Store()))
+        try:
+            a = cache.informer_for("v1", "Pod")
+            b = cache.informer_for("v1", "Pod")
+            assert a is b
+            assert cache.informer_for("v1", "Event") is not a
+        finally:
+            cache.stop()
+
+    def test_list_and_get_read_through(self):
+        client = Client(Store())
+        client.create(new_object("v1", "Pod", "p0", "ns1"))
+        cache = InformerCache(client)
+        try:
+            assert [p["metadata"]["name"] for p in cache.list("v1", "Pod", "ns1")] == ["p0"]
+            assert cache.get("v1", "Pod", "p0", "ns1")["metadata"]["name"] == "p0"
+            assert cache.get("v1", "Pod", "missing", "ns1") is None
+        finally:
+            cache.stop()
+
+
+class TestHotPathsUseInformer:
+    def test_reconcile_does_not_relist_events_or_statefulsets(self):
+        """The O(namespace) lists VERDICT called out must not hit the store's
+        list path during steady-state reconciles — they ride the informer."""
+        mgr = build_platform().start()
+        try:
+            # Prime: one notebook through the full path.
+            mgr.client.create(mknotebook("warm"))
+            assert mgr.wait_idle()
+
+            # Count store-level list calls per resource from here on.
+            counts = {}
+            orig_list = mgr.store.list
+
+            def counting_list(res, *a, **kw):
+                counts[res.plural] = counts.get(res.plural, 0) + 1
+                return orig_list(res, *a, **kw)
+
+            mgr.store.list = counting_list
+            try:
+                for i in range(10):
+                    mgr.client.create(mknotebook(f"nb-{i}"))
+                assert mgr.wait_idle()
+            finally:
+                mgr.store.list = orig_list
+
+            # 10 notebooks × several reconciles each: without the informer,
+            # events would be listed once per reconcile (≥30 times). The
+            # informer's own relists go through the watch path, not list().
+            assert counts.get("events", 0) == 0, counts
+            assert counts.get("statefulsets", 0) == 0, counts
+        finally:
+            mgr.stop()
+
+    def test_manager_injects_cache_and_restart_rebuilds_it(self):
+        mgr = build_platform().start()
+        try:
+            recs = [c.reconciler for c in mgr._controllers]
+            assert all(r.cache is mgr.cache for r in recs)
+            old = mgr.cache
+            mgr.stop()
+            mgr.start()
+            assert mgr.cache is not old
+            assert all(c.reconciler.cache is mgr.cache for c in mgr._controllers)
+        finally:
+            mgr.stop()
+
+    def test_event_mirroring_still_works_through_cache(self):
+        """Warning events on pods still get mirrored exactly once."""
+        mgr = build_platform().start()
+        try:
+            mgr.client.create(mknotebook("evnb"))
+            assert mgr.wait_idle()
+            pod = mgr.client.get("v1", "Pod", "evnb-0", "team-a")
+            mgr.client.emit_event(pod, "FailedMount", "volume timeout", type_="Warning")
+            # Give the informer time to see the event, then reconcile twice.
+            deadline = time.monotonic() + 5
+            mirrored = []
+            while time.monotonic() < deadline:
+                mgr.wait_idle()
+                nb = mgr.client.get("kubeflow.org/v1beta1", "Notebook", "evnb", "team-a")
+                nb["metadata"].setdefault("annotations", {})["poke"] = str(time.monotonic())
+                mgr.client.update(nb)
+                mgr.wait_idle()
+                mirrored = [
+                    e for e in mgr.client.list("v1", "Event", "team-a")
+                    if e.get("involvedObject", {}).get("kind") == "Notebook"
+                    and e.get("involvedObject", {}).get("name") == "evnb"
+                    and e.get("reason") == "FailedMount"
+                ]
+                if mirrored:
+                    break
+            assert len(mirrored) == 1, f"expected exactly one mirror, got {len(mirrored)}"
+        finally:
+            mgr.stop()

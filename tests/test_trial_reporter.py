@@ -1,0 +1,174 @@
+"""Trial metrics reporting: the production loop, end-to-end on the pod
+substrate (VERDICT item 6).
+
+suggester → Trial CR → TrialPodRunner pod (reporter contract env) →
+trial process runs the objective → HTTP PATCH of the results annotation
+through the REST apiserver → TrialPodRunner folds it into status →
+StudyJob completes with real reported metrics.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.server import make_apiserver_app
+from kubeflow_tpu.controllers.studyjob import STUDY_API, TrialPodRunner
+from kubeflow_tpu.hpo.reporter import OBJECTIVES, main as reporter_main, report, resolve_objective
+from kubeflow_tpu.platform import build_platform
+
+
+# -- objective resolution ------------------------------------------------------
+
+def test_resolve_registered_names():
+    for name in OBJECTIVES:
+        assert callable(resolve_objective(name))
+
+
+def test_resolve_module_path():
+    fn = resolve_objective("kubeflow_tpu.hpo.trials:quadratic_objective")
+    assert fn({"lr": 0.1, "width": 32})["accuracy"] == pytest.approx(1.0)
+
+
+def test_resolve_rejects_garbage():
+    with pytest.raises(ValueError):
+        resolve_objective("not-a-registered-name")
+    with pytest.raises(ValueError):
+        resolve_objective("kubeflow_tpu.hpo.trials:no_such_fn")
+
+
+# -- the pod-substrate e2e -----------------------------------------------------
+
+def pod_env(pod):
+    return {e["name"]: e.get("value", "") for e in pod["spec"]["containers"][0].get("env", [])}
+
+
+class TrialPodExecutor:
+    """The kubelet-exec stand-in: runs each Running trial pod's entrypoint
+    (the REAL reporter main, with the pod's own env) in a thread, then sets
+    the pod phase from the exit code — exactly what a container runtime
+    does with images/trial-jax-tpu's CMD."""
+
+    def __init__(self, mgr):
+        self.mgr = mgr
+        self._seen = set()
+        self._stop = threading.Event()
+        self._threads = []
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            for pod in self.mgr.client.list("v1", "Pod"):
+                uid = pod["metadata"]["uid"]
+                if uid in self._seen or "trial-name" not in pod["metadata"].get("labels", {}):
+                    continue
+                if pod.get("status", {}).get("phase") != "Running":
+                    continue
+                self._seen.add(uid)
+                t = threading.Thread(target=self._exec, args=(pod,), daemon=True)
+                t.start()
+                self._threads.append(t)
+            self._stop.wait(0.05)
+
+    def _exec(self, pod):
+        code = reporter_main(env=pod_env(pod))
+        fresh = self.mgr.client.get_opt("v1", "Pod", pod["metadata"]["name"], pod["metadata"]["namespace"])
+        if fresh is None:
+            return
+        fresh["status"] = {"phase": "Succeeded" if code == 0 else "Failed"}
+        self.mgr.client.update_status(fresh)
+
+    def stop(self):
+        self._stop.set()
+        self.thread.join(timeout=5)
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+@pytest.fixture()
+def rig():
+    mgr = build_platform().start()
+    server = make_apiserver_app(mgr.store).serve(0)
+    url = f"http://127.0.0.1:{server.port}"
+    # Point trial pods at the live REST server.
+    for c in mgr._controllers:
+        if isinstance(c.reconciler, TrialPodRunner):
+            c.reconciler.apiserver_url = url
+    execu = TrialPodExecutor(mgr)
+    yield mgr, url
+    execu.stop()
+    mgr.stop()
+    server.close()
+
+
+def test_report_patches_results_annotation(rig):
+    mgr, url = rig
+    mgr.client.create(new_object(STUDY_API, "Trial", "t0", "team-a",
+                                 spec={"parameters": {"lr": 0.1}}))
+    report({"accuracy": 0.93}, "t0", "team-a", url=url)
+    trial = mgr.client.get(STUDY_API, "Trial", "t0", "team-a")
+    assert json.loads(trial["metadata"]["annotations"]["results"]) == {"accuracy": 0.93}
+
+
+def test_pod_substrate_studyjob_completes_with_real_metrics(rig):
+    mgr, url = rig
+    study = new_object(
+        STUDY_API, "StudyJob", "pod-study", "team-a",
+        spec={
+            "algorithm": {"algorithmName": "grid"},
+            "maxTrialCount": 4,
+            "parallelTrialCount": 2,
+            "objective": {"type": "maximize", "objectiveMetricName": "accuracy"},
+            "parameters": [
+                {"name": "lr", "parameterType": "double",
+                 "feasibleSpace": {"min": "0.01", "max": "0.1"}},
+            ],
+            "trialTemplate": {"objective": "quadratic"},
+        },
+    )
+    mgr.client.create(study)
+
+    deadline = time.time() + 60
+    status = {}
+    while time.time() < deadline:
+        got = mgr.client.get(STUDY_API, "StudyJob", "pod-study", "team-a")
+        status = got.get("status") or {}
+        if status.get("phase") == "Completed":
+            break
+        time.sleep(0.1)
+    assert status.get("phase") == "Completed", status
+    assert status.get("trialsSucceeded", 0) >= 4
+    best = status.get("currentOptimalTrial") or {}
+    # Real quadratic_objective numbers, reported over HTTP — max at lr=0.1.
+    assert best.get("observation", {}).get("accuracy", 0) > 0
+    assert float(best.get("parameterAssignments", {}).get("lr", 0)) == pytest.approx(0.1)
+
+    # Trials carry real metrics in status, sourced from the annotation PATCH.
+    trials = [t for t in mgr.client.list(STUDY_API, "Trial", "team-a")
+              if t["metadata"].get("labels", {}).get("studyjob-name") == "pod-study"
+              or "pod-study" in t["metadata"]["name"]]
+    assert len(trials) >= 4
+    for t in trials:
+        assert t["status"]["phase"] == "Succeeded"
+        assert "accuracy" in t["status"]["metrics"]
+        assert t["metadata"]["annotations"]["results"]
+
+
+def test_failed_objective_marks_trial_failed(rig):
+    mgr, url = rig
+    mgr.client.create(new_object(
+        STUDY_API, "Trial", "bad-trial", "team-a",
+        labels={"studyjob-name": "none"},
+        spec={"parameters": {"lr": 1.0},
+              "template": {"objective": "kubeflow_tpu.hpo.trials:no_such"}},
+    ))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        t = mgr.client.get(STUDY_API, "Trial", "bad-trial", "team-a")
+        if (t.get("status") or {}).get("phase") == "Failed":
+            break
+        time.sleep(0.1)
+    assert t["status"]["phase"] == "Failed"

@@ -1,0 +1,186 @@
+"""Secondary CRUD resources, probe split, and generated API contracts.
+
+Reference surfaces being matched:
+- crud_backend/api/{secret,storageclass,node,pod,custom_resource}.py
+- crud_backend/probes.py:7-16 (/healthz/liveness, /healthz/readiness)
+- access-management/api/swagger.yaml (machine-readable contract)
+"""
+
+import pytest
+import yaml
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.store import Store
+from kubeflow_tpu.services.jupyter import make_jupyter_app
+from kubeflow_tpu.services.kfam import make_kfam_app
+from kubeflow_tpu.services.volumes import make_volumes_app
+from kubeflow_tpu.web.auth import AuthConfig, Authorizer, install_auth
+from kubeflow_tpu.web.http import App
+
+ADMIN = "admin@kubeflow.org"
+AUTH = AuthConfig(disable_auth=False, cluster_admins=[ADMIN])
+HDRS = {"kubeflow-userid": ADMIN}
+
+
+@pytest.fixture()
+def client():
+    c = Client(Store())
+    c.create(new_object("v1", "Namespace", "team-a"))
+    c.create(new_object(
+        "storage.k8s.io/v1", "StorageClass", "fast-ssd",
+        annotations={"storageclass.kubernetes.io/is-default-class": "true"},
+        provisioner="pd.csi.storage.gke.io",
+    ))
+    c.create(new_object("storage.k8s.io/v1", "StorageClass", "standard",
+                        provisioner="pd.csi.storage.gke.io"))
+    c.create(new_object(
+        "v1", "Node", "tpu-node-0",
+        labels={"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"},
+        status={"capacity": {"google.com/tpu": "4", "cpu": "96"},
+                "allocatable": {"google.com/tpu": "4"}},
+    ))
+    c.create(new_object("v1", "Secret", "gcp-sa", "team-a",
+                        type="Opaque", data={"key.json": "e30="}))
+    c.create(new_object("v1", "Pod", "worker-0", "team-a",
+                        labels={"app": "x"}, status={"phase": "Running"}))
+    return c
+
+
+@pytest.fixture()
+def app(client):
+    return make_volumes_app(client, AUTH)
+
+
+class TestSecondaryResources:
+    def test_storageclasses(self, app):
+        r = app.call("GET", "/api/storageclasses", headers=HDRS)
+        assert r.status == 200
+        classes = {sc["name"]: sc for sc in r.body["storageClasses"]}
+        assert classes["fast-ssd"]["isDefault"] is True
+        assert classes["standard"]["isDefault"] is False
+        assert classes["standard"]["provisioner"] == "pd.csi.storage.gke.io"
+
+    def test_nodes_expose_tpu_capacity(self, app):
+        r = app.call("GET", "/api/nodes", headers=HDRS)
+        node = r.body["nodes"][0]
+        assert node["capacity"]["google.com/tpu"] == "4"
+        assert node["labels"]["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+
+    def test_secrets_list_names_not_values(self, app):
+        r = app.call("GET", "/api/namespaces/team-a/secrets", headers=HDRS)
+        assert r.body["secrets"] == [{"name": "gcp-sa", "type": "Opaque", "keys": ["key.json"]}]
+        assert "e30=" not in str(r.body)
+
+    def test_pods(self, app):
+        r = app.call("GET", "/api/namespaces/team-a/pods", headers=HDRS)
+        assert r.body["pods"][0]["name"] == "worker-0"
+        assert r.body["pods"][0]["phase"] == "Running"
+
+    def test_namespaced_reads_require_authz(self, client):
+        app = make_volumes_app(client, AUTH)
+        stranger = {"kubeflow-userid": "stranger@example.com"}
+        assert app.call("GET", "/api/namespaces/team-a/secrets", headers=stranger).status == 403
+        assert app.call("GET", "/api/namespaces/team-a/pods", headers=stranger).status == 403
+        # Cluster-scoped reads allowed for any authenticated user.
+        assert app.call("GET", "/api/storageclasses", headers=stranger).status == 200
+
+
+class TestGenericCustomResources:
+    BASE = "/api/namespaces/team-a/customresources/kubeflow.org/v1beta1/Notebook"
+
+    def csrf(self, app):
+        r = app.call("GET", "/api/config", headers=HDRS)
+        token = [c for c in r.cookies if c.startswith("XSRF-TOKEN=")][0].split(";")[0].split("=", 1)[1]
+        return {**HDRS, "cookie": f"XSRF-TOKEN={token}", "x-xsrf-token": token}
+
+    def test_cr_crud_roundtrip(self, app):
+        hdrs = self.csrf(app)
+        body = {"metadata": {"name": "nb1"}, "spec": {"template": {"spec": {"containers": [{}]}}}}
+        r = app.call("POST", self.BASE, body=body, headers=hdrs)
+        assert r.status == 200, r.body
+        assert r.body["object"]["apiVersion"] == "kubeflow.org/v1beta1"
+
+        r = app.call("GET", self.BASE, headers=HDRS)
+        assert [o["metadata"]["name"] for o in r.body["items"]] == ["nb1"]
+
+        r = app.call("GET", f"{self.BASE}/nb1", headers=HDRS)
+        assert r.body["kind"] == "Notebook"
+
+        assert app.call("POST", self.BASE, body=body, headers=hdrs).status == 409
+        assert app.call("DELETE", f"{self.BASE}/nb1", headers=hdrs).status == 200
+        assert app.call("GET", f"{self.BASE}/nb1", headers=HDRS).status == 404
+
+    def test_cr_body_path_mismatch_rejected(self, app):
+        hdrs = self.csrf(app)
+        r = app.call("POST", self.BASE,
+                     body={"kind": "Tensorboard", "metadata": {"name": "x"}}, headers=hdrs)
+        assert r.status == 400
+        r = app.call("POST", self.BASE,
+                     body={"metadata": {"name": "x", "namespace": "other"}}, headers=hdrs)
+        assert r.status == 400
+
+
+class TestProbeSplit:
+    def test_liveness_and_bare_healthz_always_ok(self, app):
+        # No identity header: probes must bypass authn.
+        assert app.call("GET", "/healthz").status == 200
+        assert app.call("GET", "/healthz/liveness").status == 200
+
+    def test_readiness_reflects_backend_health(self):
+        calls = {"fail": False}
+
+        def check():
+            if calls["fail"]:
+                raise RuntimeError("store down")
+
+        app = App("probe-test")
+        authorizer = Authorizer(Client(Store()), AUTH)
+        install_auth(app, authorizer, readiness_check=check)
+        assert app.call("GET", "/healthz/readiness").status == 200
+        calls["fail"] = True
+        r = app.call("GET", "/healthz/readiness")
+        assert r.status == 503
+        assert r.body["reason"] == "store down"
+
+    def test_default_readiness_does_store_roundtrip(self, app):
+        assert app.call("GET", "/healthz/readiness").status == 200
+
+
+class TestApiDocs:
+    def test_volumes_swagger_document(self, app):
+        r = app.call("GET", "/apidocs", headers=HDRS)
+        assert r.status == 200
+        doc = r.body
+        assert doc["swagger"] == "2.0"
+        assert doc["info"]["title"] == "volumes-web-app"
+        # Primary + secondary resources present, path params templated.
+        assert "/api/namespaces/{ns}/pvcs" in doc["paths"]
+        assert "/api/storageclasses" in doc["paths"]
+        post = doc["paths"]["/api/namespaces/{ns}/pvcs"]["post"]
+        assert {"name": "ns", "in": "path", "required": True, "type": "string"} in post["parameters"]
+        assert any(p["in"] == "body" for p in post["parameters"])
+        # The contract excludes itself.
+        assert "/apidocs" not in doc["paths"]
+
+    def test_yaml_variant_parses(self, app):
+        r = app.call("GET", "/apidocs.yaml", headers=HDRS)
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/yaml"
+        doc = yaml.safe_load(r.encode())
+        assert doc["swagger"] == "2.0"
+
+    def test_kfam_contract_base_path(self, client):
+        kfam = make_kfam_app(client, AUTH)
+        doc = kfam.call("GET", "/apidocs", headers=HDRS).body
+        # Reference swagger.yaml: basePath /kfam, bindings + profiles routes.
+        assert doc["basePath"] == "/kfam"
+        assert "/kfam/v1/bindings" in doc["paths"]
+        assert set(doc["paths"]["/kfam/v1/bindings"]) == {"get", "post", "delete"}
+        assert "/kfam/v1/profiles" in doc["paths"]
+
+    def test_jupyter_contract_covers_spawn_surface(self, client):
+        app = make_jupyter_app(client, auth=AUTH)
+        doc = app.call("GET", "/apidocs", headers=HDRS).body
+        for path in ("/api/config", "/api/tpus", "/api/namespaces/{ns}/notebooks"):
+            assert path in doc["paths"], path

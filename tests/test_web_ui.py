@@ -59,7 +59,7 @@ class TestSpaContract:
         route table (catches UI/backend drift without a browser)."""
         for name, app in apps().items():
             html = app.call("GET", "/", headers=HDRS).body
-            registered = [rx for method, rx, fn in app._routes]
+            registered = [rx for method, pattern, rx, fn in app._routes]
             for path in set(re.findall(r'"(/(?:api|kfam)/[^"$]*?)"', html)):
                 # template literals (`/api/namespaces/${NS}/...`) are matched
                 # separately below; plain strings here
