@@ -303,7 +303,9 @@ def _watch_stream(
 
         while True:
             try:
-                item = watcher.queue.get(timeout=15.0)
+                # next_event, never .queue: preloaded initial-list/RV-replay
+                # events must reach remote clients too (round-2 regression).
+                item = watcher.next_event(timeout=15.0)
             except _queue.Empty:
                 yield json.dumps({"type": "BOOKMARK", "object": {}}).encode() + b"\n"
                 continue

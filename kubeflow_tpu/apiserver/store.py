@@ -107,19 +107,35 @@ class _Watcher:
         self.key = key
         self.namespace = namespace
         self.selector = selector
-        # Sized for a 1k-notebook churn wave (~2k pods × several writes
-        # each): overflow closes the watcher and forces a full relist, so
-        # drops must be rare, not routine.
+        # LIVE events only. Sized for a 1k-notebook churn wave (~2k pods ×
+        # several writes each): overflow closes the watcher and forces a
+        # full relist, so drops must be rare, not routine.
         self.queue: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=16384)
         # Initial-list / journal-replay events: unbounded, drained before the
         # live queue. These MUST NOT count against the slow-watcher drop
         # policy — a collection larger than the queue bound would otherwise
         # close every watcher mid-relist and informers could never sync.
+        # Contract: replay/initial delivery is COMPLETE (etcd streams the
+        # whole watch window; a K8s initial list is never truncated); only
+        # live events are subject to the bounded-queue drop-close policy.
+        # Consumers must read through next_event()/iteration, never
+        # self.queue directly, or preloaded events are silently skipped.
         self._preload: "collections.deque[WatchEvent]" = collections.deque()
         self.closed = False
 
     def preload(self, event: WatchEvent) -> None:
         self._preload.append(event)
+
+    def next_event(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        """Next event: preloaded (initial list / journal replay) first, then
+        live. Returns None at end-of-stream; raises queue.Empty on timeout.
+        This is the ONLY correct read path — the REST streaming handler and
+        __iter__ both go through it (round-2 regression: reading .queue
+        directly skipped every preloaded event, so remote informers synced
+        empty caches and RV-resume watches hung)."""
+        if self._preload:
+            return self._preload.popleft()
+        return self.queue.get(timeout=timeout)
 
     def matches(self, res_key: str, obj: Dict[str, Any]) -> bool:
         if not fnmatch.fnmatch(res_key, self.key):
@@ -158,10 +174,8 @@ class _Watcher:
                     pass
 
     def __iter__(self):
-        while self._preload:
-            yield self._preload.popleft()
         while True:
-            item = self.queue.get()
+            item = self.next_event()
             if item is None:
                 return
             yield item

@@ -166,6 +166,32 @@ class TestRestWatch:
         assert (first.type, first.object["metadata"]["name"]) == ("ADDED", "r2")
         watcher.close()
 
+    def test_remote_informer_syncs_prepopulated_store(self, rest):
+        """A remote informer that starts AFTER objects exist must see them:
+        the round-2 regression dropped every preloaded (sendInitial) event
+        on the REST path, so remote caches synced empty and believed it."""
+        from kubeflow_tpu.runtime.informer import SharedInformer
+
+        store, remote, base = rest
+        remote.create(mkpod("pre1", labels={"app": "x"}))
+        remote.create(mkpod("pre2"))
+        inf = SharedInformer(Client(remote), "v1", "Pod").start()
+        try:
+            assert inf.wait_synced(timeout=10)
+            deadline = time.time() + 10
+            while time.time() < deadline and len(inf) < 2:
+                time.sleep(0.05)
+            names = {o["metadata"]["name"] for o in inf.list()}
+            assert names == {"pre1", "pre2"}, names
+            # and live events still flow on the same stream
+            remote.create(mkpod("post1"))
+            deadline = time.time() + 10
+            while time.time() < deadline and len(inf) < 3:
+                time.sleep(0.05)
+            assert {o["metadata"]["name"] for o in inf.list()} == {"pre1", "pre2", "post1"}
+        finally:
+            inf.stop()
+
 
 class TestRemoteControllerLoop:
     def test_notebook_reconciles_across_the_rest_boundary(self, rest):

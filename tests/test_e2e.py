@@ -34,7 +34,11 @@ class TestLoadtest:
     def test_loadtest_probe(self):
         from e2e.loadtest import run_loadtest
 
-        result = run_loadtest(n=10, timeout=60.0)
+        # Generous timeout: this is a functional probe (do 10 notebooks all
+        # reach Running), not a perf gate — under a full serial suite run the
+        # process carries every prior test's daemon threads and JAX state, and
+        # 60s has flaked. Perf numbers come from e2e/loadtest.py standalone.
+        result = run_loadtest(n=10, timeout=240.0)
         assert result["notebooks"] == 10
         assert result["all_running_seconds"] > 0
         assert result["reconciles_total"] > 0

@@ -7,6 +7,7 @@ the fallback lacks.
 """
 
 import json
+import queue
 import threading
 
 import pytest
@@ -194,17 +195,37 @@ class TestParityEdges:
         assert [r.name for r in only_b1] == ["x", "z"]
         assert len(b.journal_since(0)) == 3  # unfiltered sees everything
 
-    def test_watch_resume_overflow_still_terminates(self, native_store):
-        """Replaying more history than the watcher queue holds must close the
-        stream WITH its end sentinel — the consumer loop terminates and
-        relists, never hangs."""
+    def test_watch_resume_replay_is_complete(self, native_store):
+        """RV-replay larger than the LIVE queue bound is delivered in full:
+        preloaded history is unbounded by contract (etcd streams the whole
+        watch window) and never trips the slow-watcher drop-close policy.
+        A replay that silently truncated would leave informers with gaps
+        they can never detect."""
         s = native_store
-        for i in range(4200):  # queue maxsize is 4096
+        from kubeflow_tpu.apiserver.store import _Watcher
+
+        # Derived, not hard-coded: must exceed the live-queue bound or a
+        # regression that routed replay through the bounded queue would
+        # still pass this test.
+        n = _Watcher("*", None, None).queue.maxsize + 50
+        for i in range(n):
             s.create(mkpod(f"ov{i}"))
         w = s.watch(PODS, since_rv=0)
-        drained = sum(1 for _ in w)  # must terminate
-        assert w.closed
-        assert drained <= 4096
+        drained = 0
+        while True:
+            try:
+                ev = w.next_event(timeout=0.2)
+            except queue.Empty:
+                break  # replay exhausted; stream stays open for live events
+            assert ev is not None and ev.type == "ADDED"
+            drained += 1
+        assert drained == n
+        assert not w.closed  # complete replay must not drop-close the watcher
+        # Live events still flow after the replay.
+        s.create(mkpod("after-replay"))
+        ev = w.next_event(timeout=2)
+        assert ev.object["metadata"]["name"] == "after-replay"
+        w.close()
 
 
 class TestNativeBackendDirect:

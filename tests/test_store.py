@@ -126,7 +126,7 @@ def test_list_with_rv_supports_gapless_list_then_watch(store):
     store.create(mkpod("b"))
     if getattr(store.backend, "journal_capable", False):
         w = store.watch(PODS, since_rv=rv)
-        ev = w.queue.get(timeout=2)
+        ev = w.next_event(timeout=2)
         assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "b"
         w.close()
 
@@ -145,7 +145,7 @@ def test_watch_receives_lifecycle_events(store):
     obj["spec"]["containers"] = [{"name": "c"}]
     store.update(obj)
     store.delete(PODS, "a", "default")
-    events = [w.queue.get(timeout=1) for _ in range(3)]
+    events = [w.next_event(timeout=1) for _ in range(3)]
     assert [e.type for e in events] == ["ADDED", "MODIFIED", "DELETED"]
     w.close()
 
@@ -153,7 +153,7 @@ def test_watch_receives_lifecycle_events(store):
 def test_watch_send_initial(store):
     store.create(mkpod("pre"))
     w = store.watch(PODS, send_initial=True)
-    ev = w.queue.get(timeout=1)
+    ev = w.next_event(timeout=1)
     assert ev.type == "ADDED" and ev.object["metadata"]["name"] == "pre"
     w.close()
 
