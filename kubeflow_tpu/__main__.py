@@ -36,11 +36,18 @@ def main() -> None:
     # writers are covered by it (no second sweep needed here).
     servers = [("apiserver", make_apiserver_app(store).serve(int(os.environ.get("API_PORT", "8001"))))]
 
-    kfam_app = make_kfam_app(client, auth)
+    # ONE InformerCache for every co-hosted app: kfam, dashboard, and
+    # jupyter all mirror overlapping kinds (Namespace, Node, Event) — a
+    # private cache each would mean duplicate watch streams and duplicate
+    # O(cluster) mirrors in the same process.
+    from .runtime.informer import InformerCache
+
+    shared_cache = InformerCache(client)
+    kfam_app = make_kfam_app(client, auth, cache=shared_cache)
     for name, app, port_env, default in [
         ("kfam", kfam_app, "KFAM_PORT", 8081),
-        ("dashboard", make_dashboard_app(client, kfam_app, auth), "DASHBOARD_PORT", 8082),
-        ("jupyter", make_jupyter_app(client, auth=auth), "JUPYTER_PORT", 5001),
+        ("dashboard", make_dashboard_app(client, kfam_app, auth, cache=shared_cache), "DASHBOARD_PORT", 8082),
+        ("jupyter", make_jupyter_app(client, auth=auth, cache=shared_cache), "JUPYTER_PORT", 5001),
         ("tensorboards", make_tensorboards_app(client, auth), "TENSORBOARDS_PORT", 5002),
         ("volumes", make_volumes_app(client, auth), "VOLUMES_PORT", 5003),
     ]:

@@ -191,12 +191,15 @@ class RemoteStore:
         label_selector: Optional[Dict[str, str]] = None,
         send_initial: bool = False,
         since_rv: Optional[int] = None,
+        sync_marker: bool = False,
     ) -> RemoteWatch:
         if res is None:
             raise Invalid("remote watch requires a resource (no cross-kind wildcard on the wire)")
         params = ["watch=true"]
         if send_initial:
             params.append("sendInitial=true")
+        if sync_marker:
+            params.append("syncMarker=true")
         if since_rv is not None:
             params.append(f"resourceVersion={since_rv}")
         if label_selector:

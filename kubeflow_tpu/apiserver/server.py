@@ -283,6 +283,7 @@ def _watch_stream(
         except ValueError:
             raise HttpError(400, f"invalid resourceVersion {rv_param!r}") from None
     send_initial = req.query1("sendInitial") in ("true", "1")
+    sync_marker = req.query1("syncMarker") in ("true", "1")
     try:
         watcher = store.watch(
             hub_resource(res),
@@ -290,6 +291,7 @@ def _watch_stream(
             label_selector=selector,
             send_initial=send_initial,
             since_rv=since_rv,
+            sync_marker=sync_marker,
         )
     except ApiError as e:
         return JsonResponse(e.to_status(), status=e.code)
@@ -311,6 +313,9 @@ def _watch_stream(
                 continue
             if item is None:
                 return
+            if item.type == "SYNC":  # protocol marker, not an object — no conversion
+                yield json.dumps({"type": "SYNC", "object": item.object}).encode() + b"\n"
+                continue
             obj = convert(item.object, res.group, res.kind, res.version)
             yield json.dumps({"type": item.type, "object": obj}).encode() + b"\n"
 

@@ -378,7 +378,12 @@ class Store:
             self._notify(res, WatchEvent("MODIFIED", obj))
             # Finalizer removal on a deleting object completes the delete.
             if md.get("deletionTimestamp") and not md.get("finalizers"):
-                self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
+                drv = self.backend.next_rv()
+                # DELETED events carry the deletion RV (etcd tombstone mod
+                # revision) so watch consumers can order them against the
+                # global RV stream — informer read-your-writes depends on it.
+                md["resourceVersion"] = str(drv)
+                self.backend.delete(res.key, ns, name, obj, drv)
                 self._gc_untrack(obj)
                 self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
@@ -417,7 +422,9 @@ class Store:
                     self.backend.put(res.key, ns, name, obj, rv, "MODIFIED")
                     self._notify(res, WatchEvent("MODIFIED", obj))
                 return apimeta.deepcopy(obj)
-            self.backend.delete(res.key, ns, name, obj, self.backend.next_rv())
+            drv = self.backend.next_rv()
+            md["resourceVersion"] = str(drv)  # tombstone RV, see update()
+            self.backend.delete(res.key, ns, name, obj, drv)
             self._gc_untrack(obj)
             self._notify(res, WatchEvent("DELETED", obj))
             return apimeta.deepcopy(obj)
@@ -442,11 +449,19 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
         send_initial: bool = False,
         since_rv: Optional[int] = None,
+        sync_marker: bool = False,
     ) -> _Watcher:
         """Open a watch stream. ``since_rv`` replays history from the write
         journal (native backend only) before going live — etcd watch-window
         semantics; raises Expired (410) when the window has been trimmed, in
-        which case the caller relists (informer resync)."""
+        which case the caller relists (informer resync).
+
+        ``sync_marker`` appends a ``SYNC`` event (empty object) after the
+        initial-list/replay burst and before any live event. Informers use
+        the marker as the relist boundary: everything cached that was NOT
+        re-sent before SYNC vanished while disconnected, so synthetic
+        DELETED events can fire (client-go emits deletes on relist for the
+        same reason — handler-maintained state must not go stale)."""
         if res is not None:
             res = conversion.hub_resource(res)
         key = res.key if res else "*"
@@ -469,6 +484,13 @@ class Store:
             elif send_initial and res is not None:
                 for obj in self.list(res, namespace=namespace, label_selector=label_selector):
                     w.preload(WatchEvent("ADDED", obj))
+            if sync_marker:
+                # The marker carries the store RV at the snapshot: informers
+                # use it to jump their seen-RV to "current" on (re)connect,
+                # making min-RV read barriers resolve immediately after sync.
+                w.preload(
+                    WatchEvent("SYNC", {"resourceVersion": str(self.backend.current_rv())})
+                )
             self._watchers.append(w)
         return w
 

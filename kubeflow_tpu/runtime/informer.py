@@ -59,6 +59,11 @@ class SharedInformer:
         self._index_fns: Dict[str, Callable[[Dict[str, Any]], List[str]]] = {}
         self._indexes: Dict[str, Dict[str, Dict[Tuple[Optional[str], str], Dict[str, Any]]]] = {}
         self._item_keys: Dict[Tuple[Optional[str], str], Dict[str, List[str]]] = {}
+        # Highest store resourceVersion this mirror reflects: bumped by every
+        # event's object RV and jumped to the snapshot RV at each SYNC
+        # marker. wait_rv() is the read-your-writes barrier built on it.
+        self._rv_cond = threading.Condition()
+        self._last_rv = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SharedInformer":
@@ -87,6 +92,25 @@ class SharedInformer:
 
     def wait_synced(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
+
+    def wait_rv(self, rv: int, timeout: float = 10.0) -> bool:
+        """Block until the mirror reflects store resourceVersion >= rv — a
+        read-your-writes barrier (K8s resourceVersionMatch=NotOlderThan).
+        Only meaningful for an rv produced by a write to THIS kind (or any
+        rv ≤ a sync snapshot): the informer never observes other kinds'
+        RVs, so a foreign rv may only resolve at the next reconnect."""
+        with self._rv_cond:
+            return self._rv_cond.wait_for(lambda: self._last_rv >= rv, timeout)
+
+    def _note_rv(self, rv_str: Any) -> None:
+        try:
+            rv = int(rv_str)
+        except (TypeError, ValueError):
+            return
+        with self._rv_cond:
+            if rv > self._last_rv:
+                self._last_rv = rv
+                self._rv_cond.notify_all()
 
     @property
     def has_synced(self) -> bool:
@@ -165,35 +189,57 @@ class SharedInformer:
     def _pump(self) -> None:
         while not self._stopped.is_set():
             try:
-                watcher = self.client.watch(self.api_version, self.kind, send_initial=True)
+                watcher = self.client.watch(
+                    self.api_version, self.kind, send_initial=True, sync_marker=True
+                )
             except Exception as e:
                 log.warning("informer %s: watch connect failed: %s", self.kind, e)
                 self._stopped.wait(1.0)
                 continue
             with self._lock:
                 self._watcher = watcher
-                # Relist semantics: the initial ADDED burst replaces the
-                # mirror; drop entries deleted while we were disconnected.
-                self._items.clear()
-                self._item_keys.clear()
-                for name in self._indexes:
-                    self._indexes[name] = {}
-            self._synced.set()
+            # Relist semantics: the initial ADDED burst overlays the old
+            # mirror (no empty-cache window); at the SYNC boundary, every
+            # cached key NOT re-sent vanished while we were disconnected —
+            # fire synthetic DELETED so handler-maintained state (gauge
+            # indexes etc.) can't go stale. client-go emits deletes on
+            # relist for exactly this reason.
+            seen: set = set()
+            syncing = True
             try:
                 for event in watcher:
+                    if event.type == "SYNC":
+                        syncing = False
+                        with self._lock:
+                            vanished = [
+                                (k, self._items[k]) for k in list(self._items) if k not in seen
+                            ]
+                            for key, old in vanished:
+                                self._apply("DELETED", key, old)
+                        self._note_rv((event.object or {}).get("resourceVersion"))
+                        self._synced.set()
+                        for _key, old in vanished:
+                            self._dispatch("DELETED", old)
+                        continue
                     obj = event.object
                     key = (apimeta.namespace_of(obj), apimeta.name_of(obj))
+                    if syncing:
+                        seen.add(key)
                     with self._lock:
                         self._apply(event.type, key, obj)
-                    for fn in self._handlers:
-                        try:
-                            fn(event.type, obj)
-                        except Exception:
-                            log.exception("informer %s: handler failed", self.kind)
+                    self._note_rv(obj.get("metadata", {}).get("resourceVersion"))
+                    self._dispatch(event.type, obj)
             except Exception as e:
                 log.warning("informer %s: watch stream error: %s", self.kind, e)
             if not self._stopped.is_set():
                 self._stopped.wait(0.2)
+
+    def _dispatch(self, event_type: str, obj: Dict[str, Any]) -> None:
+        for fn in self._handlers:
+            try:
+                fn(event_type, obj)
+            except Exception:
+                log.exception("informer %s: handler failed", self.kind)
 
 
 class InformerCache:
@@ -222,11 +268,18 @@ class InformerCache:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         sync_timeout: float = 10.0,
+        min_rv: Optional[int] = None,
     ) -> List[Dict[str, Any]]:
+        """``min_rv`` is a read-your-writes barrier: wait until the mirror
+        reflects that store RV (pass the RV returned by your own write to
+        the same kind). On barrier/sync timeout, degrade to a direct list —
+        a live read is always fresh enough."""
         inf = self.informer_for(api_version, kind)
-        if not inf.wait_synced(sync_timeout):
-            # Degrade to a direct list rather than serving an empty cache.
-            log.warning("informer %s/%s: sync timeout; direct list", api_version, kind)
+        if not inf.wait_synced(sync_timeout) or (
+            min_rv is not None and not inf.wait_rv(min_rv, sync_timeout)
+        ):
+            # Degrade to a direct list rather than serving a stale/empty cache.
+            log.warning("informer %s/%s: sync/rv timeout; direct list", api_version, kind)
             return self.client.list(api_version, kind, namespace, label_selector=label_selector)
         return inf.list(namespace, label_selector)
 

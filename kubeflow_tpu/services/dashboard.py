@@ -20,7 +20,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..controllers.profile import PROFILE_API
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
-from ..web.openapi import install_apidocs
+from ..web.openapi import annotate, install_apidocs
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth
 from ..web.http import App, HttpError, JsonResponse, Request
@@ -82,10 +82,11 @@ def make_dashboard_app(
     client: Client,
     kfam_app: Optional[App] = None,
     auth: Optional[AuthConfig] = None,
+    cache: Optional["InformerCache"] = None,
 ) -> App:
     cfg = auth or AuthConfig()
     authorizer = Authorizer(client, cfg)
-    metrics = TpuMetricsService(client)
+    metrics = TpuMetricsService(client, cache=cache)
     app = App("centraldashboard")
     install_auth(app, authorizer, enable_csrf=False)
 
@@ -103,12 +104,12 @@ def make_dashboard_app(
     # -- cluster views -------------------------------------------------------
     @app.route("/api/namespaces")
     def namespaces(req: Request):
-        return [apimeta.name_of(n) for n in client.list("v1", "Namespace")]
+        return [apimeta.name_of(n) for n in metrics.cache.list("v1", "Namespace")]
 
     @app.route("/api/activities/<ns>")
     def activities(req: Request):
         authorizer.ensure(user(req), "list", req.params["ns"])
-        events = client.list("v1", "Event", req.params["ns"])
+        events = metrics.cache.list("v1", "Event", req.params["ns"])
         return sorted(events, key=lambda e: e.get("lastTimestamp", ""), reverse=True)[:50]
 
     @app.route("/api/metrics/<kind>")
@@ -145,7 +146,7 @@ def make_dashboard_app(
     @app.route("/api/platform-info")
     def platform_info(req: Request):
         provider = "other"
-        for node in client.list("v1", "Node"):
+        for node in metrics.cache.list("v1", "Node"):
             pid = node.get("spec", {}).get("providerID", "")
             if pid.startswith("gce://"):
                 provider = "gce"
@@ -157,11 +158,12 @@ def make_dashboard_app(
 
     # -- workgroup / registration flow --------------------------------------
     @app.route("/api/workgroup/exists")
+    @annotate(response="WorkgroupExists")
     def exists(req: Request):
         u = user(req)
         owned = [
             apimeta.name_of(p)
-            for p in client.list(PROFILE_API, "Profile")
+            for p in metrics.cache.list(PROFILE_API, "Profile")
             if p.get("spec", {}).get("owner", {}).get("name") == u
         ]
         return {"hasWorkgroup": bool(owned), "user": u, "namespaces": owned,
@@ -175,9 +177,10 @@ def make_dashboard_app(
         return {"message": f"profile {name} created"}
 
     @app.route("/api/workgroup/env-info")
+    @annotate(response="EnvInfo")
     def env_info(req: Request):
         u = user(req)
-        profiles = client.list(PROFILE_API, "Profile")
+        profiles = metrics.cache.list(PROFILE_API, "Profile")
         namespaces = []
         for p in profiles:
             ns = apimeta.name_of(p)
@@ -200,6 +203,8 @@ def make_dashboard_app(
     def nuke_self(req: Request):
         u = user(req)
         nuked = []
+        # Deliberately a live list, not the informer: a destructive flow must
+        # not act on a stale mirror (miss = orphaned profile).
         for p in client.list(PROFILE_API, "Profile"):
             if p.get("spec", {}).get("owner", {}).get("name") == u:
                 kfam(req, "DELETE", f"/kfam/v1/profiles/{apimeta.name_of(p)}")
@@ -211,24 +216,31 @@ def make_dashboard_app(
         if not authorizer.is_cluster_admin(user(req)):
             raise HttpError(403, "cluster admin only")
         out = []
-        for p in client.list(PROFILE_API, "Profile"):
+        for p in metrics.cache.list(PROFILE_API, "Profile"):
             ns = apimeta.name_of(p)
             resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={ns}")
             contributors = [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]
             out.append([ns, contributors])
         return out
 
+    def _contributors(req: Request, min_rv=None) -> List[str]:
+        # contributor ↔ edit role (api_workgroup.ts:40-48); the owner's admin
+        # binding is not a contributor. min_rv = read-your-writes barrier
+        # after a mutation (KFAM's informer waits for the write's RV).
+        url = f"/kfam/v1/bindings?namespace={req.params['ns']}&role=edit"
+        if min_rv:
+            url += f"&minResourceVersion={min_rv}"
+        resp = kfam(req, "GET", url)
+        return [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]
+
     @app.route("/api/workgroup/get-contributors/<ns>")
     def contributors(req: Request):
-        # contributor ↔ edit role (api_workgroup.ts:40-48); the owner's admin
-        # binding is not a contributor.
-        resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={req.params['ns']}&role=edit")
-        return [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]
+        return _contributors(req)
 
     @app.route("/api/workgroup/add-contributor/<ns>", methods=("POST",))
     def add_contributor(req: Request):
         body = req.json or {}
-        kfam(
+        resp = kfam(
             req,
             "POST",
             "/kfam/v1/bindings",
@@ -238,12 +250,15 @@ def make_dashboard_app(
                 "roleRef": {"kind": "ClusterRole", "name": "edit"},
             },
         )
-        return contributors(req)
+        rv = (((resp.body or {}).get("binding") or {}).get("metadata") or {}).get(
+            "resourceVersion"
+        )
+        return _contributors(req, min_rv=rv)
 
     @app.route("/api/workgroup/remove-contributor/<ns>", methods=("DELETE",))
     def remove_contributor(req: Request):
         body = req.json or {}
-        kfam(
+        resp = kfam(
             req,
             "DELETE",
             "/kfam/v1/bindings",
@@ -253,7 +268,8 @@ def make_dashboard_app(
                 "roleRef": {"kind": "ClusterRole", "name": "edit"},
             },
         )
-        return contributors(req)
+        rv = (resp.body or {}).get("resourceVersion")
+        return _contributors(req, min_rv=rv)
 
     install_apidocs(app)
     install_spa(app, load_ui("dashboard.html"), cfg)

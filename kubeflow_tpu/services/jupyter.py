@@ -33,7 +33,7 @@ from ..tpu.topology import (
     NODE_LABEL_TOPOLOGY,
     RESOURCE_TPU,
 )
-from ..web.openapi import install_apidocs
+from ..web.openapi import annotate, install_apidocs
 from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
@@ -67,12 +67,19 @@ def make_jupyter_app(
     client: Client,
     auth: Optional[AuthConfig] = None,
     spawner: Optional[SpawnerConfig] = None,
+    cache: Optional["InformerCache"] = None,
 ) -> App:
+    from ..runtime.informer import InformerCache
+
     cfg = auth or AuthConfig()
     spawner = spawner or SpawnerConfig()
     authorizer = Authorizer(client, cfg)
     app = App("jupyter-web-app")
     install_auth(app, authorizer)
+    # List endpoints read through shared informers (KFAM informer-lister
+    # pattern, api_default.go:71-75) — a populated namespace must not cost
+    # an apiserver table scan per UI poll.
+    cache = cache or InformerCache(client)
 
     def user(req: Request) -> str:
         return req.context["user"]
@@ -85,11 +92,12 @@ def make_jupyter_app(
         return resp
 
     @app.route("/api/tpus")
+    @annotate(response="TpuList")
     def get_tpus(req: Request):
         """TPU discovery: generations/topologies present in node capacity
         (the reference's vendor discovery reshaped for slices)."""
         found: Dict[str, Dict[str, Any]] = {}
-        for node in client.list("v1", "Node"):
+        for node in cache.list("v1", "Node"):
             labels = apimeta.labels_of(node)
             gke_name = labels.get(NODE_LABEL_ACCELERATOR)
             capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
@@ -110,12 +118,13 @@ def make_jupyter_app(
 
     # -- listings ------------------------------------------------------------
     @app.route("/api/namespaces/<ns>/notebooks")
+    @annotate(response="NotebookList")
     def list_notebooks(req: Request):
         authorizer.ensure(user(req), "list", req.params["ns"])
         ns = req.params["ns"]
         out = []
-        all_events = client.list("v1", "Event", ns)
-        for nb in client.list(NOTEBOOK_API, "Notebook", ns):
+        all_events = cache.list("v1", "Event", ns)
+        for nb in cache.list(NOTEBOOK_API, "Notebook", ns):
             name = apimeta.name_of(nb)
             events = [
                 e for e in all_events
@@ -143,14 +152,16 @@ def make_jupyter_app(
         return {"notebook": nb}
 
     @app.route("/api/namespaces/<ns>/pvcs")
+    @annotate(response="PvcList")
     def list_pvcs(req: Request):
         authorizer.ensure(user(req), "list", req.params["ns"])
-        return {"pvcs": client.list("v1", "PersistentVolumeClaim", req.params["ns"])}
+        return {"pvcs": cache.list("v1", "PersistentVolumeClaim", req.params["ns"])}
 
     @app.route("/api/namespaces/<ns>/poddefaults")
+    @annotate(response="PodDefaultList")
     def list_poddefaults(req: Request):
         authorizer.ensure(user(req), "list", req.params["ns"])
-        pds = client.list("kubeflow.org/v1alpha1", "PodDefault", req.params["ns"])
+        pds = cache.list("kubeflow.org/v1alpha1", "PodDefault", req.params["ns"])
         return {
             "poddefaults": [
                 {
@@ -164,6 +175,7 @@ def make_jupyter_app(
 
     # -- spawn ---------------------------------------------------------------
     @app.route("/api/namespaces/<ns>/notebooks", methods=("POST",))
+    @annotate(response="Status", request="SpawnForm")
     def create_notebook(req: Request):
         ns = req.params["ns"]
         authorizer.ensure(user(req), "create", ns)
@@ -214,6 +226,7 @@ def make_jupyter_app(
         return {"status": "created", "notebook": name}
 
     @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=("PATCH",))
+    @annotate(response="Status")
     def patch_notebook(req: Request):
         ns, name = req.params["ns"], req.params["name"]
         authorizer.ensure(user(req), "update", ns)
@@ -235,6 +248,7 @@ def make_jupyter_app(
         return {"status": "stopped" if stopped else "started"}
 
     @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=("DELETE",))
+    @annotate(response="Status")
     def delete_notebook(req: Request):
         ns, name = req.params["ns"], req.params["name"]
         authorizer.ensure(user(req), "delete", ns)

@@ -21,11 +21,11 @@ from typing import Any, Dict, List, Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
-from ..apiserver.store import Conflict
+from ..apiserver.store import Conflict, NotFound
 from ..controllers.profile import PROFILE_API, ROLE_MAP
 from ..runtime.metrics import METRICS
 from ..web.auth import AuthConfig, Authorizer, install_auth
-from ..web.openapi import install_apidocs
+from ..web.openapi import annotate, install_apidocs
 from ..web.http import App, HttpError, Request
 
 BINDING_ANNOTATION_USER = "user"
@@ -37,11 +37,22 @@ def binding_name(user: str, role: str) -> str:
     return f"user-{mangled}-clusterrole-kubeflow-{role}"
 
 
-def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_header: str = "kubeflow-userid") -> App:
+def make_kfam_app(
+    client: Client,
+    auth: Optional[AuthConfig] = None,
+    userid_header: str = "kubeflow-userid",
+    cache: Optional["InformerCache"] = None,
+) -> App:
+    from ..runtime.informer import InformerCache
+
     cfg = auth or AuthConfig(userid_header=userid_header)
     authorizer = Authorizer(client, cfg)
     app = App("kfam")
     install_auth(app, authorizer, enable_csrf=False)
+    # List hot paths read through shared informers, not per-request API
+    # scans — the reference reads RoleBindings via a 60-min shared informer
+    # lister (access-management/kfam/api_default.go:71-75).
+    cache = cache or InformerCache(client)
 
     def profile_of(name: str) -> Dict[str, Any]:
         profile = client.get_opt(PROFILE_API, "Profile", name)
@@ -57,6 +68,7 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
 
     # -- profiles ------------------------------------------------------------
     @app.route("/kfam/v1/profiles", methods=("POST",))
+    @annotate(response="Profile", request="Profile")
     def create_profile(req: Request):
         body = req.json or {}
         name = (body.get("metadata") or {}).get("name") or body.get("name")
@@ -79,17 +91,20 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
             raise HttpError(409, f"profile {name!r} already exists") from None
 
     @app.route("/kfam/v1/profiles/<name>", methods=("DELETE",))
+    @annotate(response="Status")
     def delete_profile(req: Request):
         ensure_owner_or_admin(req.context["user"], req.params["name"])
         client.delete(PROFILE_API, "Profile", req.params["name"])
         return {"status": "deleted"}
 
     @app.route("/kfam/v1/profiles/<name>", methods=("GET",))
+    @annotate(response="Profile")
     def get_profile(req: Request):
         return profile_of(req.params["name"])
 
     # -- bindings ------------------------------------------------------------
     @app.route("/kfam/v1/bindings", methods=("POST",))
+    @annotate(response="BindingCreated", request="Binding")
     def create_binding(req: Request):
         body = req.json or {}
         ns = body.get("referredNamespace")
@@ -119,7 +134,7 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
             subjects=[{"kind": "User", "name": subject["name"]}],
         )
         try:
-            client.create(rb)
+            rb = client.create(rb)  # re-bind: the response carries the write RV
         except Conflict:
             raise HttpError(409, "binding already exists") from None
         policy = apimeta.new_object(
@@ -148,6 +163,7 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
         return {"status": "created", "binding": rb}
 
     @app.route("/kfam/v1/bindings", methods=("DELETE",))
+    @annotate(response="Status", request="Binding")
     def delete_binding(req: Request):
         body = req.json or {}
         ns = body.get("referredNamespace")
@@ -157,21 +173,63 @@ def make_kfam_app(client: Client, auth: Optional[AuthConfig] = None, userid_head
             raise HttpError(400, "referredNamespace and user.name required")
         ensure_owner_or_admin(req.context["user"], ns)
         name = binding_name(subject, role)
-        client.delete_opt("rbac.authorization.k8s.io/v1", "RoleBinding", name, ns)
+        rv = None
+        try:
+            gone = client.delete("rbac.authorization.k8s.io/v1", "RoleBinding", name, ns)
+            rv = (gone.get("metadata") or {}).get("resourceVersion")
+        except NotFound:
+            pass
         client.delete_opt("security.istio.io/v1beta1", "AuthorizationPolicy", name, ns)
-        return {"status": "deleted"}
+        # The tombstone RV lets the caller issue a list with
+        # minResourceVersion= and be guaranteed not to see this binding.
+        return {"status": "deleted", "resourceVersion": rv}
 
     @app.route("/kfam/v1/bindings", methods=("GET",))
+    @annotate(
+        response="BindingList",
+        query=[
+            {"name": "namespace"},
+            {"name": "user"},
+            {"name": "role"},
+            {"name": "minResourceVersion",
+             "description": "read-your-writes barrier: do not serve a view older than this RV"},
+        ],
+    )
     def list_bindings(req: Request):
         want_ns = req.query1("namespace")
         want_user = req.query1("user")
         want_role = req.query1("role")
+        # Read-your-writes: a client that just mutated a binding passes the
+        # write's RV; the informer blocks until its mirror reflects it
+        # (K8s resourceVersionMatch=NotOlderThan semantics).
+        min_rv: Optional[int] = None
+        raw_rv = req.query1("minResourceVersion")
+        if raw_rv:
+            try:
+                min_rv = int(raw_rv)
+            except ValueError:
+                raise HttpError(400, f"invalid minResourceVersion {raw_rv!r}") from None
+        # Resolve the barrier ONCE, with a short bound: the RV is untrusted
+        # client input, so a bogus future RV must not hold a worker thread —
+        # and certainly not once per namespace. If the mirror can't reach
+        # the RV in time, degrade to direct lists (a live read trivially
+        # satisfies any genuine barrier).
+        barrier_ok = True
+        if min_rv is not None:
+            inf = cache.informer_for("rbac.authorization.k8s.io/v1", "RoleBinding")
+            barrier_ok = inf.wait_synced(5.0) and inf.wait_rv(min_rv, timeout=2.0)
+
+        def role_bindings(ns: str) -> List[Dict[str, Any]]:
+            if barrier_ok:
+                return cache.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns)
+            return client.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns)
+
         bindings: List[Dict[str, Any]] = []
         namespaces = [want_ns] if want_ns else [
-            apimeta.name_of(n) for n in client.list("v1", "Namespace")
+            apimeta.name_of(n) for n in cache.list("v1", "Namespace")
         ]
         for ns in namespaces:
-            for rb in client.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns):
+            for rb in role_bindings(ns):
                 anns = apimeta.annotations_of(rb)
                 if BINDING_ANNOTATION_USER not in anns or BINDING_ANNOTATION_ROLE not in anns:
                     continue  # not a kfam contributor binding

@@ -13,7 +13,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
 from ..controllers.tensorboard import TB_API, parse_logspath
-from ..web.openapi import install_apidocs
+from ..web.openapi import annotate, install_apidocs
 from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
@@ -33,6 +33,7 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
         return resp
 
     @app.route("/api/namespaces/<ns>/tensorboards")
+    @annotate(response="TensorboardList")
     def list_tbs(req: Request):
         authorizer.ensure(req.context["user"], "list", req.params["ns"])
         out = []
@@ -50,6 +51,7 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
         return {"tensorboards": out}
 
     @app.route("/api/namespaces/<ns>/tensorboards", methods=("POST",))
+    @annotate(response="Status")
     def create_tb(req: Request):
         ns = req.params["ns"]
         authorizer.ensure(req.context["user"], "create", ns)
@@ -68,6 +70,7 @@ def make_tensorboards_app(client: Client, auth: Optional[AuthConfig] = None) -> 
         return {"status": "created"}
 
     @app.route("/api/namespaces/<ns>/tensorboards/<name>", methods=("DELETE",))
+    @annotate(response="Status")
     def delete_tb(req: Request):
         authorizer.ensure(req.context["user"], "delete", req.params["ns"])
         client.delete(TB_API, "Tensorboard", req.params["name"], req.params["ns"])

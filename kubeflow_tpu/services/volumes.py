@@ -13,7 +13,7 @@ from typing import Optional
 from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
-from ..web.openapi import install_apidocs
+from ..web.openapi import annotate, install_apidocs
 from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
 from ..web.auth import AuthConfig, Authorizer, install_auth, issue_csrf_cookie
@@ -33,6 +33,7 @@ def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
         return resp
 
     @app.route("/api/namespaces/<ns>/pvcs")
+    @annotate(response="PvcList")
     def list_pvcs(req: Request):
         ns = req.params["ns"]
         authorizer.ensure(req.context["user"], "list", ns)
@@ -52,6 +53,7 @@ def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
         }
 
     @app.route("/api/namespaces/<ns>/pvcs", methods=("POST",))
+    @annotate(response="Status")
     def create_pvc(req: Request):
         ns = req.params["ns"]
         authorizer.ensure(req.context["user"], "create", ns)
@@ -77,6 +79,7 @@ def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
         return {"status": "created"}
 
     @app.route("/api/namespaces/<ns>/pvcs/<name>", methods=("DELETE",))
+    @annotate(response="Status")
     def delete_pvc(req: Request):
         ns, name = req.params["ns"], req.params["name"]
         authorizer.ensure(req.context["user"], "delete", ns)

@@ -1,20 +1,263 @@
 """Machine-readable API contracts, generated from the live route table.
 
 The reference publishes a hand-written swagger 2.0 document for KFAM
-(components/access-management/api/swagger.yaml) and nothing for the CRUD
-apps. Here every app built on ``web.http.App`` can serve a generated
-contract at ``/apidocs`` (JSON) and ``/apidocs.yaml`` — derived from the
-actual registered routes, so it can never drift from the implementation.
+(components/access-management/api/swagger.yaml) with typed models (Binding,
+Profile, Status) and nothing for the CRUD apps. Here every app built on
+``web.http.App`` serves a generated contract at ``/apidocs`` (JSON) and
+``/apidocs.yaml`` — derived from the actual registered routes so paths can
+never drift — and handlers declare their models with ``@annotate``, which
+both documents the route and pins it to a named definition the way the
+reference's swagger drove its generated typed client
+(centraldashboard/app/clients/profile_controller.ts).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from .http import App, JsonResponse, Request
 
 _PARAM_RX = re.compile(r"<([a-zA-Z_][a-zA-Z0-9_]*)>")
+
+# -- shared model definitions (swagger 2.0 `definitions`) --------------------
+# One platform-wide vocabulary: apps reference these by name via @annotate;
+# only definitions actually referenced by an app's routes are emitted into
+# its document (transitively, so $refs always resolve).
+
+DEFINITIONS: Dict[str, Dict[str, Any]] = {
+    "Metadata": {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "namespace": {"type": "string"},
+            "uid": {"type": "string"},
+            "resourceVersion": {"type": "string"},
+            "creationTimestamp": {"type": "string", "format": "date-time"},
+            "labels": {"type": "object", "additionalProperties": {"type": "string"}},
+            "annotations": {"type": "object", "additionalProperties": {"type": "string"}},
+        },
+        "required": ["name"],
+    },
+    "Status": {
+        # Mirrors the reference's kfam swagger `Status` / K8s metav1.Status.
+        "type": "object",
+        "properties": {
+            "status": {"type": "string"},
+            "message": {"type": "string"},
+            "code": {"type": "integer"},
+            "resourceVersion": {"type": "string"},
+        },
+    },
+    "Error": {
+        "type": "object",
+        "properties": {"error": {"type": "string"}},
+        "required": ["error"],
+    },
+    "Subject": {
+        "type": "object",
+        "properties": {"kind": {"type": "string"}, "name": {"type": "string"}},
+        "required": ["name"],
+    },
+    "RoleRef": {
+        "type": "object",
+        "properties": {
+            "apiGroup": {"type": "string"},
+            "kind": {"type": "string"},
+            "name": {"type": "string"},
+        },
+        "required": ["kind", "name"],
+    },
+    "Binding": {
+        # access-management/api/swagger.yaml Binding model, TPU-reshaped.
+        "type": "object",
+        "properties": {
+            "user": {"$ref": "#/definitions/Subject"},
+            "referredNamespace": {"type": "string"},
+            "roleRef": {"$ref": "#/definitions/RoleRef"},
+        },
+        "required": ["user", "referredNamespace", "roleRef"],
+    },
+    "BindingList": {
+        "type": "object",
+        "properties": {
+            "bindings": {"type": "array", "items": {"$ref": "#/definitions/Binding"}}
+        },
+        "required": ["bindings"],
+    },
+    "BindingCreated": {
+        "type": "object",
+        "properties": {
+            "status": {"type": "string"},
+            "binding": {"type": "object"},
+        },
+    },
+    "Profile": {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "metadata": {"$ref": "#/definitions/Metadata"},
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "owner": {"$ref": "#/definitions/Subject"},
+                    "resourceQuotaSpec": {"type": "object"},
+                    "plugins": {"type": "array", "items": {"type": "object"}},
+                },
+            },
+            "status": {"type": "object"},
+        },
+    },
+    "TpuSpec": {
+        "type": "object",
+        "properties": {
+            "generation": {"type": "string"},
+            "topology": {"type": "string"},
+            "numHosts": {"type": "integer"},
+            "chips": {"type": "integer"},
+        },
+    },
+    "NotebookSummary": {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "namespace": {"type": "string"},
+            "image": {"type": "string"},
+            "tpu": {"$ref": "#/definitions/TpuSpec"},
+            "status": {"$ref": "#/definitions/UiStatus"},
+            "serverType": {"type": "string"},
+        },
+        "required": ["name", "namespace", "status"],
+    },
+    "UiStatus": {
+        "type": "object",
+        "properties": {"phase": {"type": "string"}, "message": {"type": "string"}},
+        "required": ["phase"],
+    },
+    "NotebookList": {
+        "type": "object",
+        "properties": {
+            "notebooks": {
+                "type": "array",
+                "items": {"$ref": "#/definitions/NotebookSummary"},
+            }
+        },
+        "required": ["notebooks"],
+    },
+    "TpuInfo": {
+        "type": "object",
+        "properties": {
+            "generation": {"type": "string"},
+            "topologies": {"type": "array", "items": {"type": "string"}},
+            "chipsPerNode": {"type": "integer"},
+        },
+        "required": ["generation", "topologies"],
+    },
+    "TpuList": {
+        "type": "object",
+        "properties": {
+            "tpus": {"type": "array", "items": {"$ref": "#/definitions/TpuInfo"}}
+        },
+        "required": ["tpus"],
+    },
+    "PodDefaultInfo": {
+        "type": "object",
+        "properties": {
+            "label": {"type": "string"},
+            "desc": {"type": "string"},
+            "name": {"type": "string"},
+        },
+        "required": ["name"],
+    },
+    "PodDefaultList": {
+        "type": "object",
+        "properties": {
+            "poddefaults": {
+                "type": "array",
+                "items": {"$ref": "#/definitions/PodDefaultInfo"},
+            }
+        },
+        "required": ["poddefaults"],
+    },
+    "TensorboardList": {
+        "type": "object",
+        "properties": {
+            "tensorboards": {"type": "array", "items": {"type": "object"}}
+        },
+        "required": ["tensorboards"],
+    },
+    "PvcList": {
+        "type": "object",
+        "properties": {"pvcs": {"type": "array", "items": {"type": "object"}}},
+        "required": ["pvcs"],
+    },
+    "SpawnForm": {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "image": {"type": "string"},
+            "cpu": {"type": "string"},
+            "memory": {"type": "string"},
+            "tpus": {"type": "object"},
+            "workspaceVolume": {"type": "object"},
+            "dataVolumes": {"type": "array", "items": {"type": "object"}},
+            "configurations": {"type": "array", "items": {"type": "string"}},
+            "shm": {"type": "boolean"},
+        },
+        "required": ["name"],
+    },
+    "EnvInfo": {
+        "type": "object",
+        "properties": {
+            "user": {"type": "string"},
+            "platform": {"type": "object"},
+            "namespaces": {"type": "array", "items": {"type": "object"}},
+            "isClusterAdmin": {"type": "boolean"},
+        },
+    },
+    "WorkgroupExists": {
+        "type": "object",
+        "properties": {
+            "hasWorkgroup": {"type": "boolean"},
+            "user": {"type": "string"},
+            "namespaces": {"type": "array", "items": {"type": "string"}},
+            "hasAuth": {"type": "boolean"},
+            "registrationFlowAllowed": {"type": "boolean"},
+        },
+        "required": ["hasWorkgroup", "user"],
+    },
+}
+
+_REF_RX = re.compile(r"#/definitions/([A-Za-z0-9_]+)")
+
+
+def annotate(
+    response: Optional[str] = None,
+    request: Optional[str] = None,
+    query: Optional[List[Dict[str, Any]]] = None,
+):
+    """Attach swagger model names to a handler: ``response``/``request`` are
+    keys into DEFINITIONS; ``query`` is a list of swagger query-parameter
+    dicts. Used by openapi_document to emit typed per-route schemas."""
+
+    def deco(fn):
+        fn.__openapi__ = {"response": response, "request": request, "query": query}
+        return fn
+
+    return deco
+
+
+def _collect_refs(schema: Any, out: set) -> None:
+    if isinstance(schema, dict):
+        for v in schema.values():
+            _collect_refs(v, out)
+    elif isinstance(schema, list):
+        for v in schema:
+            _collect_refs(v, out)
+    elif isinstance(schema, str):
+        for name in _REF_RX.findall(schema):
+            out.add(name)
 
 
 def _swagger_path(pattern: str) -> str:
@@ -24,31 +267,56 @@ def _swagger_path(pattern: str) -> str:
 def openapi_document(app: App, base_path: str = "/", version: str = "1.0") -> Dict[str, Any]:
     """Swagger 2.0 document from the app's route table.
 
-    Handler docstrings (first line) become operation summaries.
+    Handler docstrings (first line) become operation summaries; ``@annotate``
+    marks become typed request/response schemas referencing `definitions`
+    (emitted transitively so every $ref resolves).
     """
     paths: Dict[str, Dict[str, Any]] = {}
+    used: set = set()
     for method, pattern, fn in app.iter_routes():
         swagger = _swagger_path(pattern)
         params: List[Dict[str, Any]] = [
             {"name": name, "in": "path", "required": True, "type": "string"}
             for name in _PARAM_RX.findall(pattern)
         ]
+        marks = getattr(fn, "__openapi__", {})
         op: Dict[str, Any] = {
             "operationId": f"{fn.__name__}_{method.lower()}",
             "responses": {"200": {"description": "OK"}},
         }
+        if marks.get("response"):
+            ref = f"#/definitions/{marks['response']}"
+            op["responses"]["200"]["schema"] = {"$ref": ref}
+            used.add(marks["response"])
         doc = (fn.__doc__ or "").strip().splitlines()
         if doc:
             op["summary"] = doc[0].strip()
+        for qp in marks.get("query") or []:
+            params.append({"in": "query", "type": "string", **qp})
+        if method in ("POST", "PUT", "PATCH", "DELETE") and (
+            marks.get("request") or method != "DELETE"
+        ):
+            body_schema: Dict[str, Any] = {"type": "object"}
+            if marks.get("request"):
+                body_schema = {"$ref": f"#/definitions/{marks['request']}"}
+                used.add(marks["request"])
+            params.append({"name": "body", "in": "body", "schema": body_schema})
+            op["consumes"] = ["application/json"]
         if params:
             op["parameters"] = params
-        if method in ("POST", "PUT", "PATCH"):
-            op.setdefault("parameters", []).append(
-                {"name": "body", "in": "body", "schema": {"type": "object"}}
-            )
-            op["consumes"] = ["application/json"]
         paths.setdefault(swagger, {})[method.lower()] = op
-    return {
+
+    # Transitive closure so nested $refs (Binding → Subject) resolve.
+    frontier = set(used)
+    while frontier:
+        nxt: set = set()
+        for name in frontier:
+            _collect_refs(DEFINITIONS.get(name, {}), nxt)
+        frontier = nxt - used
+        used |= nxt
+    definitions = {n: DEFINITIONS[n] for n in sorted(used) if n in DEFINITIONS}
+
+    doc: Dict[str, Any] = {
         "swagger": "2.0",
         "info": {"title": app.name, "version": version},
         "basePath": base_path,
@@ -56,6 +324,9 @@ def openapi_document(app: App, base_path: str = "/", version: str = "1.0") -> Di
         "produces": ["application/json"],
         "paths": dict(sorted(paths.items())),
     }
+    if definitions:
+        doc["definitions"] = definitions
+    return doc
 
 
 def install_apidocs(app: App, base_path: str = "/", version: str = "1.0") -> None:

@@ -9,7 +9,7 @@ import time
 
 import pytest
 
-from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.api.meta import REGISTRY, new_object
 from kubeflow_tpu.apiserver.client import Client
 from kubeflow_tpu.apiserver.store import Store
 from kubeflow_tpu.platform import build_platform
@@ -75,6 +75,76 @@ class TestSharedInformer:
             assert wait_for(lambda: ("ADDED", "p0") in seen)
             client.delete("v1", "Pod", "p0", "ns1")
             assert wait_for(lambda: ("DELETED", "p0") in seen)
+        finally:
+            inf.stop()
+
+    def test_synthetic_delete_on_relist(self):
+        """Objects deleted while the stream was down must produce DELETED
+        handler events on reconnect — otherwise handler-maintained state
+        (e.g. the notebook controller's StatefulSet gauge index) holds
+        stale entries forever. client-go emits deletes on relist for the
+        same reason."""
+        store = Store()
+        client = Client(store)
+        client.create(new_object("v1", "Pod", "stays", "ns1"))
+        client.create(new_object("v1", "Pod", "vanishes", "ns1"))
+        inf = SharedInformer(client, "v1", "Pod").start()
+        seen = []
+        inf.add_event_handler(lambda t, o: seen.append((t, o["metadata"]["name"])))
+        try:
+            assert inf.wait_synced()
+            assert wait_for(lambda: len(inf) == 2)
+            # Kill the stream, then delete while the informer is deaf. The
+            # watcher is closed server-side, so the DELETED event is lost.
+            inf._watcher.close()
+            store.delete(REGISTRY.for_kind("v1", "Pod"), "vanishes", "ns1")
+            # The pump reconnects, relists, and must synthesize the delete.
+            assert wait_for(lambda: ("DELETED", "vanishes") in seen, timeout=10)
+            assert wait_for(lambda: len(inf) == 1)
+            assert inf.get("stays", "ns1") is not None
+            assert inf.get("vanishes", "ns1") is None
+        finally:
+            inf.stop()
+
+    def test_wait_rv_read_your_writes_barrier(self):
+        """list(min_rv=<my write's RV>) must reflect that write — the
+        K8s resourceVersionMatch=NotOlderThan contract the dashboard's
+        add/remove-contributor read-back depends on."""
+        store = Store()
+        client = Client(store)
+        inf = SharedInformer(client, "v1", "Pod").start()
+        try:
+            assert inf.wait_synced()
+            created = client.create(new_object("v1", "Pod", "rw", "ns1"))
+            rv = int(created["metadata"]["resourceVersion"])
+            assert inf.wait_rv(rv, timeout=5)
+            assert inf.get("rw", "ns1") is not None
+            # Tombstone RV: the DELETED event carries the deletion RV, so a
+            # barrier on it guarantees the delete is reflected too.
+            gone = client.delete("v1", "Pod", "rw", "ns1")
+            drv = int(gone["metadata"]["resourceVersion"])
+            assert drv > rv
+            assert inf.wait_rv(drv, timeout=5)
+            assert inf.get("rw", "ns1") is None
+        finally:
+            inf.stop()
+
+    def test_no_empty_cache_window_during_relist(self):
+        """Relist overlays the mirror in place: a reader between reconnect
+        and sync must never observe an empty cache for objects that still
+        exist (the old clear-then-refill approach had that window)."""
+        client = Client(Store())
+        client.create(new_object("v1", "Pod", "p0", "ns1"))
+        inf = SharedInformer(client, "v1", "Pod").start()
+        try:
+            assert inf.wait_synced()
+            assert wait_for(lambda: len(inf) == 1)
+            for _ in range(5):  # churn reconnects; cache must never dip to 0
+                inf._watcher.close()
+                deadline = time.time() + 2
+                while time.time() < deadline and inf._watcher.closed:
+                    assert len(inf) == 1
+                    time.sleep(0.005)
         finally:
             inf.stop()
 
