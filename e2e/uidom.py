@@ -1,0 +1,686 @@
+"""Headless DOM harness for the kfui declarative frontend.
+
+The browser-driven e2e tier (reference: testing/test_jwa.py drives JWA with
+Selenium through a real browser; centraldashboard/test/e2e.test.ts uses
+Puppeteer). This image ships no JS runtime or browser, so the tier is built
+the other way around: the frontend expresses ALL of its wiring declaratively
+in ``data-kf-*`` attributes (kubeflow_tpu/web/ui/kfui.js is a generic
+interpreter with no app logic), and this module interprets the SAME
+attribute semantics over a real parsed DOM, driving the real backends
+in-process. A flow test here exercises: served HTML → DOM → component init
+(fetches) → user interaction (click/fill/submit, confirm dialogs) →
+HTTP calls → re-rendered DOM — everything a browser test covers except the
+pixel rasterizer and the ~400-line generic runtime, which is kept
+app-logic-free precisely so this harness stays faithful.
+
+Semantics mirrored 1:1 from kfui.js (same section names):
+templating ``{path}``, items paths with one-level filters
+(``tpus[generation={dep}].topologies``), tables with row templates and
+show/hide-when, actions with confirm + body templates + then-steps, forms
+with dotted names / omit rules, dependent selects, text/show-if binders,
+bar charts, the namespace selector, and the exponential-backoff poller.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from html.parser import HTMLParser
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+VOID_TAGS = {
+    "area", "base", "br", "col", "embed", "hr", "img", "input",
+    "link", "meta", "param", "source", "track", "wbr",
+}
+
+
+class Element:
+    def __init__(self, tag: str, attrs: Dict[str, str], parent: Optional["Element"]):
+        self.tag = tag
+        self.attrs = dict(attrs)
+        self.parent = parent
+        self.children: List[Any] = []  # Element | str
+        # form-control state
+        self.value: str = attrs.get("value", "")
+        self.checked: bool = "checked" in attrs
+        self.selected_values: List[str] = []
+        self._default_value = self.value
+        self._default_checked = self.checked
+
+    # -- tree ops ------------------------------------------------------------
+    def append(self, node: Any) -> None:
+        if isinstance(node, Element):
+            node.parent = self
+        self.children.append(node)
+
+    def remove(self) -> None:
+        if self.parent:
+            self.parent.children.remove(self)
+            self.parent = None
+
+    def replace_children(self, nodes: List[Any]) -> None:
+        for c in self.children:
+            if isinstance(c, Element):
+                c.parent = None
+        self.children = []
+        for n in nodes:
+            self.append(n)
+
+    def walk(self):
+        # Template CONTENT is inert (browsers keep it out of document
+        # queries): yield the <template> element itself but never descend
+        # into it. A walk started ON a template (materializing a clone)
+        # still sees its children.
+        for c in list(self.children):
+            if isinstance(c, Element):
+                yield c
+                if c.tag != "template":
+                    yield from c.walk()
+
+    # -- queries ---------------------------------------------------------------
+    def matches(self, simple: str) -> bool:
+        m = re.match(
+            r"^([a-zA-Z*][\w-]*)?(?:#([\w-]+))?((?:\.[\w-]+)*)((?:\[[^\]]+\])*)$", simple
+        )
+        if not m:
+            return False
+        tag, eid, classes, attrsel = m.groups()
+        if tag and tag != "*" and self.tag != tag:
+            return False
+        if eid and self.attrs.get("id") != eid:
+            return False
+        for cls in filter(None, (classes or "").split(".")):
+            if cls not in (self.attrs.get("class", "").split()):
+                return False
+        for am in re.findall(r"\[([^\]=]+)(?:=\"?([^\]\"]*)\"?)?\]", attrsel or ""):
+            name, want = am
+            if name not in self.attrs:
+                return False
+            if want and self.attrs.get(name) != want:
+                return False
+        return True
+
+    def css(self, selector: str) -> List["Element"]:
+        """Descendant-combinator selector subset (what the pages use)."""
+        out: List[Element] = []
+        for sel in selector.split(","):
+            parts = sel.strip().split()
+            candidates: List[Element] = [self]
+            for i, part in enumerate(parts):
+                nxt: List[Element] = []
+                for c in candidates:
+                    for el in c.walk():
+                        if el.matches(part):
+                            nxt.append(el)
+                candidates = nxt
+            for el in candidates:
+                if el not in out:
+                    out.append(el)
+        return out
+
+    def one(self, selector: str) -> "Element":
+        found = self.css(selector)
+        if not found:
+            raise AssertionError(f"no element matches {selector!r}")
+        return found[0]
+
+    def closest(self, pred: Callable[["Element"], bool]) -> Optional["Element"]:
+        cur: Optional[Element] = self
+        while cur is not None:
+            if pred(cur):
+                return cur
+            cur = cur.parent
+        return None
+
+    # -- text ------------------------------------------------------------------
+    @property
+    def text(self) -> str:
+        parts: List[str] = []
+        for c in self.children:
+            if isinstance(c, str):
+                parts.append(c)
+            else:
+                parts.append(c.text)
+        return re.sub(r"\s+", " ", "".join(parts)).strip()
+
+    def set_text(self, value: str) -> None:
+        self.replace_children([value])
+
+    def clone(self) -> "Element":
+        el = Element(self.tag, dict(self.attrs), None)
+        el.value, el.checked = self.value, self.checked
+        for c in self.children:
+            el.append(c.clone() if isinstance(c, Element) else c)
+        return el
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        ident = "#" + self.attrs["id"] if "id" in self.attrs else ""
+        return f"<{self.tag}{ident}>"
+
+
+class _TreeBuilder(HTMLParser):
+    def __init__(self) -> None:
+        super().__init__(convert_charrefs=True)
+        self.root = Element("document", {}, None)
+        self.stack = [self.root]
+
+    def handle_starttag(self, tag, attrs):
+        el = Element(tag, {k: (v if v is not None else "") for k, v in attrs}, None)
+        self.stack[-1].append(el)
+        if tag not in VOID_TAGS:
+            self.stack.append(el)
+
+    def handle_startendtag(self, tag, attrs):
+        self.stack[-1].append(
+            Element(tag, {k: (v if v is not None else "") for k, v in attrs}, None)
+        )
+
+    def handle_endtag(self, tag):
+        for i in range(len(self.stack) - 1, 0, -1):
+            if self.stack[i].tag == tag:
+                del self.stack[i:]
+                return
+
+    def handle_data(self, data):
+        if data:
+            self.stack[-1].append(data)
+
+
+def parse_html(html: str) -> Element:
+    b = _TreeBuilder()
+    b.feed(html)
+    return b.root
+
+
+# ---- kfui semantics ---------------------------------------------------------
+
+def lookup(obj: Any, path: str) -> Any:
+    if path in (".", ""):
+        return obj
+    cur = obj
+    for part in path.split("."):
+        if cur is None:
+            return None
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+    return cur
+
+
+class Page:
+    """One served SPA page wired to in-process backend apps."""
+
+    def __init__(
+        self,
+        app,
+        html: str,
+        ns: str = "kubeflow-user",
+        headers: Optional[Dict[str, str]] = None,
+        extra_apps: Optional[Dict[str, Any]] = None,
+    ):
+        self.app = app
+        self.ns = ns
+        self.headers = dict(headers or {})
+        self.extra_apps = extra_apps or {}
+        self.doc = parse_html(html)
+        self.snacks: List[Tuple[str, str]] = []
+        self.confirms: List[str] = []
+        self.confirm_answer = True
+        self.location: Optional[str] = None  # navigation sink
+        self.reloaded = False
+        self._pollers: Dict[int, "Poller"] = {}
+        self._actions: Dict[int, Dict[str, str]] = {}  # element id() -> attrs ctx-resolved
+        self.calls: List[Tuple[str, str]] = []  # request log (method, url)
+        self.init()
+
+    # -- transport (fetch analog, in-process) ---------------------------------
+    def api(self, method: str, url: str, body: Any = None):
+        # Init-pass GET memo (kfui semantics): components binding the same
+        # endpoint during init share one fetch; pollers/actions fetch fresh.
+        if method == "GET" and self._init_memo is not None:
+            if url not in self._init_memo:
+                self._init_memo[url] = self._fetch(method, url, body)
+            return self._init_memo[url]
+        return self._fetch(method, url, body)
+
+    def _fetch(self, method: str, url: str, body: Any = None):
+        self.calls.append((method, url))
+        resp = self.app.call(method, url, body, self.headers)
+        data = resp.body
+        if resp.status >= 400:
+            msg = (data or {}).get("error") if isinstance(data, dict) else None
+            raise RuntimeError(msg or f"HTTP {resp.status}")
+        return data
+
+    # -- templating -----------------------------------------------------------
+    def subst(self, template: str, ctx: Any) -> str:
+        def repl(m):
+            path = m.group(1)
+            if path == "ns":
+                return self.ns
+            v = ctx if path == "." else lookup(ctx, path)
+            if isinstance(v, bool):  # JSON booleans render as true/false in JS
+                return "true" if v else "false"
+            return "" if v is None else str(v)
+
+        # Identifier-shaped placeholders only ({.}, {ns}, {status.phase}) —
+        # JSON body templates ({"stopped": true}) pass through untouched.
+        return re.sub(r"\{(\.|[A-Za-z_$][\w$.]*)\}", repl, str(template))
+
+    def subst_json(self, template: str, ctx: Any) -> str:
+        """subst with JSON-escaped values — for data-kf-body templates,
+        so quotes/backslashes in data can't break parsing (kfui substJson)."""
+
+        def repl(m):
+            path = m.group(1)
+            v = self.ns if path == "ns" else (ctx if path == "." else lookup(ctx, path))
+            if isinstance(v, bool):
+                v = "true" if v else "false"
+            s = "" if v is None else str(v)
+            return json.dumps(s)[1:-1]
+
+        return re.sub(r"\{(\.|[A-Za-z_$][\w$.]*)\}", repl, str(template))
+
+    def items_at(self, data: Any, path: str, ctx: Any) -> List[Any]:
+        if not path or path == ".":
+            return data if isinstance(data, list) else []
+        cur = data
+        for seg in path.split("."):
+            if cur is None:
+                return []
+            m = re.match(r"^([^[]*)(?:\[([^=\]]+)=([^\]]*)\])?$", seg)
+            if m.group(1):
+                cur = lookup(cur, m.group(1))
+            if m.group(2) is not None and isinstance(cur, list):
+                want = self.subst(m.group(3), ctx)
+                cur = next(
+                    (it for it in cur if str(lookup(it, m.group(2))) == want), None
+                )
+        if cur is None:
+            return []
+        return cur if isinstance(cur, list) else [cur]
+
+    # -- init (kf.init order) -------------------------------------------------
+    def init(self) -> None:
+        self._init_memo: Optional[Dict[str, Any]] = {}
+        try:
+            self._init_all()
+        finally:
+            self._init_memo = None
+
+    def _init_all(self) -> None:
+        for n in self.doc.css("[data-kf-nav]"):
+            n.attrs["href"] = n.attrs["data-kf-nav"] + "?ns=" + self.ns
+        for n in self.doc.css("[data-kf-ns-select]"):
+            self._init_ns_select(n)
+        for n in self.doc.css("[data-kf-options]"):
+            self._init_options(n)
+        for n in self.doc.css("[data-kf-text]"):
+            self._init_text(n)
+        for n in self.doc.css("[data-kf-show-if]"):
+            self._init_show_if(n)
+        for n in self.doc.css("[data-kf-chart]"):
+            self._init_chart(n)
+        for n in self.doc.css("[data-kf-table]"):
+            self._init_table(n)
+
+    # -- components -----------------------------------------------------------
+    def _init_ns_select(self, sel: Element) -> None:
+        try:
+            data = self.api("GET", "/api/namespaces")
+        except RuntimeError:
+            data = []
+        namespaces = data if isinstance(data, list) else []
+        sel.replace_children([])
+        for ns in namespaces:
+            opt = Element("option", {"value": ns}, None)
+            opt.set_text(ns)
+            sel.append(opt)
+        if self.ns in namespaces:
+            sel.value = self.ns
+
+    def _init_options(self, sel: Element) -> None:
+        def load():
+            spec = sel.attrs["data-kf-options"].split(";")
+            url, items_path, value_path = spec[0], spec[1], spec[2]
+            label_tpl = spec[3] if len(spec) > 3 else None
+            dep_sel = sel.attrs.get("data-kf-depends")
+            dep = ""
+            if dep_sel:
+                dep = self.doc.one(dep_sel).value
+            ctx = {"dep": dep}
+            data = self.api("GET", self.subst(url, ctx))
+            items = self.items_at(data, self.subst(items_path, ctx), ctx)
+            keep: List[Element] = []
+            if "data-kf-keep-first" in sel.attrs:
+                opts = [c for c in sel.children if isinstance(c, Element) and c.tag == "option"]
+                if opts:
+                    keep = [opts[0].clone()]
+            sel.replace_children(list(keep))
+            for item in items:
+                value = str(item) if value_path == "." else str(lookup(item, value_path))
+                opt = Element("option", {"value": value}, None)
+                opt.set_text(self.subst(label_tpl, item) if label_tpl else value)
+                sel.append(opt)
+            if "disabled" in sel.attrs and (items or keep):
+                del sel.attrs["disabled"]
+            elif not items and not keep:
+                sel.attrs["disabled"] = ""
+            options = [c for c in sel.children if isinstance(c, Element)]
+            values = [o.attrs.get("value", "") for o in options]
+            if sel.value not in values:
+                sel.value = values[0] if values else ""
+
+        sel._kf_init = load  # type: ignore[attr-defined]
+        try:
+            load()
+        except RuntimeError:
+            pass
+
+    def _init_text(self, node: Element) -> None:
+        def load():
+            spec = node.attrs["data-kf-text"].split(";")
+            url, path = spec[0], spec[1] if len(spec) > 1 else ""
+            tpl = spec[2] if len(spec) > 2 else None
+            if not url:
+                node.set_text(self.subst(tpl or "", {}))
+                return
+            data = self.api("GET", self.subst(url, {}))
+            if tpl:
+                node.set_text(self.subst(tpl, data))
+            else:
+                v = lookup(data, path)
+                node.set_text("" if v is None else str(v))
+
+        node._kf_init = load  # type: ignore[attr-defined]
+        try:
+            load()
+        except RuntimeError:
+            pass
+
+    def _init_show_if(self, node: Element) -> None:
+        def load():
+            url, path, want = node.attrs["data-kf-show-if"].split(";")
+            data = self.api("GET", self.subst(url, {}))
+            v = lookup(data, path)
+            got = ("true" if v else "false") if isinstance(v, bool) else str(v)
+            if got == want:
+                node.attrs.pop("hidden", None)
+            else:
+                node.attrs["hidden"] = ""
+
+        node._kf_init = load  # type: ignore[attr-defined]
+        try:
+            load()
+        except RuntimeError:
+            pass
+
+    def _init_chart(self, node: Element) -> None:
+        def load():
+            url, items_path, label_path, value_path = node.attrs["data-kf-chart"].split(";")
+            data = self.api("GET", self.subst(url, {}))
+            items = self.items_at(data, items_path, {})
+            svg = Element("svg", {"class": "kf-chart"}, None)
+            for item in items:
+                value = lookup(item, value_path) or 0
+                frac = max(0.0, min(1.0, float(value)))
+                bar = Element("rect", {"class": "kf-bar", "data-frac": f"{frac:.4f}"}, None)
+                label = Element("text", {"class": "kf-bar-label"}, None)
+                label.set_text(str(lookup(item, label_path) or ""))
+                pct = Element("text", {"class": "kf-bar-pct"}, None)
+                pct.set_text(f"{round(frac * 100)}%")
+                svg.append(bar)
+                svg.append(label)
+                svg.append(pct)
+            node.replace_children([svg])
+
+        node._kf_refresh = load  # type: ignore[attr-defined]
+        poll = int(node.attrs.get("data-kf-poll", "0"))
+        if poll > 0:
+            self._pollers[id(node)] = Poller(load, poll)
+        try:
+            load()
+        except RuntimeError:
+            pass
+
+    def _init_table(self, node: Element) -> None:
+        url = node.attrs["data-kf-table"]
+        items_path = node.attrs.get("data-kf-items", ".")
+        empty_text = node.attrs.get("data-kf-empty", "none")
+        template = node.one("template[data-kf-row]")
+        tbodies = node.css("tbody")
+        tbody = tbodies[0] if tbodies else node
+
+        def render(data):
+            rows = self.items_at(data, items_path, {})
+            tbody.replace_children([])
+            if not rows:
+                tr = Element("tr", {}, None)
+                td = Element("td", {"class": "empty"}, None)
+                td.set_text(empty_text)
+                tr.append(td)
+                tbody.append(tr)
+                return
+            for row in rows:
+                clone = template.clone()
+                self._materialize(clone, row)
+                for c in list(clone.children):
+                    clone.children.remove(c)
+                    tbody.append(c)
+
+        def refresh():
+            render(self.api("GET", self.subst(url, {})))
+
+        node._kf_render = render  # type: ignore[attr-defined]
+        node._kf_refresh = refresh  # type: ignore[attr-defined]
+        poll = int(node.attrs.get("data-kf-poll", "0"))
+        if poll > 0:
+            self._pollers[id(node)] = Poller(refresh, poll)
+        try:
+            refresh()
+        except RuntimeError as e:
+            self.snacks.append((str(e), "error"))
+
+    def _materialize(self, fragment: Element, ctx: Any) -> None:
+        def walk_text(el: Element):
+            el.children = [
+                self.subst(c, ctx) if isinstance(c, str) else c for c in el.children
+            ]
+            for c in el.children:
+                if isinstance(c, Element):
+                    walk_text(c)
+
+        walk_text(fragment)
+        for el in list(fragment.walk()):
+            for k in list(el.attrs):
+                if "{" in el.attrs[k]:
+                    fill = self.subst_json if k == "data-kf-body" else self.subst
+                    el.attrs[k] = fill(el.attrs[k], ctx)
+            show = el.attrs.get("data-kf-show-when")
+            if show is not None:
+                got, _, want = show.partition("==")
+                if got != want:
+                    el.remove()
+                    continue
+            hide = el.attrs.get("data-kf-hide-when")
+            if hide is not None:
+                got, _, want = hide.partition("==")
+                if got == want:
+                    el.remove()
+                    continue
+            if "data-kf-action" in el.attrs:
+                # attrs were already ctx-resolved above; click() reads them.
+                self._actions[id(el)] = dict(el.attrs)
+
+    # -- interactions ----------------------------------------------------------
+    def _run_then(self, then_spec: Optional[str], result: Any = None) -> None:
+        if not then_spec or then_spec == "none":
+            return
+        for step in then_spec.split(","):
+            verb, _, arg = step.partition(":")
+            if verb == "refresh":
+                target = self.doc.one(arg)
+                fn = getattr(target, "_kf_refresh", None) or getattr(target, "_kf_init", None)
+                if fn:
+                    fn()
+            elif verb == "render":
+                # render the mutation's own (barrier'd) response — no refetch
+                target = self.doc.one(arg)
+                fn = getattr(target, "_kf_render", None)
+                if fn:
+                    fn(result)
+            elif verb == "reload":
+                self.reloaded = True
+            elif verb == "nav":
+                self.location = self.subst(arg, {})
+            elif verb == "clear":
+                for field in self.doc.one(arg).css("[name]"):
+                    field.value = field._default_value
+                    field.checked = field._default_checked
+                    field.selected_values = []
+
+    def click(self, target) -> None:
+        """Click an element carrying data-kf-action (row or page level)."""
+        el = target if isinstance(target, Element) else self.doc.one(target)
+        attrs = self._actions.get(id(el), el.attrs)
+        action = attrs.get("data-kf-action")
+        assert action, f"{el!r} has no data-kf-action"
+        method, _, url_tpl = action.partition(":")
+        url = self.subst(url_tpl, {})
+        confirm = attrs.get("data-kf-confirm")
+        if confirm:
+            self.confirms.append(self.subst(confirm, {}))
+            if not self.confirm_answer:
+                return
+        body = None
+        if attrs.get("data-kf-body"):
+            body = json.loads(self.subst(attrs["data-kf-body"], {}))
+        try:
+            result = self.api(method, url, body)
+            self.snacks.append((attrs.get("data-kf-done", "done"), "ok"))
+            self._run_then(attrs.get("data-kf-then"), result)
+        except RuntimeError as e:
+            self.snacks.append((str(e), "error"))
+
+    def form_body(self, form: Element) -> Dict[str, Any]:
+        body: Dict[str, Any] = {}
+        for field in form.css("[name]"):
+            if "disabled" in field.attrs:
+                continue
+            if field.tag == "select" and "multiple" in field.attrs:
+                value: Any = list(field.selected_values)
+            elif field.attrs.get("type") == "checkbox":
+                value = field.checked
+            elif field.attrs.get("type") == "number":
+                value = "" if field.value == "" else float(field.value)
+            else:
+                value = field.value
+            omit_if = field.attrs.get("data-kf-omit-if")
+            if omit_if is not None and str(value) == omit_if:
+                continue
+            if value == "" and "data-kf-omit-empty" in field.attrs:
+                continue
+            path = field.attrs["name"].split(".")
+            cur = body
+            for seg in path[:-1]:
+                cur = cur.setdefault(seg, {})
+            cur[path[-1]] = value
+        return body
+
+    def submit(self, selector: str) -> None:
+        form = self.doc.one(selector)
+        method, _, url_tpl = form.attrs["data-kf-form"].partition(":")
+        try:
+            result = self.api(method, self.subst(url_tpl, {}), self.form_body(form))
+            self.snacks.append((form.attrs.get("data-kf-done", "created"), "ok"))
+            self._run_then(form.attrs.get("data-kf-then"), result)
+        except RuntimeError as e:
+            self.snacks.append((str(e), "error"))
+
+    def fill(self, selector: str, value: str) -> None:
+        self.doc.one(selector).value = value
+
+    def select(self, selector: str, value: str) -> None:
+        """Choose an option — asserts it exists (a user can only pick what
+        the UI offers), then fires dependent reloads (change event)."""
+        sel = self.doc.one(selector)
+        options = [c for c in sel.children if isinstance(c, Element) and c.tag == "option"]
+        values = [o.attrs.get("value", "") for o in options]
+        assert value in values, f"option {value!r} not in {values} for {selector}"
+        sel.value = value
+        for other in self.doc.css("[data-kf-depends]"):
+            if other.attrs.get("data-kf-depends", "") and self.doc.one(
+                other.attrs["data-kf-depends"]
+            ) is sel:
+                fn = getattr(other, "_kf_init", None)
+                if fn:
+                    fn()
+
+    def select_multi(self, selector: str, values: List[str]) -> None:
+        sel = self.doc.one(selector)
+        options = [c for c in sel.children if isinstance(c, Element) and c.tag == "option"]
+        have = [o.attrs.get("value", "") for o in options]
+        for v in values:
+            assert v in have, f"option {v!r} not in {have} for {selector}"
+        sel.selected_values = list(values)
+
+    def set_checkbox(self, selector: str, checked: bool) -> None:
+        self.doc.one(selector).checked = checked
+
+    # -- observations ----------------------------------------------------------
+    def table_rows(self, selector: str) -> List[List[str]]:
+        node = self.doc.one(selector)
+        tbody = node.css("tbody")[0] if node.css("tbody") else node
+        rows = []
+        for tr in [c for c in tbody.children if isinstance(c, Element) and c.tag == "tr"]:
+            rows.append([td.text for td in tr.css("td")])
+        return rows
+
+    def row_button(self, table_sel: str, row_match: str, label: str) -> Element:
+        """The action button labeled `label` in the row containing row_match."""
+        node = self.doc.one(table_sel)
+        for tr in node.css("tr"):
+            if row_match in tr.text:
+                for btn in tr.css("button"):
+                    if btn.text == label:
+                        return btn
+        raise AssertionError(f"no {label!r} button in a row matching {row_match!r}")
+
+    def text(self, selector: str) -> str:
+        return self.doc.one(selector).text
+
+    def visible(self, selector: str) -> bool:
+        el = self.doc.one(selector)
+        return el.closest(lambda e: "hidden" in e.attrs) is None
+
+    def tick(self, selector: Optional[str] = None) -> None:
+        """Advance poll cycles (one tick of every — or one — poller)."""
+        if selector:
+            node = self.doc.one(selector)
+            self._pollers[id(node)].tick()
+        else:
+            for p in list(self._pollers.values()):
+                p.tick()
+
+    def poller_interval(self, selector: str) -> int:
+        return self._pollers[id(self.doc.one(selector))].interval
+
+
+class Poller:
+    """kf.poller without timers: exponential backoff, manual ticks
+    (exponential-backoff.ts semantics: double on failure, reset on
+    success, capped at max)."""
+
+    def __init__(self, fn: Callable[[], None], interval: int, max_interval: int = 30000):
+        self.fn = fn
+        self.base = interval
+        self.max = max_interval
+        self.interval = interval
+
+    def tick(self) -> None:
+        try:
+            self.fn()
+            self.interval = self.base
+        except Exception:
+            self.interval = min(self.interval * 2, self.max)

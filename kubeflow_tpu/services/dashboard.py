@@ -161,9 +161,13 @@ def make_dashboard_app(
     @annotate(response="WorkgroupExists")
     def exists(req: Request):
         u = user(req)
+        # Live list, not the informer: registration immediately re-queries
+        # this route after POST /api/workgroup/create (page reload), and a
+        # stale mirror would bounce the new user back to the signup card.
+        # Profiles are small and this route is not a hot poll path.
         owned = [
             apimeta.name_of(p)
-            for p in metrics.cache.list(PROFILE_API, "Profile")
+            for p in client.list(PROFILE_API, "Profile")
             if p.get("spec", {}).get("owner", {}).get("name") == u
         ]
         return {"hasWorkgroup": bool(owned), "user": u, "namespaces": owned,
@@ -180,7 +184,7 @@ def make_dashboard_app(
     @annotate(response="EnvInfo")
     def env_info(req: Request):
         u = user(req)
-        profiles = metrics.cache.list(PROFILE_API, "Profile")
+        profiles = client.list(PROFILE_API, "Profile")  # live: follows registration immediately
         namespaces = []
         for p in profiles:
             ns = apimeta.name_of(p)
@@ -216,7 +220,7 @@ def make_dashboard_app(
         if not authorizer.is_cluster_admin(user(req)):
             raise HttpError(403, "cluster admin only")
         out = []
-        for p in metrics.cache.list(PROFILE_API, "Profile"):
+        for p in client.list(PROFILE_API, "Profile"):
             ns = apimeta.name_of(p)
             resp = kfam(req, "GET", f"/kfam/v1/bindings?namespace={ns}")
             contributors = [b["user"]["name"] for b in (resp.body or {}).get("bindings", [])]

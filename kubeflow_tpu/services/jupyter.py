@@ -255,7 +255,7 @@ def make_jupyter_app(
         client.delete(NOTEBOOK_API, "Notebook", name, ns)
         return {"status": "deleted"}
 
-    install_cluster_api(app, client, authorizer)
+    install_cluster_api(app, client, authorizer, cache=cache)
     install_apidocs(app)
     install_spa(app, load_ui("jupyter.html"), cfg)
     return app

@@ -20,7 +20,18 @@ from .auth import Authorizer
 from .http import App, HttpError, Request
 
 
-def install_cluster_api(app: App, client: Client, authorizer: Authorizer) -> None:
+def install_cluster_api(app: App, client: Client, authorizer: Authorizer,
+                        cache=None) -> None:
+    # Shell selector reads through the app's shared informer when it has one
+    # (every SPA load hits this); a cache-less app falls back to live lists.
+    reader = cache if cache is not None else client
+
+    @app.route("/api/namespaces")
+    def list_namespaces(req: Request):
+        """List namespaces (shell namespace selector; reference
+        crud_backend api/namespace.py)."""
+        return [apimeta.name_of(n) for n in reader.list("v1", "Namespace")]
+
     @app.route("/api/storageclasses")
     def list_storageclasses(req: Request):
         """List StorageClasses (volumes form storage-class picker)."""

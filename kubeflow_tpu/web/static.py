@@ -23,7 +23,7 @@ def load_ui(name: str) -> str:
     with open(os.path.join(UI_DIR, name)) as f:
         html = f.read()
     for fname, open_tag, close_tag in (
-        ("common.js", "<script>", "</script>"),
+        ("kfui.js", "<script>", "</script>"),
         ("style.css", "<style>", "</style>"),
     ):
         include = f"<!--#include {fname}-->"

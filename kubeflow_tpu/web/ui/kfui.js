@@ -1,0 +1,507 @@
+// kfui — declarative hypermedia runtime for the platform SPAs.
+//
+// The kubeflow-common-lib analog (reference: crud-web-apps/common/frontend/
+// kubeflow-common-lib — resource-table, namespace-select, polling with
+// exponential backoff, confirm-dialog, snack-bar, status icons; and
+// centraldashboard/public/components — cards, charts, manage-users,
+// registration). Re-designed for air-gapped TPU pods: no npm toolchain, no
+// framework — pages declare components and flows with data-kf-* attributes
+// and this ~single-file runtime interprets them. The SAME attributes are
+// interpreted by the Python DOM harness (e2e/uidom.py), so every UI flow is
+// exercised end-to-end in CI without a browser, and here in one.
+//
+// Attribute vocabulary (all templates may use {path.to.field} against the
+// active context: page ns, fetched item, or table row):
+//
+//   data-kf-table="/api/...{ns}.../notebooks"   resource table
+//     data-kf-items="notebooks"                 JSON key of the row array
+//     data-kf-poll="3000"                       poll interval ms (w/ backoff)
+//     data-kf-empty="no notebooks"              empty-state text
+//     + child <template data-kf-row> holding one <tr> with {placeholders}
+//   data-kf-action="POST:/api/...{name}"        button-triggered call
+//     data-kf-body='{"stopped": true}'          JSON body template
+//     data-kf-confirm="Delete {name}?"          confirm dialog first
+//     data-kf-then="refresh:#tbl"               refresh:<sel> | reload | none
+//   data-kf-form="POST:/api/namespaces/{ns}/notebooks"  submit → JSON body
+//     (field names become JSON keys; dots nest: tpus.generation;
+//      data-kf-omit-if="none" drops the field when it holds that value;
+//      data-kf-group="x" wraps following named fields under key x)
+//   data-kf-options="/api/tpus;tpus;generation;{generation}"  select options
+//     data-kf-keep-first                        keep the static first <option>
+//   data-kf-depends="#f-gen"                    re-derive options on change:
+//     data-kf-options="/api/tpus;tpus[generation={dep}].topologies;.;{.}"
+//   data-kf-text="/api/workgroup/exists;user"   fetch → textContent
+//   data-kf-show-if="/api/workgroup/exists;hasWorkgroup;false"  conditional
+//   data-kf-chart="/api/metrics/node;.;node;utilization"  SVG bar chart
+//   data-kf-ns-select                           namespace picker (?ns=)
+//   data-kf-nav="/jupyter/"                     nav links carrying ?ns=
+//
+// Exponential backoff matches the reference's polling/exponential-backoff.ts:
+// interval doubles per consecutive failure up to maxInterval, resets on
+// success.
+"use strict";
+
+(function () {
+  const kf = (window.kfui = {});
+
+  // ---- context + templating ------------------------------------------------
+  kf.ns = function () {
+    return new URLSearchParams(location.search).get("ns") || "kubeflow-user";
+  };
+
+  function lookup(obj, path) {
+    if (path === "." || path === "") return obj;
+    let cur = obj;
+    for (const part of path.split(".")) {
+      if (cur == null) return undefined;
+      cur = cur[part];
+    }
+    return cur;
+  }
+
+  // Placeholders are identifier-shaped ({.}, {ns}, {status.phase}) so JSON
+  // body templates ({"stopped": true}) pass through untouched.
+  function substWith(template, ctx, encode) {
+    return String(template).replace(/\{(\.|[A-Za-z_$][\w$.]*)\}/g, (_, path) => {
+      let v;
+      if (path === "ns") v = kf.ns();
+      else v = path === "." ? ctx : lookup(ctx, path);
+      if (v === undefined || v === null) v = "";
+      return encode ? encode(String(v)) : String(v);
+    });
+  }
+  function subst(template, ctx) { return substWith(template, ctx, null); }
+  // For values substituted INSIDE a JSON body template: escape so quotes and
+  // backslashes in data (e.g. a contributor name) can't break JSON.parse.
+  function substJson(template, ctx) {
+    return substWith(template, ctx, (s) => JSON.stringify(s).slice(1, -1));
+  }
+  kf.subst = subst;
+
+  // items path with one-level filter: "tpus[generation=v5e].topologies"
+  function itemsAt(data, path, ctx) {
+    if (!path || path === ".") return Array.isArray(data) ? data : [];
+    let cur = data;
+    for (const seg of path.split(".")) {
+      if (cur == null) return [];
+      const m = seg.match(/^([^[]*)(?:\[([^=\]]+)=([^\]]*)\])?$/);
+      if (m[1]) cur = lookup(cur, m[1]);
+      if (m[2] !== undefined && Array.isArray(cur)) {
+        const want = subst(m[3], ctx);
+        cur = cur.find((it) => String(lookup(it, m[2])) === want);
+      }
+    }
+    return cur == null ? [] : Array.isArray(cur) ? cur : [cur];
+  }
+  kf.itemsAt = itemsAt;
+
+  // ---- transport (CSRF double-submit, JSON, error surfacing) ---------------
+  function cookie(name) {
+    const m = document.cookie.match(new RegExp("(?:^|; )" + name + "=([^;]*)"));
+    return m ? decodeURIComponent(m[1]) : null;
+  }
+
+  kf.api = async function (method, path, body) {
+    // During kf.init several components often bind the same endpoint
+    // (e.g. /api/workgroup/exists drives the user label AND both
+    // conditional views): memoize GETs for the init pass only. Pollers
+    // and actions run after init and always fetch fresh.
+    if (method === "GET" && kf._initMemo) {
+      if (!(path in kf._initMemo)) kf._initMemo[path] = kf._fetch(method, path, body);
+      return kf._initMemo[path];
+    }
+    return kf._fetch(method, path, body);
+  };
+
+  kf._fetch = async function (method, path, body) {
+    const headers = { "content-type": "application/json" };
+    const token = cookie("XSRF-TOKEN");
+    if (token) headers["x-xsrf-token"] = token;
+    const resp = await fetch(path, {
+      method,
+      headers,
+      credentials: "same-origin",
+      body: body === undefined ? undefined : JSON.stringify(body),
+    });
+    const text = await resp.text();
+    let data = null;
+    try { data = text ? JSON.parse(text) : null; } catch (e) { data = text; }
+    if (!resp.ok) {
+      throw new Error((data && data.error) || resp.statusText || "request failed");
+    }
+    return data;
+  };
+
+  // ---- snack bar -----------------------------------------------------------
+  kf.snack = function (message, kind) {
+    let bar = document.getElementById("kf-snack");
+    if (!bar) {
+      bar = document.createElement("div");
+      bar.id = "kf-snack";
+      document.body.append(bar);
+    }
+    bar.textContent = message;
+    bar.className = "show " + (kind || "info");
+    clearTimeout(bar._t);
+    bar._t = setTimeout(() => (bar.className = ""), 4000);
+  };
+
+  // ---- confirm dialog ------------------------------------------------------
+  kf.confirm = function (message) {
+    return new Promise((resolve) => {
+      let dlg = document.getElementById("kf-confirm");
+      if (!dlg) {
+        dlg = document.createElement("dialog");
+        dlg.id = "kf-confirm";
+        dlg.innerHTML =
+          '<p id="kf-confirm-msg"></p><div class="row">' +
+          '<button id="kf-confirm-no" class="ghost">Cancel</button>' +
+          '<button id="kf-confirm-yes" class="danger">Confirm</button></div>';
+        document.body.append(dlg);
+      }
+      dlg.querySelector("#kf-confirm-msg").textContent = message;
+      dlg.querySelector("#kf-confirm-yes").onclick = () => { dlg.close(); resolve(true); };
+      dlg.querySelector("#kf-confirm-no").onclick = () => { dlg.close(); resolve(false); };
+      dlg.showModal();
+    });
+  };
+
+  // ---- exponential backoff poller (exponential-backoff.ts semantics) -------
+  kf.poller = function (fn, interval, maxInterval) {
+    const base = interval || 3000;
+    const max = maxInterval || 30000;
+    let cur = base;
+    let timer = null;
+    let stopped = false;
+    async function tick() {
+      try {
+        await fn();
+        cur = base; // success resets the backoff
+      } catch (e) {
+        cur = Math.min(cur * 2, max); // failure doubles it
+      }
+      if (!stopped) timer = setTimeout(tick, cur);
+    }
+    tick();
+    return {
+      stop() { stopped = true; clearTimeout(timer); },
+      get interval() { return cur; },
+    };
+  };
+
+  // ---- component: resource table -------------------------------------------
+  function initTable(node) {
+    const url = node.getAttribute("data-kf-table");
+    const itemsPath = node.getAttribute("data-kf-items") || ".";
+    const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
+    const emptyText = node.getAttribute("data-kf-empty") || "none";
+    const template = node.querySelector("template[data-kf-row]");
+    const tbody = node.querySelector("tbody") || node;
+
+    function render(data) {
+      const rows = itemsAt(data, itemsPath, {});
+      tbody.replaceChildren();
+      if (!rows.length) {
+        const tr = document.createElement("tr");
+        const td = document.createElement("td");
+        td.className = "empty";
+        td.colSpan = (node.querySelectorAll("thead th") || []).length || 1;
+        td.textContent = emptyText;
+        tr.append(td);
+        tbody.append(tr);
+        return;
+      }
+      for (const row of rows) {
+        const frag = template.content.cloneNode(true);
+        materialize(frag, row);
+        tbody.append(frag);
+      }
+    }
+    async function refresh() {
+      render(await kf.api("GET", subst(url, {})));
+    }
+    node._kfRender = render;
+    node._kfRefresh = refresh;
+    refresh().catch((e) => kf.snack(String(e.message || e), "error"));
+    if (pollMs > 0) node._kfPoller = kf.poller(refresh, pollMs);
+  }
+
+  // Substitute {placeholders} into a cloned row fragment and wire actions.
+  function materialize(fragment, ctx) {
+    const walker = document.createTreeWalker(fragment, NodeFilter.SHOW_TEXT);
+    const texts = [];
+    while (walker.nextNode()) texts.push(walker.currentNode);
+    for (const t of texts) t.textContent = subst(t.textContent, ctx);
+    for (const eln of fragment.querySelectorAll("*")) {
+      for (const attr of [...eln.attributes]) {
+        if (!attr.value.includes("{")) continue;
+        // Body templates are JSON: substituted values must be escaped so
+        // quotes/backslashes in data can't break JSON.parse at click time.
+        const fill = attr.name === "data-kf-body" ? substJson : subst;
+        eln.setAttribute(attr.name, fill(attr.value, ctx));
+      }
+      // show-when="{expr}"=value : remove the element unless it matches
+      const showWhen = eln.getAttribute("data-kf-show-when");
+      if (showWhen !== null) {
+        const [got, want] = showWhen.split("==");
+        if (got !== want) { eln.remove(); continue; }
+      }
+      const hideWhen = eln.getAttribute("data-kf-hide-when");
+      if (hideWhen !== null) {
+        const [got, want] = hideWhen.split("==");
+        if (got === want) { eln.remove(); continue; }
+      }
+      if (eln.hasAttribute("data-kf-action")) wireAction(eln, ctx);
+    }
+  }
+
+  // ---- component: action buttons -------------------------------------------
+  function wireAction(btn, ctx) {
+    btn.addEventListener("click", async (ev) => {
+      ev.preventDefault();
+      const [method, ...rest] = btn.getAttribute("data-kf-action").split(":");
+      const url = subst(rest.join(":"), ctx || {});
+      const confirmTpl = btn.getAttribute("data-kf-confirm");
+      if (confirmTpl && !(await kf.confirm(subst(confirmTpl, ctx || {})))) return;
+      try {
+        let body;
+        const bodyTpl = btn.getAttribute("data-kf-body");
+        if (bodyTpl) body = JSON.parse(substJson(bodyTpl, ctx || {}));
+        const result = await kf.api(method, url, body);
+        kf.snack(btn.getAttribute("data-kf-done") || "done", "ok");
+        runThen(btn.getAttribute("data-kf-then"), result);
+      } catch (e) {
+        kf.snack(String(e.message || e), "error");
+      }
+    });
+  }
+
+  function runThen(thenSpec, result) {
+    if (!thenSpec || thenSpec === "none") return;
+    for (const step of thenSpec.split(",")) {
+      const [verb, arg] = step.split(":");
+      if (verb === "refresh") {
+        const target = document.querySelector(arg);
+        if (target && target._kfRefresh) {
+          target._kfRefresh().catch(() => {});
+        } else if (target && target._kfInit) {
+          target._kfInit().catch(() => {});
+        }
+      } else if (verb === "render") {
+        // Render the MUTATION's own response into the target collection —
+        // the server already computed the post-write view (with its
+        // read-your-writes barrier), so a refetch here would only race
+        // the informer mirror.
+        const target = document.querySelector(arg);
+        if (target && target._kfRender) target._kfRender(result);
+      } else if (verb === "reload") {
+        location.reload();
+      } else if (verb === "nav") {
+        location.href = subst(arg, {});
+      } else if (verb === "clear") {
+        const form = document.querySelector(arg);
+        if (form) form.reset();
+      }
+    }
+  }
+
+  // ---- component: forms ----------------------------------------------------
+  function formBody(form) {
+    const body = {};
+    for (const field of form.querySelectorAll("[name]")) {
+      if (field.disabled) continue;
+      let value;
+      if (field.tagName === "SELECT" && field.multiple) {
+        value = [...field.selectedOptions].map((o) => o.value);
+      } else if (field.type === "checkbox") {
+        value = field.checked;
+      } else if (field.type === "number") {
+        value = field.value === "" ? "" : Number(field.value);
+      } else {
+        value = field.value;
+      }
+      const omitIf = field.getAttribute("data-kf-omit-if");
+      if (omitIf !== null && String(value) === omitIf) continue;
+      if (value === "" && field.hasAttribute("data-kf-omit-empty")) continue;
+      const path = field.getAttribute("name").split(".");
+      let cur = body;
+      for (const seg of path.slice(0, -1)) cur = cur[seg] = cur[seg] || {};
+      cur[path[path.length - 1]] = value;
+    }
+    return body;
+  }
+  kf.formBody = formBody;
+
+  function initForm(form) {
+    form.addEventListener("submit", async (ev) => {
+      ev.preventDefault();
+      const [method, ...rest] = form.getAttribute("data-kf-form").split(":");
+      const url = subst(rest.join(":"), {});
+      try {
+        const result = await kf.api(method, url, formBody(form));
+        kf.snack(form.getAttribute("data-kf-done") || "created", "ok");
+        runThen(form.getAttribute("data-kf-then"), result);
+      } catch (e) {
+        kf.snack(String(e.message || e), "error");
+      }
+    });
+  }
+
+  // ---- component: data-driven selects / text / visibility ------------------
+  async function initOptions(sel) {
+    const [url, itemsPath, valuePath, labelTpl] =
+      sel.getAttribute("data-kf-options").split(";");
+    const depSel = sel.getAttribute("data-kf-depends");
+    const load = async () => {
+      const dep = depSel ? (document.querySelector(depSel) || {}).value : undefined;
+      const ctx = { dep: dep === undefined ? "" : dep };
+      const data = await kf.api("GET", subst(url, ctx));
+      const items = itemsAt(data, subst(itemsPath, ctx), ctx);
+      const keep = sel.hasAttribute("data-kf-keep-first") && sel.options.length
+        ? [sel.options[0]] : [];
+      sel.replaceChildren(...keep);
+      for (const item of items) {
+        const opt = document.createElement("option");
+        opt.value = valuePath === "." ? String(item) : String(lookup(item, valuePath));
+        opt.textContent = labelTpl ? subst(labelTpl, item) : opt.value;
+        sel.append(opt);
+      }
+      sel.disabled = items.length === 0 && !keep.length;
+    };
+    sel._kfInit = load;
+    await load().catch(() => {});
+    if (depSel) {
+      const dep = document.querySelector(depSel);
+      if (dep) dep.addEventListener("change", () => load().catch(() => {}));
+    }
+  }
+
+  async function initText(node) {
+    const [url, path, tpl] = node.getAttribute("data-kf-text").split(";");
+    const load = async () => {
+      if (!url) { // static template against the page context (e.g. {ns})
+        node.textContent = subst(tpl || "", {});
+        return;
+      }
+      const data = await kf.api("GET", subst(url, {}));
+      node.textContent = tpl ? subst(tpl, data) : String(lookup(data, path) ?? "");
+    };
+    node._kfInit = load;
+    await load().catch(() => {});
+  }
+
+  async function initShowIf(node) {
+    const [url, path, want] = node.getAttribute("data-kf-show-if").split(";");
+    const load = async () => {
+      const data = await kf.api("GET", subst(url, {}));
+      const got = String(lookup(data, path));
+      node.style.display = got === want ? "" : "none";
+      node.toggleAttribute("hidden", got !== want);
+    };
+    node._kfInit = load;
+    await load().catch(() => {});
+  }
+
+  // ---- component: SVG bar chart (resource-chart.js analog) -----------------
+  async function initChart(node) {
+    const [url, itemsPath, labelPath, valuePath] =
+      node.getAttribute("data-kf-chart").split(";");
+    const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
+    const load = async () => {
+      const data = await kf.api("GET", subst(url, {}));
+      const items = itemsAt(data, itemsPath, {});
+      const W = 320, BAR = 18, GAP = 6;
+      const H = items.length * (BAR + GAP) || BAR;
+      const svgNS = "http://www.w3.org/2000/svg";
+      const svg = document.createElementNS(svgNS, "svg");
+      svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+      svg.setAttribute("class", "kf-chart");
+      items.forEach((item, i) => {
+        const value = Number(lookup(item, valuePath)) || 0;
+        const frac = Math.max(0, Math.min(1, value));
+        const y = i * (BAR + GAP);
+        const bg = document.createElementNS(svgNS, "rect");
+        bg.setAttribute("x", "120"); bg.setAttribute("y", String(y));
+        bg.setAttribute("width", String(W - 120)); bg.setAttribute("height", String(BAR));
+        bg.setAttribute("class", "kf-bar-bg");
+        const bar = document.createElementNS(svgNS, "rect");
+        bar.setAttribute("x", "120"); bar.setAttribute("y", String(y));
+        bar.setAttribute("width", String((W - 120) * frac));
+        bar.setAttribute("height", String(BAR));
+        bar.setAttribute("class", "kf-bar");
+        const label = document.createElementNS(svgNS, "text");
+        label.setAttribute("x", "0"); label.setAttribute("y", String(y + BAR - 4));
+        label.setAttribute("class", "kf-bar-label");
+        label.textContent = String(lookup(item, labelPath) ?? "");
+        const pct = document.createElementNS(svgNS, "text");
+        pct.setAttribute("x", String(W - 4)); pct.setAttribute("y", String(y + BAR - 4));
+        pct.setAttribute("text-anchor", "end");
+        pct.setAttribute("class", "kf-bar-pct");
+        pct.textContent = Math.round(frac * 100) + "%";
+        svg.append(bg, bar, label, pct);
+      });
+      node.replaceChildren(svg);
+    };
+    node._kfRefresh = load;
+    await load().catch(() => {});
+    if (pollMs > 0) node._kfPoller = kf.poller(load, pollMs);
+  }
+
+  // ---- component: namespace selector (namespace-selector.js analog) --------
+  async function initNsSelect(sel) {
+    const data = await kf.api("GET", "/api/namespaces").catch(() => []);
+    const namespaces = Array.isArray(data) ? data : [];
+    sel.replaceChildren();
+    for (const ns of namespaces) {
+      const opt = document.createElement("option");
+      opt.value = ns; opt.textContent = ns;
+      sel.append(opt);
+    }
+    const current = kf.ns();
+    if (namespaces.includes(current)) sel.value = current;
+    sel.addEventListener("change", () => {
+      const u = new URL(location.href);
+      u.searchParams.set("ns", sel.value);
+      location.href = u.toString();
+    });
+  }
+
+  function initNavLinks() {
+    for (const a of document.querySelectorAll("[data-kf-nav]")) {
+      const target = a.getAttribute("data-kf-nav");
+      a.setAttribute("href", target + "?ns=" + encodeURIComponent(kf.ns()));
+    }
+  }
+
+  // ---- boot ----------------------------------------------------------------
+  kf.init = async function (root) {
+    root = root || document;
+    kf._initMemo = {};
+    try {
+      await kf._initAll(root);
+    } finally {
+      kf._initMemo = null;
+    }
+  };
+
+  kf._initAll = async function (root) {
+    initNavLinks();
+    for (const n of root.querySelectorAll("[data-kf-ns-select]")) await initNsSelect(n);
+    for (const n of root.querySelectorAll("[data-kf-options]")) await initOptions(n);
+    for (const n of root.querySelectorAll("[data-kf-text]")) await initText(n);
+    for (const n of root.querySelectorAll("[data-kf-show-if]")) await initShowIf(n);
+    for (const n of root.querySelectorAll("[data-kf-chart]")) await initChart(n);
+    for (const n of root.querySelectorAll("[data-kf-table]")) initTable(n);
+    for (const n of root.querySelectorAll("form[data-kf-form]")) initForm(n);
+    // page-level action buttons (row-level ones are wired by materialize)
+    for (const n of root.querySelectorAll("[data-kf-action]")) {
+      if (!n.closest("template") && !n._kfWired) { n._kfWired = true; wireAction(n, {}); }
+    }
+  };
+
+  if (document.readyState === "loading") {
+    document.addEventListener("DOMContentLoaded", () => kf.init());
+  } else {
+    kf.init();
+  }
+})();

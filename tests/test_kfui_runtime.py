@@ -1,0 +1,151 @@
+"""Structural checks on the kfui browser runtime.
+
+CI has no JS engine (SURVEY: CPU-only, air-gapped image), so the runtime's
+BEHAVIOR is pinned by executing the identical attribute semantics in Python
+(e2e/uidom.py, exercised by tests/test_ui_dom.py). What Python cannot do is
+parse JavaScript — this file closes the cheapest failure mode instead: a
+lexer that understands JS strings, template literals, comments and regex
+literals verifies every brace/bracket/paren in kfui.js balances, and a few
+greppable invariants keep the runtime generic (no app logic creep).
+"""
+
+import re
+from pathlib import Path
+
+KFUI = Path(__file__).resolve().parent.parent / "kubeflow_tpu" / "web" / "ui" / "kfui.js"
+
+
+def lex_structure(src: str):
+    """Yield structural delimiters, skipping strings/comments/regex."""
+    i, n = 0, len(src)
+    out = []
+    last_significant = ""
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if c == "/" and nxt == "/":
+            i = src.find("\n", i)
+            i = n if i == -1 else i
+            continue
+        if c == "/" and nxt == "*":
+            i = src.find("*/", i)
+            assert i != -1, "unterminated block comment"
+            i += 2
+            continue
+        if c in "'\"":
+            q = c
+            i += 1
+            while i < n and src[i] != q:
+                i += 2 if src[i] == "\\" else 1
+            assert i < n, f"unterminated {q} string"
+            i += 1
+            last_significant = q
+            continue
+        if c == "`":
+            i += 1
+            while i < n and src[i] != "`":
+                if src[i] == "\\":
+                    i += 2
+                elif src[i] == "$" and i + 1 < n and src[i + 1] == "{":
+                    # template expression: lex it recursively via brace depth
+                    depth = 1
+                    i += 2
+                    while i < n and depth:
+                        if src[i] == "{":
+                            depth += 1
+                        elif src[i] == "}":
+                            depth -= 1
+                        i += 1
+                else:
+                    i += 1
+            assert i < n, "unterminated template literal"
+            i += 1
+            last_significant = "`"
+            continue
+        if c == "/":
+            # regex literal iff the previous significant token can't end an
+            # expression (standard heuristic)
+            if last_significant in "" or last_significant in "=([{,;:!&|?+-*%<>~^":
+                i += 1
+                in_class = False
+                while i < n and (src[i] != "/" or in_class):
+                    if src[i] == "\\":
+                        i += 1
+                    elif src[i] == "[":
+                        in_class = True
+                    elif src[i] == "]":
+                        in_class = False
+                    i += 1
+                assert i < n, "unterminated regex literal"
+                i += 1
+                while i < n and src[i].isalpha():
+                    i += 1  # flags
+                last_significant = "/"
+                continue
+            last_significant = "/"
+            i += 1
+            continue
+        if c in "(){}[]":
+            out.append((c, i))
+        if not c.isspace():
+            last_significant = c
+        i += 1
+    return out
+
+
+def test_kfui_delimiters_balance():
+    src = KFUI.read_text()
+    stack = []
+    pairs = {")": "(", "}": "{", "]": "["}
+    for tok, pos in lex_structure(src):
+        if tok in "({[":
+            stack.append((tok, pos))
+        else:
+            assert stack, f"unmatched {tok!r} at byte {pos}"
+            opener, opos = stack.pop()
+            assert opener == pairs[tok], (
+                f"mismatched {opener!r}@{opos} closed by {tok!r}@{pos}"
+            )
+    assert not stack, f"unclosed {stack[-1][0]!r} at byte {stack[-1][1]}"
+
+
+def test_kfui_stays_generic():
+    """The runtime must hold NO app logic — that is the property that makes
+    the Python harness's coverage transfer to the browser. Any /api/ URL or
+    resource-specific name creeping into kfui.js breaks the equivalence."""
+    # check code, not the attribute-vocabulary doc comment at the top
+    src = "\n".join(
+        line for line in KFUI.read_text().splitlines()
+        if not line.lstrip().startswith("//")
+    )
+    for word in ("notebook", "tensorboard", "pvcs", "contributor", "workgroup",
+                 "poddefault", "spawn"):
+        assert word not in src.lower(), f"app concept {word!r} leaked into the runtime"
+    # the single generic endpoint the shell's namespace selector needs
+    urls = re.findall(r'"(/api/[^"]*)"', src)
+    assert urls == ["/api/namespaces"], urls
+
+
+def test_kfui_and_harness_share_the_placeholder_grammar():
+    """The template-placeholder regex must be literally identical in both
+    interpreters, or browser and CI would disagree on what substitutes."""
+    js = KFUI.read_text()
+    py = (Path(__file__).resolve().parent.parent / "e2e" / "uidom.py").read_text()
+    js_rx = re.search(r"replace\(/(.+?)/g", js).group(1)
+    py_rx = re.search(r're\.sub\(r"(.+?)", repl', py).group(1)
+    assert js_rx.replace("$", "") == py_rx.replace("$", ""), (js_rx, py_rx)
+
+
+def test_pages_declare_every_flow_verdict_requires():
+    """VERDICT r2 #2's checklist, greppable: spawn w/ topology, stop/start,
+    delete, add/remove contributor, register workgroup, charts, backoff."""
+    ui = KFUI.parent
+    jupyter = (ui / "jupyter.html").read_text()
+    dashboard = (ui / "dashboard.html").read_text()
+    assert 'data-kf-depends="#f-tpu-gen"' in jupyter  # topology picker
+    assert '"stopped": true' in jupyter and '"stopped": false' in jupyter
+    assert "data-kf-confirm" in jupyter  # delete confirm
+    assert "add-contributor" in dashboard and "remove-contributor" in dashboard
+    assert "/api/workgroup/create" in dashboard  # registration
+    assert "data-kf-chart" in dashboard  # TPU duty-cycle chart
+    assert "cur * 2" in KFUI.read_text()  # exponential backoff lives in the lib

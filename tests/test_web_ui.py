@@ -1,11 +1,13 @@
-"""Web UI plane: SPA index contract + page/API coherence.
+"""Web UI plane: SPA index contract + declarative page/API coherence.
 
 The reference serves Angular/Polymer SPAs through crud_backend's
 ``serving.py`` (ETag + no-cache + CSRF refresh — :18-31); these tests pin
-that contract for every app and check each page's embedded client actually
-targets the API routes its backend registers (no browser/node in CI, so
-coherence is asserted at the HTTP + source level; field names are covered
-by comparing against the live list responses).
+that contract for every app. The pages themselves are declarative
+(data-kf-* attributes interpreted by the kfui runtime), which makes
+UI↔backend coherence machine-checkable: every URL template a page declares
+must match a registered route, and every {placeholder} a row template
+renders must be a field the backend actually emits. Full interaction flows
+are covered DOM-level in tests/test_ui_dom.py.
 """
 
 import re
@@ -23,8 +25,17 @@ from kubeflow_tpu.services.tensorboards import make_tensorboards_app
 from kubeflow_tpu.services.volumes import make_volumes_app
 from kubeflow_tpu.web.auth import AuthConfig
 
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from e2e.uidom import parse_html  # noqa: E402
+
 AUTH = AuthConfig(disable_auth=True, cluster_admins=["anonymous@kubeflow.org"])
 HDRS = {"kubeflow-userid": "anonymous@kubeflow.org"}
+
+URL_ATTRS = ("data-kf-table", "data-kf-form", "data-kf-action", "data-kf-options",
+             "data-kf-chart", "data-kf-text", "data-kf-show-if")
 
 
 def apps():
@@ -36,6 +47,25 @@ def apps():
         "tensorboards": make_tensorboards_app(client, AUTH),
         "volumes": make_volumes_app(client, AUTH),
     }
+
+
+def declared_urls(doc):
+    """Every URL template any kfui component on the page will fetch."""
+    urls = set()
+    for el in doc.css("*") + [doc]:
+        for attr in URL_ATTRS:
+            raw = el.attrs.get(attr) if hasattr(el, "attrs") else None
+            if not raw:
+                continue
+            spec = raw.split(";")[0]
+            if attr in ("data-kf-action", "data-kf-form"):
+                spec = spec.partition(":")[2] or spec  # strip METHOD:
+            if spec.startswith("/"):
+                urls.add(spec)
+    # templates are excluded from walk(); pull their content too
+    for tpl in doc.css("template"):
+        urls |= declared_urls(tpl)
+    return urls
 
 
 class TestSpaContract:
@@ -51,78 +81,115 @@ class TestSpaContract:
         # conditional revalidation → 304 without a body
         r304 = app.call("GET", "/", headers={**HDRS, "if-none-match": r.headers["ETag"]})
         assert r304.status == 304 and r304.encode() == b""
-        # shared runtime + styles are inlined (single-file page, no asset routes)
-        assert "async function api(" in r.body and "--brand" in r.body
+        # the kfui runtime + styles are inlined (single-file page, no asset routes)
+        assert "window.kfui" in r.body and "--pri" in r.body
 
-    def test_pages_reference_only_registered_api_routes(self):
-        """Every /api/... path the page's JS fetches must exist in the app's
-        route table (catches UI/backend drift without a browser)."""
-        for name, app in apps().items():
-            html = app.call("GET", "/", headers=HDRS).body
-            registered = [rx for method, pattern, rx, fn in app._routes]
-            for path in set(re.findall(r'"(/(?:api|kfam)/[^"$]*?)"', html)):
-                # template literals (`/api/namespaces/${NS}/...`) are matched
-                # separately below; plain strings here
-                assert any(rx.match(path) for rx in registered), (name, path)
-            for tmpl in set(re.findall(r"`(/(?:api|kfam)/[^`]*)`", html)):
-                probe = re.sub(r"\$\{[^}]*\}", "x", tmpl).split("?")[0]
-                assert any(rx.match(probe) for rx in registered), (name, tmpl)
+    @pytest.mark.parametrize("name", ["jupyter", "dashboard", "tensorboards", "volumes"])
+    def test_pages_reference_only_registered_api_routes(self, name):
+        """Every URL template the page declares must match a registered
+        route (catches UI/backend drift without a browser)."""
+        app = apps()[name]
+        html = app.call("GET", "/", headers=HDRS).body
+        registered = [rx for method, pattern, rx, fn in app._routes]
+        doc = parse_html(html)
+        urls = declared_urls(doc)
+        assert urls, f"{name}: page declares no kfui components"
+        for url in urls:
+            probe = re.sub(r"\{[^}]*\}", "x", url).split("?")[0]
+            assert any(rx.match(probe) for rx in registered), (name, url)
+
+    @pytest.mark.parametrize("name", ["jupyter", "tensorboards", "volumes"])
+    def test_nav_links_point_at_sibling_apps(self, name):
+        html = apps()[name].call("GET", "/", headers=HDRS).body
+        doc = parse_html(html)
+        navs = {el.attrs["data-kf-nav"] for el in doc.css("[data-kf-nav]")}
+        assert navs, f"{name}: no nav links"
+        assert navs <= {"/", "/jupyter/", "/tensorboards/", "/volumes/"}
+
+    def test_dashboard_menu_is_driven_by_dashboard_links(self):
+        """The shell menu renders /api/dashboard-links (admin-configurable
+        ConfigMap) — every configured entry, Katib and Serving included."""
+        app = apps()["dashboard"]
+        doc = parse_html(app.call("GET", "/", headers=HDRS).body)
+        menu = doc.one("#menu")
+        assert menu.attrs["data-kf-table"] == "/api/dashboard-links"
+        assert menu.attrs["data-kf-items"] == "menuLinks"
+        # and the endpoint still serves the full default menu
+        links = app.call("GET", "/api/dashboard-links", headers=HDRS).body
+        texts = [l["text"] for l in links["menuLinks"]]
+        assert "Experiments (HPO)" in texts and "Model Serving" in texts
+
+
+def row_placeholders(doc, table_sel):
+    """{placeholders} a table's row template renders (text + attributes)."""
+    table = doc.one(table_sel)
+    tpl = table.one("template[data-kf-row]")
+    found = set()
+
+    def collect(el):
+        for c in el.children:
+            if isinstance(c, str):
+                found.update(re.findall(r"\{(\.|[A-Za-z_$][\w$.]*)\}", c))
+            else:
+                for v in c.attrs.values():
+                    found.update(re.findall(r"\{(\.|[A-Za-z_$][\w$.]*)\}", v))
+                collect(c)
+
+    collect(tpl)
+    return found - {"ns"}
 
 
 class TestUiBackendCoherence:
-    def test_jupyter_page_fields_match_list_response(self):
-        """The table renderers read exactly the fields the backend emits."""
+    """Row templates may only reference fields the backend really emits."""
+
+    def test_jupyter_row_template_fields(self):
         mgr = build_platform().start()
         try:
             mgr.client.create(new_object("v1", "Namespace", "ui-ns"))
             app = make_jupyter_app(mgr.client, auth=AUTH)
-            mgr.client.create(
-                new_object(
-                    "kubeflow.org/v1beta1",
-                    "Notebook",
-                    "nb1",
-                    "ui-ns",
-                    spec={"template": {"spec": {"containers": [{"name": "nb1", "image": "img"}]}}},
-                )
-            )
+            mgr.client.create(new_object(
+                "kubeflow.org/v1beta1", "Notebook", "nb1", "ui-ns",
+                spec={"template": {"spec": {"containers": [{"name": "nb1", "image": "img"}]}}},
+            ))
             assert mgr.wait_idle(10)
             nbs = app.call("GET", "/api/namespaces/ui-ns/notebooks", headers=HDRS).body["notebooks"]
-            html = app.call("GET", "/", headers=HDRS).body
-            for field in ("name", "image", "tpu", "status"):
-                assert field in nbs[0], field
-                assert re.search(rf"nb\.{field}\b", html), f"UI never renders {field}"
-            assert nbs[0]["status"]["phase"]  # statusBadge(nb.status.phase)
+            doc = parse_html(app.call("GET", "/", headers=HDRS).body)
+            for ph in row_placeholders(doc, "#nb-table"):
+                root = ph.split(".")[0]
+                assert root == "." or root in nbs[0], f"UI renders unknown field {ph}"
         finally:
             mgr.stop()
 
-    def test_volumes_page_fields_match_list_response(self):
+    def test_volumes_row_template_fields(self):
         client = Client(Store())
         app = make_volumes_app(client, AUTH)
-        app.call(
-            "POST",
-            "/api/namespaces/ui-ns/pvcs",
-            {"name": "v1", "size": "5Gi", "mode": "ReadWriteOnce", "class": "{none}"},
-            headers=HDRS,
-        )
+        app.call("POST", "/api/namespaces/ui-ns/pvcs",
+                 {"name": "v1", "size": "5Gi", "mode": "ReadWriteOnce", "class": "{none}"},
+                 headers=HDRS)
         pvcs = app.call("GET", "/api/namespaces/ui-ns/pvcs", headers=HDRS).body["pvcs"]
-        html = app.call("GET", "/", headers=HDRS).body
-        for field in ("name", "capacity", "modes", "class", "inUse"):
-            assert field in pvcs[0], field
-            assert re.search(rf"p\.{field}\b", html), f"UI never renders {field}"
+        doc = parse_html(app.call("GET", "/", headers=HDRS).body)
+        for ph in row_placeholders(doc, "#pvc-table"):
+            root = ph.split(".")[0]
+            assert root == "." or root in pvcs[0], f"UI renders unknown field {ph}"
 
-    def test_tensorboards_page_fields_match_list_response(self):
+    def test_tensorboards_row_template_fields(self):
         client = Client(Store())
         app = make_tensorboards_app(client, AUTH)
-        app.call(
-            "POST",
-            "/api/namespaces/ui-ns/tensorboards",
-            {"name": "t1", "logspath": "pvc://w/logs"},
-            headers=HDRS,
-        )
-        tbs = app.call("GET", "/api/namespaces/ui-ns/tensorboards", headers=HDRS).body[
-            "tensorboards"
-        ]
-        html = app.call("GET", "/", headers=HDRS).body
-        for field in ("name", "logspath", "ready"):
-            assert field in tbs[0], field
-            assert re.search(rf"t\.{field}\b", html), f"UI never renders {field}"
+        app.call("POST", "/api/namespaces/ui-ns/tensorboards",
+                 {"name": "t1", "logspath": "pvc://w/logs"}, headers=HDRS)
+        tbs = app.call("GET", "/api/namespaces/ui-ns/tensorboards", headers=HDRS).body["tensorboards"]
+        doc = parse_html(app.call("GET", "/", headers=HDRS).body)
+        for ph in row_placeholders(doc, "#tb-table"):
+            root = ph.split(".")[0]
+            assert root == "." or root in tbs[0], f"UI renders unknown field {ph}"
+
+    def test_spawn_form_fields_match_backend_contract(self):
+        """Every named field the spawner form submits is a key the backend's
+        SpawnForm contract knows (names with dots nest: tpus.generation)."""
+        app = apps()["jupyter"]
+        doc = parse_html(app.call("GET", "/", headers=HDRS).body)
+        form = doc.one("#spawn-form")
+        known = {"name", "image", "cpu", "memory", "tpus", "workspaceVolume",
+                 "dataVolumes", "configurations", "shm"}
+        for field in form.css("[name]"):
+            assert field.attrs["name"].split(".")[0] in known, field.attrs["name"]
