@@ -21,15 +21,29 @@ import jax
 import jax.numpy as jnp
 import optax
 
+try:  # persistent compile cache: tunnel compiles run 20-50 s
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax-cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 TARGET_MFU = 0.60
 
 
 def _batch_candidates() -> list:
+    # 512 is viable again: the round-1 "batch-512 hang" was the image batch
+    # being a closure constant — serialized into the remote-compile request
+    # body (308 MiB at 512; the backend 413s past ~256 MiB). Data is now a
+    # jitted ARGUMENT, so the compile payload is shape-only.
+    # 256 first: it measures marginally better than 512 on this chip
+    # (2507 vs 2417 img/s — batch 512 spills more activations), and the
+    # first batch that completes is the headline.
     try:
         override = os.environ.get("BENCH_BATCH")
-        return [int(override)] if override else [256, 128, 64, 32]
+        return [int(override)] if override else [256, 512, 128, 64, 32]
     except ValueError:
-        return [256, 128, 64, 32]
+        return [256, 512, 128, 64, 32]
 
 
 def _timed_steps() -> int:
@@ -72,11 +86,13 @@ def _bench(batch: int):
     # dispatch covers the whole window, so per-dispatch/tunnel latency and
     # async-dispatch artifacts cannot distort the measurement. The fetched
     # outputs depend on the LAST step's update (param checksum) and loss,
-    # so no step can be dead-code-eliminated.
+    # so no step can be dead-code-eliminated. Images/labels are ARGUMENTS —
+    # a closure-captured batch is serialized into the remote-compile request
+    # on this backend (413 past ~256 MiB; hung batch 512 in round 1).
     timed_steps = _timed_steps()
 
     @jax.jit
-    def run_steps(state):
+    def run_steps(state, images, labels):
         def body(s, _):
             s2, metrics = step(s, images, labels)
             return s2, metrics["loss"]
@@ -87,11 +103,11 @@ def _bench(batch: int):
     # Warmup: compile + one full execution, forced to completion by the
     # host fetch (block_until_ready alone can be a no-op on proxied
     # backends).
-    loss, checksum = run_steps(state)
+    loss, checksum = run_steps(state, images, labels)
     _ = (float(loss), float(checksum))
 
     t0 = time.perf_counter()
-    loss, checksum = run_steps(state)
+    loss, checksum = run_steps(state, images, labels)
     loss, checksum = float(loss), float(checksum)  # host fetch = real barrier
     total = time.perf_counter() - t0
     import math
@@ -111,8 +127,102 @@ def _bench(batch: int):
     }
 
 
+def _bench_gpt(batch: int, seq: int):
+    """GPT-2-medium-class causal LM train step (AdamW, bf16 compute, Pallas
+    flash attention). The matmul-dominated counterpart to the ResNet row:
+    its op mix runs near the measured 175 TF/s matmul ceiling
+    (e2e/ceiling.py), so it shows the MFU the framework reaches when the
+    model shape suits the 128x128 MXU — ResNet's 64-wide convs cannot."""
+    import optax as _optax
+
+    from kubeflow_tpu.models.gpt import GptConfig, GptLM, causal_lm_loss
+    from kubeflow_tpu.training import compiled_flops, mfu
+    from kubeflow_tpu.training.flops import detect_generation
+
+    cfg = GptConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+                    max_seq=seq, vocab_size=32000,
+                    remat=os.environ.get("BENCH_REMAT", "0") == "1")
+    model = GptLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    ids = jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)
+    params = model.init(rng, ids)["params"]
+    opt = _optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    timed_steps = _timed_steps()
+
+    def train_step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: causal_lm_loss(model.apply({"params": p}, ids), ids)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return _optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def run_steps(params, opt_state, ids):
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = train_step(p, s, ids)
+            return (p, s), loss
+        (p, s), losses = jax.lax.scan(body, (params, opt_state), None, length=timed_steps)
+        checksum = sum(jnp.sum(x.astype(jnp.float32)) for x in jax.tree_util.tree_leaves(p))
+        return losses[-1], checksum
+
+    flops = None
+    try:
+        flops = compiled_flops(jax.jit(train_step), params, opt_state, ids)
+    except Exception:
+        pass
+    if not flops:
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        flops = 6.0 * n_params * batch * seq  # 6ND
+
+    loss, checksum = run_steps(params, opt_state, ids)
+    _ = (float(loss), float(checksum))
+    t0 = time.perf_counter()
+    loss, checksum = run_steps(params, opt_state, ids)
+    loss, checksum = float(loss), float(checksum)
+    total = time.perf_counter() - t0
+    import math
+
+    if not (math.isfinite(loss) and math.isfinite(checksum)):
+        raise RuntimeError(f"non-finite gpt bench: loss={loss} checksum={checksum}")
+    dt = total / timed_steps
+    gen = detect_generation()
+    return {
+        "tokens_per_sec_per_chip": batch * seq / dt,
+        "step_seconds": dt,
+        "mfu": mfu(flops, dt, num_chips=1, generation=gen),
+        "generation": gen,
+        "batch": batch,
+        "seq": seq,
+    }
+
+
 def main() -> int:
     platform = jax.devices()[0].platform
+    if os.environ.get("BENCH_MODEL") == "serving":
+        from e2e.serving_bench import main as serving_main
+
+        return serving_main()
+    if os.environ.get("BENCH_MODEL") == "gpt":
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        try:
+            r = _bench_gpt(batch, seq)
+            print(json.dumps({
+                "metric": f"gpt2_medium_train_mfu_{r['generation']}_1chip",
+                "value": round(r["mfu"] * 100, 2),
+                "unit": "percent_mfu",
+                "vs_baseline": round(r["mfu"] / TARGET_MFU, 4),
+                "tokens_per_sec_per_chip": round(r["tokens_per_sec_per_chip"], 1),
+                "batch": r["batch"], "seq": r["seq"], "platform": platform,
+            }))
+            return 0
+        except Exception as e:
+            print(json.dumps({"metric": "gpt2_medium_train_mfu", "value": 0.0,
+                              "unit": "percent_mfu", "vs_baseline": 0.0,
+                              "error": str(e)[:200]}))
+            return 1
     last_err = None
     for batch in _batch_candidates():
         try:

@@ -230,7 +230,6 @@ class Page:
         self.location: Optional[str] = None  # navigation sink
         self.reloaded = False
         self._pollers: Dict[int, "Poller"] = {}
-        self._actions: Dict[int, Dict[str, str]] = {}  # element id() -> attrs ctx-resolved
         self.calls: List[Tuple[str, str]] = []  # request log (method, url)
         self.init()
 
@@ -316,6 +315,8 @@ class Page:
             self._init_ns_select(n)
         for n in self.doc.css("[data-kf-options]"):
             self._init_options(n)
+        for n in self.doc.css("[data-kf-value]"):
+            self._init_value(n)
         for n in self.doc.css("[data-kf-text]"):
             self._init_text(n)
         for n in self.doc.css("[data-kf-show-if]"):
@@ -377,6 +378,21 @@ class Page:
             load()
         except RuntimeError:
             pass
+
+    def _init_value(self, node: Element) -> None:
+        """data-kf-value: set a form control's value (and reset default)
+        from config — admin spawner defaults (kfui initValue)."""
+        spec = node.attrs["data-kf-value"].split(";")
+        url, path = spec[0], spec[1] if len(spec) > 1 else ""
+        try:
+            data = self.api("GET", self.subst(url, {}))
+        except RuntimeError:
+            return
+        v = lookup(data, path)
+        if v is None:
+            return
+        node.value = str(v)
+        node._default_value = str(v)
 
     def _init_text(self, node: Element) -> None:
         def load():
@@ -509,9 +525,7 @@ class Page:
                 if got == want:
                     el.remove()
                     continue
-            if "data-kf-action" in el.attrs:
-                # attrs were already ctx-resolved above; click() reads them.
-                self._actions[id(el)] = dict(el.attrs)
+
 
     # -- interactions ----------------------------------------------------------
     def _run_then(self, then_spec: Optional[str], result: Any = None) -> None:
@@ -543,7 +557,8 @@ class Page:
     def click(self, target) -> None:
         """Click an element carrying data-kf-action (row or page level)."""
         el = target if isinstance(target, Element) else self.doc.one(target)
-        attrs = self._actions.get(id(el), el.attrs)
+        # attrs were ctx-resolved in place at materialize time
+        attrs = el.attrs
         action = attrs.get("data-kf-action")
         assert action, f"{el!r} has no data-kf-action"
         method, _, url_tpl = action.partition(":")

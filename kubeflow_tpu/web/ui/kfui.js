@@ -194,7 +194,9 @@
     const url = node.getAttribute("data-kf-table");
     const itemsPath = node.getAttribute("data-kf-items") || ".";
     const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
-    const emptyText = node.getAttribute("data-kf-empty") || "none";
+    // explicit data-kf-empty="" means "render nothing", only absence defaults
+    const emptyText = node.hasAttribute("data-kf-empty")
+      ? node.getAttribute("data-kf-empty") : "none";
     const template = node.querySelector("template[data-kf-row]");
     const tbody = node.querySelector("tbody") || node;
 
@@ -376,6 +378,20 @@
     }
   }
 
+  // data-kf-value="/url;path" — set a form control's value (and its reset
+  // default) from config, e.g. admin spawner defaults. Runs after
+  // data-kf-options so a fetched default can select a fetched option.
+  async function initValue(node) {
+    const [url, path] = node.getAttribute("data-kf-value").split(";");
+    try {
+      const data = await kf.api("GET", subst(url, {}));
+      const v = lookup(data, path);
+      if (v === undefined || v === null) return;
+      node.value = String(v);
+      node.defaultValue = String(v);
+    } catch (e) { /* keep the static default */ }
+  }
+
   async function initText(node) {
     const [url, path, tpl] = node.getAttribute("data-kf-text").split(";");
     const load = async () => {
@@ -488,6 +504,7 @@
     initNavLinks();
     for (const n of root.querySelectorAll("[data-kf-ns-select]")) await initNsSelect(n);
     for (const n of root.querySelectorAll("[data-kf-options]")) await initOptions(n);
+    for (const n of root.querySelectorAll("[data-kf-value]")) await initValue(n);
     for (const n of root.querySelectorAll("[data-kf-text]")) await initText(n);
     for (const n of root.querySelectorAll("[data-kf-show-if]")) await initShowIf(n);
     for (const n of root.querySelectorAll("[data-kf-chart]")) await initChart(n);

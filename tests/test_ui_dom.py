@@ -365,6 +365,30 @@ class TestSharedComponentSemantics:
         page.tick("#nb-table")
         assert any(r[0] == "weird-name" for r in page.table_rows("#nb-table"))
 
+    def test_spawner_form_binds_admin_defaults(self, platform, team_a, auth):
+        """Admin-customized spawnerFormDefaults must drive the form values
+        (data-kf-value), not the HTML's static fallbacks."""
+        from kubeflow_tpu.services.spawner_config import SpawnerConfig
+
+        spawner = SpawnerConfig()
+        spawner.defaults["cpu"]["value"] = "2.0"
+        spawner.defaults["memory"]["value"] = "3.0Gi"
+        spawner.defaults["image"]["value"] = spawner.defaults["image"]["options"][1]
+        jwa = make_jupyter_app(platform.client, auth, spawner=spawner)
+        page = Page(jwa, load_ui("jupyter.html"), ns="team-a",
+                    headers=csrf_headers(jwa, ALICE))
+        assert page.doc.one("#f-cpu").value == "2.0"
+        assert page.doc.one("#f-mem").value == "3.0Gi"
+        assert page.doc.one("#f-image").value == spawner.defaults["image"]["options"][1]
+        # and a spawn with untouched fields submits the admin defaults
+        page.fill("#f-name", "defaults-nb")
+        page.submit("#spawn-form")
+        nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "defaults-nb", "team-a")
+        container = nb["spec"]["template"]["spec"]["containers"][0]
+        assert container["resources"]["requests"]["cpu"] == "2.0"
+        assert container["resources"]["requests"]["memory"] == "3.0Gi"
+        assert container["image"] == spawner.defaults["image"]["options"][1]
+
     def test_form_reset_after_create(self, platform, team_a, auth):
         jwa = make_jupyter_app(platform.client, auth)
         page = Page(jwa, load_ui("jupyter.html"), ns="team-a",
