@@ -40,7 +40,7 @@ except Exception:
 SEQ = 128
 
 
-def bench_bert_http(batches=(1, 8, 32), requests_per_batch: int = 20) -> List[Dict[str, Any]]:
+def bench_bert_http(batches=(1, 8, 32), requests_per_batch: int = 40) -> List[Dict[str, Any]]:
     import urllib.request
 
     from kubeflow_tpu.models.bert import BertConfig, BertForMaskedLM
@@ -82,11 +82,14 @@ def bench_bert_http(batches=(1, 8, 32), requests_per_batch: int = 20) -> List[Di
             request()  # warm: compiles this bucket
             lat = sorted(request() for _ in range(requests_per_batch))
             p50 = statistics.median(lat)
-            p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+            # With 40 samples, index 37 is a real p95; a "p99" here would
+            # just be the max (one tunnel hiccup), so report p95 + max.
+            p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95) - 1)]
             rows.append({
                 "batch": batch,
                 "p50_ms": round(p50 * 1e3, 1),
-                "p99_ms": round(p99 * 1e3, 1),
+                "p95_ms": round(p95 * 1e3, 1),
+                "max_ms": round(lat[-1] * 1e3, 1),
                 "qps": round(1.0 / p50, 2),
                 "sequences_per_sec": round(batch / p50, 1),
             })
@@ -126,9 +129,9 @@ def bench_gpt_decode(batches=(1, 8), prompt_len: int = 128,
 
 def main() -> int:
     bert = bench_bert_http()
-    print(f"{'BERT-base predict (HTTP)':28s} {'p50':>8s} {'p99':>8s} {'seq/s':>8s}")
+    print(f"{'BERT-base predict (HTTP)':28s} {'p50':>8s} {'p95':>8s} {'max':>8s} {'seq/s':>8s}")
     for r in bert:
-        print(f"  batch {r['batch']:<4d}                 {r['p50_ms']:7.1f}ms {r['p99_ms']:7.1f}ms {r['sequences_per_sec']:8.1f}")
+        print(f"  batch {r['batch']:<4d}                 {r['p50_ms']:7.1f}ms {r['p95_ms']:7.1f}ms {r['max_ms']:7.1f}ms {r['sequences_per_sec']:8.1f}")
     gpt = bench_gpt_decode()
     print(f"{'GPT-medium KV-cache decode':28s} {'tok/s':>8s} {'ms/tok':>8s}")
     for r in gpt:

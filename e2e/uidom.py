@@ -596,11 +596,33 @@ class Page:
                 continue
             if value == "" and "data-kf-omit-empty" in field.attrs:
                 continue
+            unless = field.attrs.get("data-kf-omit-unless")
+            if unless:
+                deps = form.css(unless) or self.doc.css(unless)
+                if not deps or not deps[0].value:
+                    continue
+            # Dotted names nest; NUMERIC segments index arrays (kfui parity).
             path = field.attrs["name"].split(".")
-            cur = body
-            for seg in path[:-1]:
-                cur = cur.setdefault(seg, {})
-            cur[path[-1]] = value
+            cur: Any = body
+            for i, seg in enumerate(path[:-1]):
+                want_array = path[i + 1].isdigit()
+                if seg.isdigit():
+                    idx = int(seg)
+                    while len(cur) <= idx:
+                        cur.append([] if want_array else {})
+                    cur = cur[idx]
+                else:
+                    if seg not in cur:
+                        cur[seg] = [] if want_array else {}
+                    cur = cur[seg]
+            leaf = path[-1]
+            if leaf.isdigit():
+                idx = int(leaf)
+                while len(cur) <= idx:
+                    cur.append(None)
+                cur[idx] = value
+            else:
+                cur[leaf] = value
         return body
 
     def submit(self, selector: str) -> None:

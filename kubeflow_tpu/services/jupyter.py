@@ -190,6 +190,24 @@ def make_jupyter_app(
         memory = str(spawner.form_value(form, "memory"))
         tpu = spawner.tpu_of_form(form)
 
+        # Resolve scheduling groups BEFORE any PVC creation: a bad key must
+        # 400 without leaving orphaned volumes behind.
+        affinity = tolerations = None
+        affinity_key = spawner.form_value(form, "affinityConfig")
+        if affinity_key:
+            opt = next((o for o in (spawner.defaults.get("affinityConfig", {}).get("options") or [])
+                        if o.get("configKey") == affinity_key), None)
+            if opt is None:
+                raise HttpError(400, f"unknown affinityConfig {affinity_key!r}")
+            affinity = opt.get("affinity", {})
+        tol_key = spawner.form_value(form, "tolerationGroup")
+        if tol_key:
+            opt = next((o for o in (spawner.defaults.get("tolerationGroup", {}).get("options") or [])
+                        if o.get("groupKey") == tol_key), None)
+            if opt is None:
+                raise HttpError(400, f"unknown tolerationGroup {tol_key!r}")
+            tolerations = opt.get("tolerations", [])
+
         volumes, mounts = [], []
         workspace = spawner.form_value(form, "workspaceVolume")
         for vol in ([workspace] if workspace else []) + list(spawner.form_value(form, "dataVolumes") or []):
@@ -212,9 +230,18 @@ def make_jupyter_app(
             volumes.append({"name": "dshm", "emptyDir": {"medium": "Memory"}})
             container["volumeMounts"] = mounts + [{"name": "dshm", "mountPath": "/dev/shm"}]
 
-        spec: Dict[str, Any] = {
-            "template": {"spec": {"containers": [container], "volumes": volumes}}
-        }
+        pod_spec: Dict[str, Any] = {"containers": [container], "volumes": volumes}
+
+        # Affinity/toleration groups (reference spawner_ui_config.yaml:155-200,
+        # form.py set_notebook_affinity/tolerations), resolved above. TPU
+        # topology selectors are injected by the PodDefault webhook and
+        # merge with these by key.
+        if affinity is not None:
+            pod_spec["affinity"] = affinity
+        if tolerations is not None:
+            pod_spec["tolerations"] = tolerations
+
+        spec: Dict[str, Any] = {"template": {"spec": pod_spec}}
         if tpu:
             spec["tpu"] = tpu
 
@@ -270,6 +297,25 @@ def _ensure_pvc(client: Client, ns: str, nb_name: str, vol: Dict[str, Any]) -> O
     """Create the PVC for a 'new' volume; reference existing ones as-is."""
     if not isinstance(vol, dict):
         return None
+    # Simplified UI shape ({type: new|existing, name, size, mount}) — the
+    # declarative spawner form submits this; the Angular reference builds
+    # the full newPvc object client-side instead.
+    if "name" in vol and "newPvc" not in vol and "existingSource" not in vol and "existing" not in vol:
+        if not vol["name"]:
+            return None
+        if vol.get("type") == "existing":
+            vol = {"existing": vol["name"], "mount": vol.get("mount", "/data")}
+        else:
+            vol = {
+                "newPvc": {
+                    "metadata": {"name": vol["name"]},
+                    "spec": {
+                        "resources": {"requests": {"storage": vol.get("size") or "10Gi"}},
+                        "accessModes": ["ReadWriteOnce"],
+                    },
+                },
+                "mount": vol.get("mount", "/data"),
+            }
     if "existingSource" in vol or "existing" in vol:
         name = vol.get("existing") or (vol.get("existingSource") or {}).get(
             "persistentVolumeClaim", {}

@@ -203,6 +203,8 @@ DEFINITIONS: Dict[str, Dict[str, Any]] = {
             "workspaceVolume": {"type": "object"},
             "dataVolumes": {"type": "array", "items": {"type": "object"}},
             "configurations": {"type": "array", "items": {"type": "string"}},
+            "affinityConfig": {"type": "string"},
+            "tolerationGroup": {"type": "string"},
             "shm": {"type": "boolean"},
         },
         "required": ["name"],

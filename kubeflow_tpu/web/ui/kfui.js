@@ -325,10 +325,37 @@
       const omitIf = field.getAttribute("data-kf-omit-if");
       if (omitIf !== null && String(value) === omitIf) continue;
       if (value === "" && field.hasAttribute("data-kf-omit-empty")) continue;
+      // omit-unless: drop this field while the referenced control is empty
+      // (e.g. a volume's type select only counts once a name is typed).
+      const unless = field.getAttribute("data-kf-omit-unless");
+      if (unless) {
+        const dep = form.querySelector(unless) || document.querySelector(unless);
+        if (!dep || !dep.value) continue;
+      }
+      // Dotted names nest; NUMERIC segments index arrays
+      // (dataVolumes.0.name -> {dataVolumes: [{name: ...}]}).
       const path = field.getAttribute("name").split(".");
       let cur = body;
-      for (const seg of path.slice(0, -1)) cur = cur[seg] = cur[seg] || {};
-      cur[path[path.length - 1]] = value;
+      for (let i = 0; i < path.length - 1; i++) {
+        const seg = path[i];
+        const wantArray = /^\d+$/.test(path[i + 1]);
+        if (/^\d+$/.test(seg)) {
+          const idx = +seg;
+          while (cur.length <= idx) cur.push(wantArray ? [] : {});
+          cur = cur[idx];
+        } else {
+          if (!(seg in cur)) cur[seg] = wantArray ? [] : {};
+          cur = cur[seg];
+        }
+      }
+      const leaf = path[path.length - 1];
+      if (/^\d+$/.test(leaf)) {
+        const idx = +leaf;
+        while (cur.length <= idx) cur.push(null);
+        cur[idx] = value;
+      } else {
+        cur[leaf] = value;
+      }
     }
     return body;
   }
@@ -387,8 +414,18 @@
       const data = await kf.api("GET", subst(url, {}));
       const v = lookup(data, path);
       if (v === undefined || v === null) return;
-      node.value = String(v);
-      node.defaultValue = String(v);
+      const s = String(v);
+      node.value = s;
+      if (node.tagName === "SELECT") {
+        // defaultValue is a no-op on <select>: form.reset() restores
+        // options' defaultSelected, so pin that instead.
+        for (const opt of node.options) {
+          opt.defaultSelected = opt.value === s;
+          opt.selected = opt.value === s;
+        }
+      } else {
+        node.defaultValue = s;
+      }
     } catch (e) { /* keep the static default */ }
   }
 

@@ -196,6 +196,9 @@ class TestDashboardFlows:
         assert page2.visible("#memberships")
         rows = page2.table_rows("#memberships-table")
         assert ["team-alice", "owner"] in rows
+        # quick-links card renders the configured shortcuts
+        quick = page2.table_rows("#quick-links")
+        assert any("Create a new Notebook server" in r[0] for r in quick), quick
 
     def test_contributor_management_flow(self, platform, auth):
         dash = self._dash(platform, auth)
@@ -365,6 +368,56 @@ class TestSharedComponentSemantics:
         page.tick("#nb-table")
         assert any(r[0] == "weird-name" for r in page.table_rows("#nb-table"))
 
+    def test_spawn_with_affinity_toleration_and_data_volume(self, platform, team_a, auth):
+        """Reference parity: affinity/toleration groups from the admin
+        config (spawner_ui_config.yaml:155-200) and a data volume, all
+        selected through the rendered form."""
+        from kubeflow_tpu.services.spawner_config import SpawnerConfig
+
+        spawner = SpawnerConfig()
+        spawner.defaults["affinityConfig"]["options"] = [{
+            "configKey": "tpu-pool",
+            "displayName": "Exclusive: TPU pool",
+            "affinity": {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "node_pool", "operator": "In", "values": ["tpu-v5e"]}]}]}}},
+        }]
+        spawner.defaults["tolerationGroup"]["options"] = [{
+            "groupKey": "preemptible",
+            "displayName": "Preemptible nodes",
+            "tolerations": [{"key": "preemptible", "operator": "Exists", "effect": "NoSchedule"}],
+        }]
+        jwa = make_jupyter_app(platform.client, auth, spawner=spawner)
+        page = Page(jwa, load_ui("jupyter.html"), ns="team-a",
+                    headers=csrf_headers(jwa, ALICE))
+        # the selects list the admin-defined groups by display name
+        labels = [o.text for o in page.doc.one("#f-affinity").css("option")]
+        assert "Exclusive: TPU pool" in labels
+        page.fill("#f-name", "sched-nb")
+        page.select("#f-affinity", "tpu-pool")
+        page.select("#f-tolerations", "preemptible")
+        page.fill("#f-dv-name", "scratch")
+        page.fill("#f-dv-size", "5Gi")
+        page.fill("#f-dv-mount", "/scratch")
+        page.submit("#spawn-form")
+        assert page.snacks[-1][1] == "ok", page.snacks
+        nb = platform.client.get("kubeflow.org/v1beta1", "Notebook", "sched-nb", "team-a")
+        pod_spec = nb["spec"]["template"]["spec"]
+        assert pod_spec["affinity"]["nodeAffinity"]
+        assert pod_spec["tolerations"][0]["key"] == "preemptible"
+        # the data volume PVC exists and is mounted at the chosen path
+        pvc = platform.client.get("v1", "PersistentVolumeClaim", "scratch", "team-a")
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "5Gi"
+        mounts = pod_spec["containers"][0]["volumeMounts"]
+        assert any(m["mountPath"] == "/scratch" for m in mounts)
+
+    def test_unknown_affinity_key_rejected(self, platform, team_a, auth):
+        jwa = make_jupyter_app(platform.client, auth)
+        r = jwa.call("POST", "/api/namespaces/team-a/notebooks",
+                     {"name": "bad", "affinityConfig": "nope"},
+                     csrf_headers(jwa, ALICE))
+        assert r.status == 400
+
     def test_spawner_form_binds_admin_defaults(self, platform, team_a, auth):
         """Admin-customized spawnerFormDefaults must drive the form values
         (data-kf-value), not the HTML's static fallbacks."""
@@ -388,6 +441,15 @@ class TestSharedComponentSemantics:
         assert container["resources"]["requests"]["cpu"] == "2.0"
         assert container["resources"]["requests"]["memory"] == "3.0Gi"
         assert container["image"] == spawner.defaults["image"]["options"][1]
+
+    def test_init_fetches_each_endpoint_once(self, platform, team_a, auth):
+        """Seven controls bind /api/config (options + value binders); the
+        init-phase memo must collapse them into ONE fetch per endpoint."""
+        jwa = make_jupyter_app(platform.client, auth)
+        page = Page(jwa, load_ui("jupyter.html"), ns="team-a",
+                    headers=csrf_headers(jwa, ALICE))
+        config_calls = [c for c in page.calls if c == ("GET", "/api/config")]
+        assert len(config_calls) == 1, page.calls
 
     def test_form_reset_after_create(self, platform, team_a, auth):
         jwa = make_jupyter_app(platform.client, auth)

@@ -190,6 +190,7 @@ class TestUiBackendCoherence:
         doc = parse_html(app.call("GET", "/", headers=HDRS).body)
         form = doc.one("#spawn-form")
         known = {"name", "image", "cpu", "memory", "tpus", "workspaceVolume",
-                 "dataVolumes", "configurations", "shm"}
+                 "dataVolumes", "configurations", "shm", "affinityConfig",
+                 "tolerationGroup"}
         for field in form.css("[name]"):
             assert field.attrs["name"].split(".")[0] in known, field.attrs["name"]
