@@ -607,15 +607,27 @@ class Page:
             for i, seg in enumerate(path[:-1]):
                 want_array = path[i + 1].isdigit()
                 if seg.isdigit():
+                    if not isinstance(cur, list):
+                        raise ValueError(
+                            f"form name mixes array and object segments: {field.attrs['name']}"
+                        )
                     idx = int(seg)
                     while len(cur) <= idx:
                         cur.append([] if want_array else {})
                     cur = cur[idx]
                 else:
+                    if isinstance(cur, list):
+                        raise ValueError(
+                            f"form name mixes array and object segments: {field.attrs['name']}"
+                        )
                     if seg not in cur:
                         cur[seg] = [] if want_array else {}
                     cur = cur[seg]
             leaf = path[-1]
+            if leaf.isdigit() != isinstance(cur, list):
+                raise ValueError(
+                    f"form name mixes array and object segments: {field.attrs['name']}"
+                )
             if leaf.isdigit():
                 idx = int(leaf)
                 while len(cur) <= idx:

@@ -39,16 +39,23 @@ def _interpret_default() -> bool:
 
 def _auto_block(length: int, cap: int) -> int:
     """Largest 128-aligned divisor of ``length`` up to ``cap`` (whole length
-    when it is shorter than a lane tile)."""
+    when it is shorter than a lane tile; for lengths with no 128-aligned
+    divisor — e.g. 192 — the largest plain divisor, so auto-tiling never
+    rejects a shape the kernel itself can run)."""
     if length <= 128:
         return length
-    best = 128
+    best = 0
     d = 128
     while d <= min(cap, length):
         if length % d == 0:
             best = d
         d += 128
-    return best
+    if best:
+        return best
+    for d in range(min(cap, length), 0, -1):
+        if length % d == 0:
+            return d
+    return length
 
 
 def _block_sizes(lq: int, lk: int, block_q: Optional[int], block_k: Optional[int]) -> Tuple[int, int]:

@@ -340,15 +340,26 @@
         const seg = path[i];
         const wantArray = /^\d+$/.test(path[i + 1]);
         if (/^\d+$/.test(seg)) {
+          if (!Array.isArray(cur)) {
+            // mixed array/object segments under one key is an authoring
+            // bug — fail loudly (JSON.stringify would silently drop it)
+            throw new Error("form name mixes array and object segments: " + field.getAttribute("name"));
+          }
           const idx = +seg;
           while (cur.length <= idx) cur.push(wantArray ? [] : {});
           cur = cur[idx];
         } else {
+          if (Array.isArray(cur)) {
+            throw new Error("form name mixes array and object segments: " + field.getAttribute("name"));
+          }
           if (!(seg in cur)) cur[seg] = wantArray ? [] : {};
           cur = cur[seg];
         }
       }
       const leaf = path[path.length - 1];
+      if (/^\d+$/.test(leaf) !== Array.isArray(cur)) {
+        throw new Error("form name mixes array and object segments: " + field.getAttribute("name"));
+      }
       if (/^\d+$/.test(leaf)) {
         const idx = +leaf;
         while (cur.length <= idx) cur.push(null);
