@@ -19,7 +19,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import optax
 
 try:  # persistent compile cache: tunnel compiles run 20-50 s
     jax.config.update("jax_compilation_cache_dir",

@@ -1,0 +1,88 @@
+"""Profile lifecycle e2e driver — the reference's profiles_test.py
+(py/kubeflow/kubeflow/ci/profiles_test.py:1-30) as a standalone driver:
+
+Creation: create a Profile CR, then verify the namespace exists with the
+same name, ServiceAccounts ``default-editor``/``default-viewer`` are
+created, the owner RoleBinding binds ``kubeflow-admin``, the Istio
+AuthorizationPolicy guards the namespace, and the TPU ResourceQuota is
+materialized when the spec carries one.
+
+Deletion: delete the Profile and verify namespace + owned objects are gone
+(the reference expects ApiException on re-read; here NotFound).
+
+Run standalone:  python -m e2e.profile_driver
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .cluster import E2ECluster, unique_namespace, wait_for_condition
+from .junit import run_driver
+
+OWNER = "profile-e2e@example.com"
+
+
+def run_profile_e2e(timeout: float = 30.0) -> Dict[str, Any]:
+    with E2ECluster() as cluster:
+        client = cluster.client
+        ns = unique_namespace("profile")
+        client.create({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "Profile",
+            "metadata": {"name": ns},
+            "spec": {
+                "owner": {"kind": "User", "name": OWNER},
+                "resourceQuotaSpec": {
+                    "hard": {"requests.google.com/tpu": "16"},
+                },
+            },
+        })
+
+        def materialized() -> bool:
+            if client.get_opt("v1", "Namespace", ns) is None:
+                return False
+            sas = {sa["metadata"]["name"]
+                   for sa in client.list("v1", "ServiceAccount", ns)}
+            if not {"default-editor", "default-viewer"} <= sas:
+                return False
+            roles = {(rb.get("roleRef") or {}).get("name")
+                     for rb in client.list("rbac.authorization.k8s.io/v1", "RoleBinding", ns)}
+            return "kubeflow-admin" in roles
+
+        wait_for_condition(materialized, timeout=timeout, desc=f"profile {ns} materialized")
+
+        policies = client.list("security.istio.io/v1beta1", "AuthorizationPolicy", ns)
+        assert any(p["metadata"]["name"] == "ns-owner-access-istio" for p in policies), (
+            "owner AuthorizationPolicy missing"
+        )
+        quotas = client.list("v1", "ResourceQuota", ns)
+        assert any(
+            (q.get("spec") or {}).get("hard", {}).get("requests.google.com/tpu") == "16"
+            for q in quotas
+        ), "TPU ResourceQuota not materialized"
+
+        # Deletion: profile goes away and takes the namespace contents along.
+        client.delete("kubeflow.org/v1", "Profile", ns)
+        wait_for_condition(
+            lambda: client.get_opt("kubeflow.org/v1", "Profile", ns) is None
+            and client.get_opt("v1", "Namespace", ns) is None,
+            timeout=timeout,
+            desc=f"profile {ns} deleted",
+        )
+        return {"namespace": ns, "created": True, "deleted": True}
+
+
+def main(argv=None) -> int:
+    return run_driver(
+        "e2e-profile",
+        "ProfileE2E",
+        lambda args: "profile-lifecycle",
+        lambda args: lambda: run_profile_e2e(),
+        argv=argv,
+        default_junit="junit_profile.xml",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

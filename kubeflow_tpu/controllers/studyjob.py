@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import json
 import logging
-from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..api import meta as apimeta

@@ -13,7 +13,7 @@ from __future__ import annotations
 import itertools
 import math
 import random as pyrandom
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np

@@ -21,7 +21,7 @@ lengths). TPU-first choices:
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
 import flax.linen as nn

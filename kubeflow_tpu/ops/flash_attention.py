@@ -52,7 +52,11 @@ def _auto_block(length: int, cap: int) -> int:
         d += 128
     if best:
         return best
-    for d in range(min(cap, length), 0, -1):
+    # No 128-aligned divisor: largest plain divisor, floored at 64 — a tiny
+    # block would explode the grid (lq/bq × lk/bk steps; a prime length
+    # would otherwise tile at 1). Below the floor, run the whole length as
+    # ONE block: always a divisor, grid of 1, just more VMEM.
+    for d in range(min(cap, length), 63, -1):
         if length % d == 0:
             return d
     return length

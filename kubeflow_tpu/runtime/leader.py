@@ -29,7 +29,6 @@ between replicas.
 from __future__ import annotations
 
 import logging
-import os
 import socket
 import threading
 import time

@@ -17,12 +17,12 @@ import logging
 import threading
 import time
 import traceback
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
-from ..apiserver.store import Store, WatchEvent
+from ..apiserver.store import Store
 from .metrics import METRICS
 from .tracing import TRACER
 

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..runtime.metrics import METRICS
-from ..web.http import App, HttpError, JsonResponse, Request
+from ..web.http import App, HttpError, Request
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 

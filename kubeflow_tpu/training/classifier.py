@@ -17,7 +17,7 @@ import flax
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from kubeflow_tpu.parallel.mesh import batch_spec, replicated
 from kubeflow_tpu.parallel.sharding import LogicalRules, REPLICATED_RULES, shard_pytree

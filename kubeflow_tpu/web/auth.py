@@ -20,7 +20,6 @@ import secrets
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
-from ..api import meta as apimeta
 from ..apiserver.client import Client
 from .http import App, HttpError, JsonResponse, Request
 

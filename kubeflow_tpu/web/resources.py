@@ -11,7 +11,6 @@ on ``web.http.App``, with the platform's per-call authorization.
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client

@@ -1,0 +1,100 @@
+"""Source hygiene gate — the reference's CI lint tier (testing/
+test_flake8.py, test_jsonnet.py) re-built on stdlib ``ast`` since the image
+ships no flake8: every Python source must parse, carry no unused imports,
+and no `except:` bare handlers. Runs over the package, e2e harness, ci
+builders, and bench entrypoints.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+SCOPES = ["kubeflow_tpu", "e2e", "ci", "bench.py", "__graft_entry__.py"]
+
+
+def python_sources():
+    for scope in SCOPES:
+        p = ROOT / scope
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+SOURCES = list(python_sources())
+IDS = [str(p.relative_to(ROOT)) for p in SOURCES]
+
+
+class ImportAudit(ast.NodeVisitor):
+    """Collect imported top-level names and every name/attribute root used."""
+
+    def __init__(self) -> None:
+        self.imported: dict[str, int] = {}
+        self.used: set[str] = set()
+        self.exported: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imported[name] = node.lineno
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imported[name] = node.lineno
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # __all__ = [...] re-exports count as uses
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == "__all__":
+                for elt in getattr(node.value, "elts", []):
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        self.exported.add(elt.value)
+        self.generic_visit(node)
+
+
+@pytest.mark.parametrize("path", SOURCES, ids=IDS)
+def test_source_hygiene(path: Path):
+    src = path.read_text()
+    tree = ast.parse(src, filename=str(path))  # syntax gate
+
+    # bare except (swallows KeyboardInterrupt/SystemExit)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            pytest.fail(f"{path}:{node.lineno}: bare `except:`")
+
+    # unused imports — re-export files (__init__.py) use imports as surface
+    audit = ImportAudit()
+    audit.visit(tree)
+    if path.name == "__init__.py":
+        return
+    # string-annotation and doctest references are rare here; noqa escape:
+    lines = src.splitlines()
+    unused = []
+    for name, lineno in audit.imported.items():
+        if name in audit.used or name in audit.exported or name == "_":
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in line:
+            continue
+        # names referenced only inside string type annotations
+        if f'"{name}' in src or f"'{name}" in src:
+            continue
+        unused.append(f"{path}:{lineno}: unused import {name!r}")
+    assert not unused, "\n".join(unused)

@@ -169,6 +169,16 @@ class TestAutoTiling:
         assert _auto_block(128, 512) == 128
         assert _auto_block(192, 512) == 192   # no 128-aligned divisor: plain
         assert _auto_block(960, 512) == 480   # largest plain divisor <= cap
+        assert _auto_block(1021, 512) == 1021  # prime: ONE whole-length block
+        assert _auto_block(1250, 512) == 250   # plain divisor above the floor
+        assert _auto_block(1255, 512) == 251   # 5*251: divisor >= 64 exists
+        assert _auto_block(127 * 2, 512) == 254  # 2*127: 127 < floor? no, 254
+        # tiny-divisor-only lengths never tile below 64
+        from kubeflow_tpu.ops.flash_attention import _auto_block as ab
+        for length in (1021, 1031, 2047):
+            b = ab(length, 512)
+            assert b >= 64 or b == length, (length, b)
+            assert length % b == 0
 
     def test_auto_block_always_divides(self):
         from kubeflow_tpu.ops.flash_attention import _auto_block
