@@ -95,11 +95,16 @@ def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
     store = connect(url)
     app = factory(Client(store), auth_from_env())
     server = app.serve(int(os.environ.get("PORT", "5000")), host="0.0.0.0")
-    log.info("%s serving on :%d against %s", name, server.port, store.base_url)
+    # Web apps expose /metrics + /healthz like every role (the reference's
+    # KFAM serves promhttp on its API port, routers.go:85-89).
+    ops = serve_ops_endpoints(name)
+    log.info("%s serving on :%d (ops :%d) against %s",
+             name, server.port, ops.port, store.base_url)
     try:
         block_forever()
     finally:
         server.close()
+        ops.close()
 
 
 def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> None:

@@ -26,6 +26,7 @@ from ..web.auth import AuthConfig, Authorizer, install_auth
 from ..web.http import App, HttpError, JsonResponse, Request
 
 SETTINGS_CONFIGMAP = "centraldashboard-config"
+KUBEFLOW_VERSION = "tpu-native-dev"
 DEFAULT_LINKS = {
     "menuLinks": [
         {"type": "item", "link": "/jupyter/", "text": "Notebooks", "icon": "book"},
@@ -143,6 +144,20 @@ def make_dashboard_app(
             return json.loads(cm["data"]["settings"])
         return {"DASHBOARD_FORCE_IFRAME": True}
 
+    @app.route("/debug")
+    def debug(req: Request):
+        """Build/runtime info (reference server.ts /debug route)."""
+        import platform as _platform
+        import sys as _sys
+
+        return {
+            "app": "centraldashboard",
+            "kubeflowVersion": KUBEFLOW_VERSION,
+            "python": _sys.version.split()[0],
+            "platform": _platform.platform(),
+            "user": user(req),
+        }
+
     @app.route("/api/platform-info")
     def platform_info(req: Request):
         provider = "other"
@@ -154,7 +169,7 @@ def make_dashboard_app(
             if pid.startswith("aws://"):
                 provider = "aws"
                 break
-        return {"provider": provider, "kubeflowVersion": "tpu-native-dev"}
+        return {"provider": provider, "kubeflowVersion": KUBEFLOW_VERSION}
 
     # -- workgroup / registration flow --------------------------------------
     @app.route("/api/workgroup/exists")

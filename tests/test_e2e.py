@@ -29,6 +29,21 @@ class TestDrivers:
         result = run_notebook_spawn_e2e()
         assert result["hosts"] == 2
 
+    def test_profile_e2e(self):
+        from e2e.profile_driver import run_profile_e2e
+
+        result = run_profile_e2e()
+        assert result["created"] and result["deleted"]
+
+    def test_distributed_bootstrap_e2e(self):
+        """Injected coordinator env boots a real 2-process JAX cluster."""
+        from e2e.distributed_driver import run_distributed_e2e
+
+        result = run_distributed_e2e()
+        assert result["workers"] == 2 and result["rendezvous"] == "ok"
+        # the address the webhook wrote names the headless service DNS
+        assert ".svc.cluster.local:" in result["coordinator_env"]
+
 
 class TestLoadtest:
     def test_loadtest_probe(self):
