@@ -36,7 +36,16 @@ from .junit import run_driver
 
 OWNER = "dist-e2e@example.com"
 IDENTITY = {"kubeflow-userid": OWNER}
-COORD_PORT = 19877
+
+
+def _free_port() -> int:
+    """Pick a free TCP port so concurrent runs (pytest-xdist, parallel CI
+    jobs) each get their own coordinator instead of colliding."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 WORKER_PROGRAM = r"""
 import os, sys
@@ -112,12 +121,13 @@ def run_distributed_e2e(timeout: float = 120.0) -> Dict[str, Any]:
         # Boot one real OS process per worker with that env; localhost TCP
         # stands in for the headless-service DNS the address names.
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        coord_port = _free_port()
         procs = []
         try:
             for pod_name, env in worker_envs:
                 penv = dict(os.environ)
                 penv.update(env)
-                penv[ENV_COORDINATOR_ADDRESS] = f"127.0.0.1:{COORD_PORT}"
+                penv[ENV_COORDINATOR_ADDRESS] = f"127.0.0.1:{coord_port}"
                 penv["E2E_POD_NAME"] = pod_name
                 penv["PYTHONPATH"] = repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
                 procs.append(subprocess.Popen(

@@ -94,17 +94,23 @@ def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
     store = connect(url)
     app = factory(Client(store), auth_from_env())
-    server = app.serve(int(os.environ.get("PORT", "5000")), host="0.0.0.0")
-    # Web apps expose /metrics + /healthz like every role (the reference's
-    # KFAM serves promhttp on its API port, routers.go:85-89).
-    ops = serve_ops_endpoints(name)
-    log.info("%s serving on :%d (ops :%d) against %s",
-             name, server.port, ops.port, store.base_url)
+    server = ops = None
     try:
+        server = app.serve(int(os.environ.get("PORT", "5000")), host="0.0.0.0")
+        # Web apps expose /metrics + /healthz like every role (the
+        # reference's KFAM serves promhttp on its API port,
+        # routers.go:85-89). In-cluster each pod has its own netns, so the
+        # shared 8080 default is fine; co-located host runs set
+        # METRICS_PORT per process.
+        ops = serve_ops_endpoints(name)
+        log.info("%s serving on :%d (ops :%d) against %s",
+                 name, server.port, ops.port, store.base_url)
         block_forever()
     finally:
-        server.close()
-        ops.close()
+        if server is not None:
+            server.close()
+        if ops is not None:
+            ops.close()
 
 
 def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> None:
