@@ -1,0 +1,210 @@
+"""Conv-ceiling attack experiments (VERDICT r3 #1).
+
+BASELINE.md's ceiling analysis claims ResNet's 64-channel convs are bound by
+the op MIX (a 128-wide MXU half-idle below 128 contraction/output channels),
+not by the framework. That claim was measured only via
+``jax.lax.conv_general_dilated`` — i.e. via XLA's chosen formulation. These
+probes attack the bound directly by measuring the SAME arithmetic in every
+formulation a custom kernel could choose, using the honest harness from
+e2e/ceiling.py (all iterations inside one ``lax.scan`` executable, chained
+bodies, host-fetch barrier — see BASELINE.md "integrity notes").
+
+Stage-1 conv3x3 (batch 256, 56x56, 64->64, bf16) as a GEMM is
+[M=256*56*56=802816, K=9*64=576] @ [K, N=64]:
+
+1. ``gemm_conv_style``   — [M, 576] @ [576, 64]: XLA-conv-like orientation,
+   output channels (64) in the minor/lane dim -> half the MXU lanes idle.
+2. ``gemm_spatial_lanes``— [64, 576] @ [576, M]: the transposed orientation a
+   Pallas kernel can pick — spatial in lanes (full width), c_out streamed as
+   rows. Same FLOPs.
+3. ``gemm_tap_dots``     — 9 x ([64, 64] @ [64, M]): the no-im2col variant
+   (one dot per 3x3 tap); contraction depth 64 halves MXU depth utilization.
+4. ``conv_xla``          — the actual ``conv_general_dilated`` at the stage
+   shape (control; BASELINE.md row says 61.4 TF/s).
+5. ``conv_xla_fused``    — conv + BN-apply + ReLU, measuring whether the
+   epilogue is free (XLA fusion) or a separate HBM pass.
+6. ``conv_stem`` / ``conv_stem_s2d`` — the 7x7/2 stem on 224x224x3 vs the
+   space-to-depth repack (112x112x12, 4x4/1 kernel = identical arithmetic,
+   4x the input channels feeding the MXU).
+
+Run:  python -m e2e.conv_experiments [--probe NAME]
+Prints one line per probe + a JSON summary. Results recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+_CACHE = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax-cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+# Harness shared with the ceiling probe so rates stay comparable under the
+# same CEILING_CHAIN knob (one copy of the scan/amortization rationale).
+from e2e.ceiling import CHAIN, _timed  # noqa: E402
+
+ITERS = int(os.environ.get("CEILING_ITERS", "20"))
+
+# Stage-1 conv3x3 as GEMM
+B, HW, C = 256, 56, 64
+M = B * HW * HW          # 802816
+K = 9 * C                # 576
+
+
+def _gemm_probe(m: int, k: int, n: int, name: str) -> Dict[str, Any]:
+    """y <- (x @ w) folded back into x's shape via a cheap projection, chained
+    so every dot stays live. x is a jit ARGUMENT (closure capture would be
+    serialized into the remote-compile request on this backend)."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, k), jnp.bfloat16) * 0.05
+    w = jax.random.normal(key, (k, n), jnp.bfloat16) * 0.05
+    proj = jax.random.normal(key, (n, k), jnp.bfloat16) * 0.05
+
+    @jax.jit
+    def run(x, w, proj):
+        def body(x, _):
+            for _i in range(CHAIN):
+                y = jax.lax.dot(x, w)            # [m, n]
+                x = jnp.abs(jax.lax.dot(y, proj)) * 0.05  # back to [m, k], non-linear
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x, w, proj), ITERS * CHAIN)
+    flops = 2.0 * m * k * n * 2  # two dots per chain step
+    return {"kernel": name, "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def gemm_conv_style() -> Dict[str, Any]:
+    return _gemm_probe(M, K, C, f"gemm[{M}x{K}]@[{K}x{C}] (cout in lanes)")
+
+
+def gemm_spatial_lanes() -> Dict[str, Any]:
+    return _gemm_probe(C, K, M, f"gemm[{C}x{K}]@[{K}x{M}] (spatial in lanes)")
+
+
+def gemm_tap_dots() -> Dict[str, Any]:
+    """9 tap-dots of K=64: w9[9,64,64] x x[64,M] -> summed [64,M]."""
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (C, M), jnp.bfloat16) * 0.05
+    w9 = jax.random.normal(key, (9, C, C), jnp.bfloat16) * 0.05
+
+    @jax.jit
+    def run(x, w9):
+        def body(x, _):
+            for _i in range(CHAIN):
+                y = jnp.zeros((C, M), jnp.float32)
+                for t in range(9):
+                    y = y + jax.lax.dot(w9[t].T, x, preferred_element_type=jnp.float32)
+                x = jnp.abs(y).astype(jnp.bfloat16) * 0.05
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x, w9), ITERS * CHAIN)
+    flops = 2.0 * C * C * M * 9
+    return {"kernel": "9 tap-dots [64x64]@[64xM] (K=64)", "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def _conv_probe(batch: int, hw: int, cin: int, cout: int, ksz: int, stride: int,
+                name: str, fuse_bn_relu: bool = False) -> Dict[str, Any]:
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (batch, hw, hw, cin), jnp.bfloat16)
+    k = jax.random.normal(key, (ksz, ksz, cin, cout), jnp.bfloat16) * 0.05
+    ohw = hw // stride
+    proj = jax.random.normal(key, (1, 1, cout, cin), jnp.bfloat16) * 0.05
+    scale = jax.random.normal(key, (cout,), jnp.bfloat16) * 0.1
+    bias = jax.random.normal(key, (cout,), jnp.bfloat16) * 0.1
+    dn = jax.lax.conv_dimension_numbers(x0.shape, k.shape, ("NHWC", "HWIO", "NHWC"))
+    dn_proj = jax.lax.conv_dimension_numbers((batch, ohw, ohw, cout), proj.shape,
+                                             ("NHWC", "HWIO", "NHWC"))
+
+    @jax.jit
+    def run(x, k, proj, scale, bias):
+        def body(x, _):
+            for _i in range(CHAIN):
+                y = jax.lax.conv_general_dilated(x, k, (stride, stride), "SAME",
+                                                 dimension_numbers=dn)
+                if fuse_bn_relu:
+                    y = jnp.maximum(y * scale + bias, 0.0)
+                z = jax.lax.conv_general_dilated(y, proj, (1, 1), "SAME",
+                                                 dimension_numbers=dn_proj) * (1.0 / hw)
+                if stride != 1:
+                    z = jnp.repeat(jnp.repeat(z, stride, 1), stride, 2)  # back to hw
+                x = jnp.abs(z).astype(jnp.bfloat16)
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x0, k, proj, scale, bias), ITERS * CHAIN)
+    flops = 2.0 * batch * ohw * ohw * (ksz * ksz * cin * cout + cout * cin)
+    return {"kernel": name, "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def conv_xla() -> Dict[str, Any]:
+    return _conv_probe(B, HW, C, C, 3, 1, f"conv3x3 b{B} {HW}x{HW}x{C}->{C} (XLA)")
+
+
+def conv_xla_fused() -> Dict[str, Any]:
+    return _conv_probe(B, HW, C, C, 3, 1,
+                       f"conv3x3+bn+relu b{B} {HW}x{HW}x{C}->{C} (XLA)", fuse_bn_relu=True)
+
+
+def conv_stem() -> Dict[str, Any]:
+    # 7x7/2 on 224x224x3: K = 49*3 = 147 contraction, 3 input channels of a
+    # 128-lane load -> the classic worst case.
+    return _conv_probe(B, 224, 3, 64, 7, 2, f"stem conv7x7/2 b{B} 224x224x3->64 (XLA)")
+
+
+def conv_stem_s2d() -> Dict[str, Any]:
+    # Space-to-depth: x[224,224,3] -> [112,112,12] (2x2 blocks into channels);
+    # the 7x7/2 conv becomes a 4x4/1 conv on the repacked grid (the 7x7
+    # kernel zero-padded to 8x8 and regrouped — MLPerf-style stem packing).
+    # 16*12=192 taps vs 147: 31% more nominal FLOPs, but 4x the input
+    # channels feeding the MXU. Compare iter_s against conv_stem — both
+    # compute the full stem from the same input information.
+    return _conv_probe(B, 112, 12, 64, 4, 1, f"stem-s2d conv4x4 b{B} 112x112x12->64 (XLA)")
+
+
+PROBES: Dict[str, Callable[[], Dict[str, Any]]] = {
+    "gemm_conv_style": gemm_conv_style,
+    "gemm_spatial_lanes": gemm_spatial_lanes,
+    "gemm_tap_dots": gemm_tap_dots,
+    "conv_xla": conv_xla,
+    "conv_xla_fused": conv_xla_fused,
+    "conv_stem": conv_stem,
+    "conv_stem_s2d": conv_stem_s2d,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", choices=sorted(PROBES), action="append",
+                    help="run only these probes (default: all)")
+    args = ap.parse_args(argv)
+    names = args.probe or list(PROBES)
+    rows: List[Dict[str, Any]] = []
+    for name in names:
+        try:
+            r = PROBES[name]()
+        except Exception as e:  # record, keep sweeping
+            r = {"kernel": name, "tflops": 0.0, "error": str(e)[:160]}
+        rows.append(r)
+        print(f"{r['kernel']:55s} {r['tflops']:9.1f} TF/s"
+              + (f"  ERROR {r['error']}" if r.get("error") else ""), flush=True)
+    print(json.dumps({"metric": "conv_experiments", "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

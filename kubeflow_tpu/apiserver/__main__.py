@@ -2,7 +2,8 @@
 
 Env: API_PORT (default 8001), WEBHOOK_URL (external PodDefault admission;
 unset = in-process admission, the all-in-one default), KUBEFLOW_TPU_NATIVE
-(storage backend selection).
+(storage backend selection), APISERVER_AUTH=token (+ APISERVER_TOKENS /
+APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py).
 """
 
 from __future__ import annotations
@@ -13,6 +14,7 @@ import os
 from ..apiserver.client import Client
 from ..runtime.bootstrap import block_forever
 from ..webhook.poddefault import admission_hook
+from .auth import auth_from_env
 from .server import make_apiserver_app, run_gc_loop
 from .store import Store
 
@@ -21,7 +23,8 @@ def main() -> None:
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
     store = Store()
     webhook_url = os.environ.get("WEBHOOK_URL", "")
-    app = make_apiserver_app(store, webhook_url=webhook_url or None)
+    auth = auth_from_env(store)
+    app = make_apiserver_app(store, webhook_url=webhook_url or None, auth=auth)
     if not webhook_url:
         store.register_admission(
             admission_hook(Client(store), cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"))
@@ -30,10 +33,11 @@ def main() -> None:
     port = int(os.environ.get("API_PORT", "8001"))
     server = app.serve(port, host="0.0.0.0")
     logging.getLogger("kubeflow_tpu.apiserver").info(
-        "apiserver on :%d (backend=%s, admission=%s)",
+        "apiserver on :%d (backend=%s, admission=%s, auth=%s)",
         server.port,
         type(store.backend).__name__,
         webhook_url or "in-process",
+        "token+rbac" if auth else "open",
     )
     try:
         block_forever()

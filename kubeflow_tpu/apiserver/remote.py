@@ -87,9 +87,15 @@ class RemoteWatch:
 
 
 class RemoteStore:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # Role identity for the apiserver's token/RBAC gate (auth.py). Env
+        # default so every role picks up its manifest-mounted token without
+        # call-site changes; None = anonymous (open/dev apiserver).
+        import os
+
+        self.token = token if token is not None else os.environ.get("APISERVER_TOKEN") or None
 
     # -- wire helpers --------------------------------------------------------
     @staticmethod
@@ -113,8 +119,10 @@ class RemoteStore:
                  query: str = "", timeout: Optional[float] = None):
         url = self.base_url + path + (f"?{query}" if query else "")
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers={"content-type": "application/json"})
+        headers = {"content-type": "application/json"}
+        if self.token:
+            headers["authorization"] = f"Bearer {self.token}"
+        req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             return urllib.request.urlopen(req, timeout=timeout or self.timeout)
         except urllib.error.HTTPError as e:

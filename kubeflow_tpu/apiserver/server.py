@@ -9,9 +9,11 @@ makes the per-role service entrypoints real: controllers, webapps, and the
 webhook connect to this server from separate processes exactly as the
 reference's Go binaries connect to the Kubernetes API server.
 
-Auth model: none here — like kubelet's local port, this listens on the
-pod network behind the platform's service mesh; user-facing authn/authz
-lives in the web apps (crud_backend model, SURVEY §2.7). Admission: a
+Auth model (VERDICT r3 #3): pass an :class:`~.auth.ApiAuth` to gate every
+verb — bearer-token identity + RBAC over the store's Role/Binding objects,
+deny-by-default, the K8s-API-server half of the reference's two-gate model
+(user-facing SAR stays in the web apps, crud_backend model, SURVEY §2.7).
+``auth=None`` keeps the open in-process/all-in-one behavior. Admission: a
 ``webhook_url`` wires pod CREATEs through the external PodDefault webhook
 (AdmissionReview + JSONPatch), the MutatingWebhookConfiguration analog.
 """
@@ -28,6 +30,7 @@ from ..api import meta as apimeta
 from ..api.conversion import convert, convert_fragment, hub_resource
 from ..api.meta import REGISTRY, Resource
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
+from .auth import ApiAuth, Identity, Unauthenticated
 from .store import ApiError, Forbidden, Store
 
 
@@ -111,10 +114,46 @@ def webhook_admission_hook(webhook_url: str, timeout: float = 5.0):
     return hook
 
 
-def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
+def make_apiserver_app(
+    store: Store, webhook_url: Optional[str] = None, auth: Optional[ApiAuth] = None
+) -> App:
     app = App("apiserver")
     if webhook_url:
         store.register_admission(webhook_admission_hook(webhook_url))
+
+    if auth is not None:
+        @app.middleware
+        def authenticate(req: Request) -> Optional[JsonResponse]:
+            if req.path == "/healthz":  # kubelet probes stay anonymous
+                return None
+            header = req.header("authorization")
+            bearer = header[7:] if header.lower().startswith("bearer ") else None
+            try:
+                req.context["identity"] = auth.authenticate(bearer)
+            except Unauthenticated as e:
+                if auth.anonymous_read and req.method == "GET":
+                    req.context["identity"] = Identity(
+                        "system:anonymous", ("system:unauthenticated",))
+                    return None
+                return JsonResponse(
+                    {"kind": "Status", "status": "Failure", "code": 401,
+                     "reason": "Unauthorized", "message": str(e)},
+                    status=401, headers={"WWW-Authenticate": "Bearer"},
+                )
+            return None
+
+    def authorize(req: Request, verb: str, res: Resource) -> None:
+        """RBAC gate per verb (no-op when the server runs open)."""
+        if auth is None:
+            return
+        ident = req.context["identity"]
+        ns = req.params.get("ns")
+        if not auth.ensure(ident, verb, res.group, res.plural, ns):
+            raise HttpError(
+                403,
+                f"user {ident.user!r} cannot {verb} {res.plural}.{res.group or 'core'}"
+                + (f" in namespace {ns!r}" if ns else " at cluster scope"),
+            )
 
     def res_of(req: Request) -> Resource:
         """Resource addressed by the URL. May be a SPOKE version — handlers
@@ -152,7 +191,9 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
         ns = req.params.get("ns")
         selector = _selector_of(req)
         if req.query1("watch") in ("true", "1"):
+            authorize(req, "watch", res)
             return _watch_stream(store, res, ns, selector, req)
+        authorize(req, "list", res)
         try:
             items, rv = store.list_with_rv(hub_resource(res), namespace=ns, label_selector=selector)
         except ApiError as e:
@@ -168,6 +209,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def create(req: Request):
         res = res_of(req)
+        authorize(req, "create", res)
         obj = req.json or {}
         obj.setdefault("apiVersion", res.api_version)
         obj.setdefault("kind", res.kind)
@@ -180,6 +222,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def get_item(req: Request):
         res = res_of(req)
+        authorize(req, "get", res)
         try:
             return outbound(store.get(hub_resource(res), req.params["name"], req.params.get("ns")), res)
         except ApiError as e:
@@ -199,6 +242,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def put_item(req: Request):
         res = res_of(req)
+        authorize(req, "update", res)
         obj = req.json or {}
         _check_body_matches_path(req, obj)
         try:
@@ -208,6 +252,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def put_status(req: Request):
         res = res_of(req)
+        authorize(req, "update", res)
         obj = req.json or {}
         _check_body_matches_path(req, obj)
         try:
@@ -217,6 +262,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def patch_item(req: Request):
         res = res_of(req)
+        authorize(req, "patch", res)
         patch = dict(req.json or {})
         # apiVersion/kind are endpoint-determined; merging a spoke version
         # into the stored hub object would corrupt its storage key.
@@ -236,6 +282,7 @@ def make_apiserver_app(store: Store, webhook_url: Optional[str] = None) -> App:
 
     def delete_item(req: Request):
         res = res_of(req)
+        authorize(req, "delete", res)
         try:
             return outbound(store.delete(hub_resource(res), req.params["name"], req.params.get("ns")), res)
         except ApiError as e:

@@ -1,0 +1,34 @@
+"""python -m kubeflow_tpu.apiserver.tokens — generate a role token table.
+
+Prints a fresh static-token CSV (the kube ``--token-auth-file`` format the
+apiserver consumes via ``APISERVER_TOKEN_FILE``) plus the per-role secrets,
+ready to paste into the ``kubeflow-tpu-tokens`` Secret
+(manifests/apiserver/base/resources.yaml). Roles all join the
+``system:kubeflow-tpu`` group, which the seeded bootstrap RBAC binds to
+full resource access (auth.py seed_rbac).
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from .auth import SERVICE_GROUP
+
+#: Secret key -> service-account user suffix (must match the identities the
+#: manifest template ships, manifests/apiserver/base/resources.yaml).
+ROLES = {"controllers": "controllers", "webhook": "admission-webhook",
+         "webapps": "webapps"}
+
+
+def main() -> None:
+    toks = {role: secrets.token_urlsafe(24) for role in ROLES}
+    print("# token-table.csv (APISERVER_TOKEN_FILE)")
+    for i, (role, tok) in enumerate(toks.items(), 1):
+        print(f'{tok},system:serviceaccount:kubeflow:{ROLES[role]},u{i},"{SERVICE_GROUP}"')
+    print("\n# per-role Secret keys (injected as APISERVER_TOKEN)")
+    for role, tok in toks.items():
+        print(f"{role}: {tok}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@ HTTP_REWRITE_ANNOTATION = "notebooks.kubeflow.org/http-rewrite-uri"
 HEADERS_ANNOTATION = "notebooks.kubeflow.org/http-headers-request-set"
 NOTEBOOK_NAME_LABEL = "notebook-name"
 DEFAULT_CONTAINER_PORT = 8888
+MIRROR_MEMO_CAP = 4096  # FIFO bound on the mirrored-event dedupe memo
 DEFAULT_FSGROUP = 100
 
 
@@ -105,8 +106,12 @@ class NotebookReconciler(Reconciler):
         self.config = config or NotebookConfig()
         # Mirrored-event keys also tracked locally: the informer cache lags
         # the write we just made by one watch delivery, so two back-to-back
-        # reconciles would double-mirror without this.
-        self._mirrored_keys: set = set()
+        # reconciles would double-mirror without this. Insertion-ordered and
+        # FIFO-capped (plus cleared per notebook on delete) so a long-lived
+        # controller can't grow it per distinct (reason, message) forever;
+        # an evicted key at worst re-mirrors one event the informer already
+        # dedupes once its cache catches up.
+        self._mirrored_keys: Dict[tuple, None] = {}
         # Lazily-built incremental running-notebook sets per namespace.
         self._running_by_ns: Optional[Dict[str, set]] = None
 
@@ -127,6 +132,9 @@ class NotebookReconciler(Reconciler):
     def reconcile(self, client: Client, req: Request) -> Result:
         nb = client.get_opt(*self.FOR, req.name, req.namespace)
         if nb is None:
+            for key in [k for k in self._mirrored_keys
+                        if k[0] == req.namespace and k[1] == req.name]:
+                del self._mirrored_keys[key]
             return Result()
 
         self._mirror_child_events(client, nb)
@@ -394,7 +402,7 @@ class NotebookReconciler(Reconciler):
             for e in events
             if e.get("involvedObject", {}).get("kind") == "Notebook"
             and e.get("involvedObject", {}).get("name") == name
-        } | self._mirrored_keys
+        } | self._mirrored_keys.keys()
         for ev in events:
             inv = ev.get("involvedObject", {})
             if inv.get("kind") not in ("Pod", "StatefulSet"):
@@ -408,7 +416,9 @@ class NotebookReconciler(Reconciler):
                 continue
             client.emit_event(nb, ev.get("reason", ""), ev.get("message", ""), type_="Warning")
             mirrored.add(key)
-            self._mirrored_keys.add(key)
+            self._mirrored_keys[key] = None
+            while len(self._mirrored_keys) > MIRROR_MEMO_CAP:
+                del self._mirrored_keys[next(iter(self._mirrored_keys))]
 
     def _update_running_gauge(self, client: Client, namespace: Optional[str]) -> None:
         if self.cache is None:  # no manager: direct scan (unit-test path)

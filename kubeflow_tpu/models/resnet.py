@@ -75,12 +75,34 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """[N, H, W, C] -> [N, H/b, W/b, b*b*C]: 2x2 pixel blocks folded into
+    channels. A pure reshape/transpose — XLA compiles it to a cheap copy."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
 class ResNet(nn.Module):
+    """stem="s2d" folds the input 2x2 space-to-depth and runs the stem as a
+    4x4/1 conv on 12 channels instead of 7x7/2 on 3 — the same receptive
+    field (the 7x7 kernel zero-padded to 8x8 and regrouped onto the
+    half-res grid), but with 4x the channels feeding the MXU. Measured on
+    v5e (e2e/conv_experiments.py): the 3-channel 7x7 sustains 5.7 TF/s in
+    isolation vs 44.1 for the s2d form; in the full train step the win is
+    ~1% (XLA already treats the in-model stem better than the standalone
+    probe suggested — BASELINE.md round-4 notes). Default stays "conv7x7":
+    the s2d stem renames/reshapes conv_init in the param tree, which would
+    silently break existing checkpoints and torchvision weight-shape
+    parity; perf-sensitive callers (bench.py) opt in explicitly."""
+
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    stem: str = "conv7x7"  # "s2d" | "conv7x7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -96,7 +118,14 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "s2d" and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            x = space_to_depth(x, 2)
+            # padding (2,1): the s2d window spans cells i-2..i+1, covering
+            # the 7x7/2 receptive field (rows 2i-4..2i+3 vs 2i-3..2i+3).
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     padding=[(2, 1), (2, 1)], name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -39,9 +39,11 @@ def _interpret_default() -> bool:
 
 def _auto_block(length: int, cap: int) -> int:
     """Largest 128-aligned divisor of ``length`` up to ``cap`` (whole length
-    when it is shorter than a lane tile; for lengths with no 128-aligned
-    divisor — e.g. 192 — the largest plain divisor, so auto-tiling never
-    rejects a shape the kernel itself can run)."""
+    when it is shorter than a lane tile). Lengths with no 128-aligned
+    divisor fall back to the largest 8-aligned divisor >= 64 (Mosaic
+    sublane tiling), and failing that to the whole length as ONE block —
+    which ``flash_attention`` then rejects on the TPU path when it is not
+    8-aligned (clear error instead of an opaque Mosaic failure)."""
     if length <= 128:
         return length
     best = 0
@@ -52,14 +54,30 @@ def _auto_block(length: int, cap: int) -> int:
         d += 128
     if best:
         return best
-    # No 128-aligned divisor: largest plain divisor, floored at 64 — a tiny
-    # block would explode the grid (lq/bq × lk/bk steps; a prime length
-    # would otherwise tile at 1). Below the floor, run the whole length as
-    # ONE block: always a divisor, grid of 1, just more VMEM.
-    for d in range(min(cap, length), 63, -1):
+    # No 128-aligned divisor: largest 8-aligned divisor, floored at 64 — a
+    # tiny block would explode the grid (lq/bq × lk/bk steps), and Mosaic
+    # rejects block shapes whose sublane dim isn't a multiple of 8, so
+    # non-8-aligned divisors would only fail later with an opaque compile
+    # error (ADVICE r3). Below the floor, run the whole length as ONE
+    # block: always a divisor, grid of 1, just more VMEM (the caller
+    # rejects it on the TPU path if it isn't 8-aligned).
+    for d in range(min(cap, length) & ~7, 63, -8):
         if length % d == 0:
             return d
     return length
+
+
+def _auto_tile_cap() -> int:
+    # The 1024 cap budgets ~4 MiB of f32 scores plus accumulators/iotas
+    # against the ~128 MiB VMEM of v4/v5/v6-class chips; v2/v3 (~16 MiB)
+    # get a 256 cap so the auto default stays within what the old 128x128
+    # tiles compiled under (ADVICE r3: the big cap was a silent portability
+    # regression for earlier generations).
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 1024
+    return 256 if ("v2" in kind or "v3" in kind) else 1024
 
 
 def _block_sizes(lq: int, lk: int, block_q: Optional[int], block_k: Optional[int]) -> Tuple[int, int]:
@@ -69,8 +87,9 @@ def _block_sizes(lq: int, lk: int, block_q: Optional[int], block_k: Optional[int
     # against MXU dots and cut grid-step overhead ~3x (GPT-2-medium step:
     # 20.9% -> 41.2% MFU). Scores VMEM is bq*bk*4B = 4 MiB at the caps, far
     # under the 128 MiB budget even with q/k/v/o blocks alongside.
-    bq = _auto_block(lq, 1024) if block_q is None else min(block_q, lq)
-    bk = _auto_block(lk, 1024) if block_k is None else min(block_k, lk)
+    cap = _auto_tile_cap()
+    bq = _auto_block(lq, cap) if block_q is None else min(block_q, lq)
+    bk = _auto_block(lk, cap) if block_k is None else min(block_k, lk)
     if lq % bq or lk % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide sequence lengths ({lq}, {lk})"
@@ -433,6 +452,14 @@ def flash_attention(
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     interpret = _interpret_default() if interpret is None else interpret
     bq, bk = _block_sizes(q.shape[1], k.shape[1], block_q, block_k)
+    if not interpret and (bq % 8 or bk % 8):
+        # Mosaic requires sublane dims to be multiples of 8; fail fast with
+        # a clear message instead of an opaque TPU compile error (ADVICE r3).
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) are not 8-aligned; sequence lengths "
+            f"({q.shape[1]}, {k.shape[1]}) have no TPU-tileable divisor — "
+            "pad the sequence or pass explicit block_q/block_k"
+        )
     return _flash(
         q, k, v, causal, scale, int(q_offset), int(k_offset),
         bq, bk, interpret,

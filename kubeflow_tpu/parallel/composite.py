@@ -1,0 +1,191 @@
+"""Composed 4D parallelism: one GPT train step over dp x fsdp x tp x pp.
+
+VERDICT r3 #6: the per-axis dryrun phases proved each parallelism axis as an
+island; this module composes them in ONE program on ONE mesh — the way a
+real large-model job runs (Megatron/GSPMD-style):
+
+- ``pipe``  — transformer layers split into GPipe stages
+  (parallel/pipeline.py: shard_map + ppermute microbatch streaming),
+- ``model`` — Megatron tensor parallelism INSIDE each stage, written as
+  manual SPMD: column-split QKV/W1 (no comm), row-split WO/W2 followed by
+  one ``psum`` over the ``model`` axis per sublayer,
+- ``fsdp``  — ZeRO-3: weight shards live split over ``fsdp``; each stage
+  ``all_gather``s a weight right before use, and autodiff transposes that
+  gather into the gradient ``reduce_scatter``,
+- ``data``/``fsdp`` — the microbatch dim of the input stream is sharded
+  over both batch axes (mesh.BATCH_AXES); gradient all-reduce over them is
+  placed by autodiff through the shard_map.
+
+Embedding/unembedding run OUTSIDE the pipeline under ordinary GSPMD jit
+(vocab sharded over ``model``), so the program also exercises the
+shard_map <-> GSPMD boundary in both directions.
+
+The reference has no in-tree parallelism at all (SURVEY.md §2.10); this is
+the in-workload half of the TPU-native build. Checkpoint/resume across a
+DIFFERENT mesh factorization is exercised in ``__graft_entry__``
+(dryrun phase 5) via training/checkpoint.py's template-sharded restore.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_FSDP, AXIS_MODEL, AXIS_PIPE, BATCH_AXES
+from .pipeline import pipeline_apply
+
+
+@dataclass(frozen=True)
+class CompositeConfig:
+    vocab_size: int = 256
+    d_model: int = 32
+    n_heads: int = 4
+    d_ff: int = 64
+    n_layers: int = 4  # must divide by mesh pipe size
+    seq: int = 16
+
+
+def _param_specs(cfg: CompositeConfig) -> Dict[str, Any]:
+    """Stage-stacked weight specs. Stage dim on ``pipe``; Megatron column/
+    row splits on ``model``; the remaining large dim sharded over ``fsdp``
+    (ZeRO-3), gathered at use inside the stage body."""
+    return {
+        "ln1_scale": P(AXIS_PIPE, None, None),
+        "ln2_scale": P(AXIS_PIPE, None, None),
+        # [S, L, d, 3, d]: the qkv role dim is explicit and UNsharded — a
+        # flat [d, 3d] column-shard would hand device 0 "all of q plus half
+        # of k" and silently change the math between factorizations.
+        "wqkv": P(AXIS_PIPE, None, AXIS_FSDP, None, AXIS_MODEL),
+        "wo": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S, L, d/tp, d]
+        "w1": P(AXIS_PIPE, None, AXIS_FSDP, AXIS_MODEL),    # [S, L, d, ff/tp]
+        "w2": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S, L, ff/tp, d]
+    }
+
+
+def init_params(rng: jax.Array, cfg: CompositeConfig, mesh: Mesh) -> Dict[str, Any]:
+    """Global (sharded) param pytree: embed + stacked per-stage blocks."""
+    pp = mesh.shape[AXIS_PIPE]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pipe={pp}")
+    lps = cfg.n_layers // pp
+    d, ff = cfg.d_model, cfg.d_ff
+    ks = jax.random.split(rng, 5)
+    scale = d ** -0.5
+    stages = {
+        "ln1_scale": jnp.ones((pp, lps, d), jnp.float32),
+        "ln2_scale": jnp.ones((pp, lps, d), jnp.float32),
+        "wqkv": jax.random.normal(ks[0], (pp, lps, d, 3, d), jnp.float32) * scale,
+        "wo": jax.random.normal(ks[1], (pp, lps, d, d), jnp.float32) * scale,
+        "w1": jax.random.normal(ks[2], (pp, lps, d, ff), jnp.float32) * scale,
+        "w2": jax.random.normal(ks[3], (pp, lps, ff, d), jnp.float32) * (ff ** -0.5),
+    }
+    specs = _param_specs(cfg)
+    stages = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in stages.items()
+    }
+    embed = jax.device_put(
+        jax.random.normal(ks[4], (cfg.vocab_size, d), jnp.float32) * scale,
+        NamedSharding(mesh, P(AXIS_MODEL, None)),
+    )
+    return {"embed": embed, "stages": stages}
+
+
+def param_shardings(cfg: CompositeConfig, mesh: Mesh) -> Dict[str, Any]:
+    """NamedSharding tree matching :func:`init_params` — the checkpoint
+    restore template for THIS mesh (cross-factorization resume)."""
+    specs = _param_specs(cfg)
+    return {
+        "embed": NamedSharding(mesh, P(AXIS_MODEL, None)),
+        "stages": {k: NamedSharding(mesh, s) for k, s in specs.items()},
+    }
+
+
+def _stage_fn(cfg: CompositeConfig, p: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
+    """One pipeline stage = lps transformer blocks, manual SPMD.
+
+    ``p`` leaves are LOCAL shards [1, lps, ...] (stage dim stripped by the
+    pipeline body caller); ``h`` is the local microbatch [mb_local, seq, d].
+    """
+    def block(h, layer):
+        ln1, ln2, wqkv_l, wo_l, w1_l, w2_l = layer
+        # fsdp: gather the weight shard right before use; grad transposes to
+        # reduce_scatter (ZeRO-3). tiled=True concatenates along the dim.
+        wqkv = lax.all_gather(wqkv_l, AXIS_FSDP, axis=0, tiled=True)   # [d, 3, d/tp]
+        wo = lax.all_gather(wo_l, AXIS_FSDP, axis=1, tiled=True)       # [d/tp, d]
+        w1 = lax.all_gather(w1_l, AXIS_FSDP, axis=0, tiled=True)       # [d, ff/tp]
+        w2 = lax.all_gather(w2_l, AXIS_FSDP, axis=1, tiled=True)       # [ff/tp, d]
+
+        def ln(x, scale):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
+
+        # attention: column-split QKV -> local heads; causal; row-split WO
+        x = ln(h, ln1)
+        qkv = jnp.einsum("bsd,drh->bsrh", x, wqkv)       # [mb, s, 3, d/tp]
+        dl = qkv.shape[-1]                               # d/tp local width
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        hd = cfg.d_model // cfg.n_heads
+        nh = dl // hd                                    # local heads
+        mb, s, _ = q.shape
+        q = q.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+        scores = (q @ k.transpose(0, 1, 3, 2)) * (hd ** -0.5)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1) @ v       # [mb, nh, s, hd]
+        attn = attn.transpose(0, 2, 1, 3).reshape(mb, s, dl)
+        # row-split output proj: partial sums reduced over the model axis
+        h = h + lax.psum(attn @ wo, AXIS_MODEL)
+        # mlp: column-split W1 (no comm), row-split W2 (+psum)
+        x = ln(h, ln2)
+        h = h + lax.psum(jax.nn.gelu(x @ w1) @ w2, AXIS_MODEL)
+        return h, None
+
+    layers = (p["ln1_scale"], p["ln2_scale"], p["wqkv"], p["wo"], p["w1"], p["w2"])
+    h, _ = lax.scan(block, h, layers)
+    return h
+
+
+def make_train_step(cfg: CompositeConfig, mesh: Mesh, lr: float = 0.1):
+    """jit-able (params, ids[M, mb, seq]) -> (params, loss): one SGD step of
+    next-token CE under the full dp x fsdp x tp x pp composition."""
+    batch_spec = P(None, BATCH_AXES, None)  # [M, mb, seq]
+    h_spec = P(None, BATCH_AXES, None, None)  # [M, mb, seq, d]
+    specs = _param_specs(cfg)
+
+    def loss_fn(params, ids):
+        # GSPMD region: embedding lookup, vocab sharded over `model`
+        h = jnp.take(params["embed"], ids, axis=0)  # [M, mb, s, d]
+        h = pipeline_apply(
+            lambda p, hh: _stage_fn(cfg, p, hh),
+            params["stages"],
+            h,
+            mesh,
+            param_specs={k: specs[k] for k in params["stages"]},
+            x_spec=h_spec,
+            out_spec=h_spec,
+        )
+        logits = h @ params["embed"].T  # [M, mb, s, vocab]
+        targets = jnp.roll(ids, -1, axis=-1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+    def step(params, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    in_sharding = (param_shardings(cfg, mesh), NamedSharding(mesh, batch_spec))
+    return jax.jit(step, in_shardings=in_sharding,
+                   out_shardings=(in_sharding[0], NamedSharding(mesh, P())))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(None, BATCH_AXES, None))

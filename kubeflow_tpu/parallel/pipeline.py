@@ -100,6 +100,9 @@ def pipeline_apply(
     mesh: Mesh,
     *,
     axis_name: str = AXIS_PIPE,
+    param_specs: Any = None,
+    x_spec: P = P(),
+    out_spec: P = P(),
 ) -> jax.Array:
     """Run x through S pipelined stages of ``stage_fn`` over ``mesh``.
 
@@ -110,6 +113,13 @@ def pipeline_apply(
     - ``x`` — [num_microbatches, microbatch, ...] input stream, replicated
       over ``axis_name`` (batch axes may shard its microbatch dim).
 
+    Composition with the other mesh axes (parallel/composite.py): pass
+    ``param_specs`` to also shard weight dims over ``fsdp``/``model`` (the
+    stage dim must stay on ``axis_name``), ``x_spec``/``out_spec`` to shard
+    the microbatch dim over the batch axes; ``stage_fn`` then runs manual
+    SPMD — it sees LOCAL shards and uses collectives (all_gather over fsdp,
+    psum over model) itself, exactly like a Megatron block.
+
     Returns [num_microbatches, microbatch, ...] outputs, replicated over the
     pipe axis. Differentiable end-to-end.
     """
@@ -118,12 +128,13 @@ def pipeline_apply(
             f"need at least as many microbatches as stages: "
             f"{x.shape[0]} microbatches < {mesh.shape[axis_name]} stages"
         )
-    param_specs = jax.tree_util.tree_map(stage_param_spec, stage_params)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(stage_param_spec, stage_params)
     fn = shard_map(
         functools.partial(_local_pipeline, stage_fn=stage_fn, axis_name=axis_name),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=out_spec,
         check_vma=False,
     )
     return fn(stage_params, x)

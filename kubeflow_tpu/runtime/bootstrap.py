@@ -102,9 +102,16 @@ def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
         # routers.go:85-89). In-cluster each pod has its own netns, so the
         # shared 8080 default is fine; co-located host runs set
         # METRICS_PORT per process.
-        ops = serve_ops_endpoints(name)
-        log.info("%s serving on :%d (ops :%d) against %s",
-                 name, server.port, ops.port, store.base_url)
+        try:
+            ops = serve_ops_endpoints(name)
+        except OSError as e:
+            # Metrics exposure must not take the app down: co-located host
+            # runs without METRICS_PORT collide on the shared 8080 default
+            # (ADVICE r3). In-cluster each pod has its own netns, so this
+            # only fires in dev/host layouts.
+            log.warning("%s: ops endpoints unavailable (%s); serving without /metrics", name, e)
+        log.info("%s serving on :%d (ops %s) against %s",
+                 name, server.port, f":{ops.port}" if ops else "disabled", store.base_url)
         block_forever()
     finally:
         if server is not None:
@@ -143,13 +150,23 @@ def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> 
         ).start()
     else:
         mgr.start()
-    ops = serve_ops_endpoints(name)
-    log.info("%s running against %s (ops :%d)", name, store.base_url, ops.port)
+    ops = None
     try:
+        try:
+            ops = serve_ops_endpoints(name)
+        except OSError as e:
+            # Same hardening as run_webapp (ADVICE r3): a port collision on
+            # a co-located host must not crash a role whose manager/elector
+            # threads are already running.
+            log.warning("%s: ops endpoints unavailable (%s); running without /metrics",
+                        name, e)
+        log.info("%s running against %s (ops %s)", name, store.base_url,
+                 f":{ops.port}" if ops else "disabled")
         block_forever()
     finally:
         if elector is not None:
             elector.stop()  # stops the manager via on_stopped_leading
         else:
             mgr.stop()
-        ops.close()
+        if ops is not None:
+            ops.close()

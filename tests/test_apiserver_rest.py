@@ -521,3 +521,162 @@ class TestJsonPatch:
         )
         assert out == {"a": {"b": 2, "c": 3}, "arr": [2, 9]}
         assert obj == {"a": {"b": 1}, "arr": [1, 2]}  # input untouched
+
+
+class TestApiAuth:
+    """Bearer-token + RBAC gate on the REST boundary (VERDICT r3 #3: the
+    round-3 apiserver accepted unauthenticated writes from anything that
+    could reach the port)."""
+
+    @pytest.fixture()
+    def authed(self):
+        from kubeflow_tpu.apiserver.auth import (
+            SERVICE_GROUP, ApiAuth, RBACAuthorizer, TokenAuthenticator, seed_rbac,
+        )
+
+        store = Store()
+        authn = TokenAuthenticator()
+        authn.add("ctl-token", "system:serviceaccount:kubeflow:notebook-controller",
+                  [SERVICE_GROUP])
+        authn.add("alice-token", "alice@example.com")
+        auth = ApiAuth(authn, RBACAuthorizer(store))
+        seed_rbac(store)
+        server = make_apiserver_app(store, auth=auth).serve(0)
+        yield store, f"http://127.0.0.1:{server.port}"
+        server.close()
+
+    def test_unauthenticated_write_rejected(self, authed):
+        store, base = authed
+        anon = RemoteStore(base, token="")
+        from kubeflow_tpu.apiserver.store import ApiError
+
+        with pytest.raises(ApiError) as ei:
+            anon.create(mkpod("intruder"))
+        assert ei.value.code == 401
+        assert store.list(PODS, "default") == []  # nothing landed
+
+    def test_unauthenticated_read_rejected_by_default(self, authed):
+        _, base = authed
+        anon = RemoteStore(base, token="")
+        from kubeflow_tpu.apiserver.store import ApiError
+
+        with pytest.raises(ApiError) as ei:
+            anon.list(PODS, "default")
+        assert ei.value.code == 401
+
+    def test_unknown_token_rejected(self, authed):
+        _, base = authed
+        from kubeflow_tpu.apiserver.store import ApiError
+
+        with pytest.raises(ApiError) as ei:
+            RemoteStore(base, token="forged").create(mkpod("intruder"))
+        assert ei.value.code == 401
+
+    def test_service_token_full_crud_and_watch(self, authed):
+        _, base = authed
+        svc = RemoteStore(base, token="ctl-token")
+        svc.create(mkpod("svc-pod"))
+        assert svc.get(PODS, "svc-pod", "default")["metadata"]["name"] == "svc-pod"
+        w = svc.watch(PODS, namespace="default", send_initial=True)
+        events = []
+        for ev in w:
+            events.append(ev)
+            break
+        w.close()
+        assert events and events[0].object["metadata"]["name"] == "svc-pod"
+        svc.delete(PODS, "svc-pod", "default")
+
+    def test_user_verbs_follow_namespace_rolebinding(self, authed):
+        store, base = authed
+        from kubeflow_tpu.apiserver.store import ApiError
+
+        alice = RemoteStore(base, token="alice-token")
+        with pytest.raises(ApiError) as ei:
+            alice.list(PODS, "default")
+        assert ei.value.code == 403  # authenticated, no grant
+        store.create({
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+            "metadata": {"name": "alice-view", "namespace": "default"},
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-view"},
+            "subjects": [{"kind": "User", "name": "alice@example.com"}],
+        })
+        assert alice.list(PODS, "default") == []  # view grants list
+        with pytest.raises(ApiError) as ei:
+            alice.create(mkpod("alice-pod"))
+        assert ei.value.code == 403  # view does not grant create
+
+    def test_explicit_role_rules_are_resource_scoped(self, authed):
+        store, base = authed
+        from kubeflow_tpu.apiserver.store import ApiError
+
+        store.create({
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+            "metadata": {"name": "pod-creator", "namespace": "default"},
+            "rules": [{"apiGroups": [""], "resources": ["pods"],
+                       "verbs": ["create", "get", "list"]}],
+        })
+        store.create({
+            "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+            "metadata": {"name": "alice-pods", "namespace": "default"},
+            "roleRef": {"kind": "Role", "name": "pod-creator"},
+            "subjects": [{"kind": "User", "name": "alice@example.com"}],
+        })
+        alice = RemoteStore(base, token="alice-token")
+        alice.create(mkpod("scoped"))  # pods: allowed
+        cm = REGISTRY.for_kind("v1", "ConfigMap")
+        with pytest.raises(ApiError) as ei:
+            alice.list(cm, "default")  # configmaps: not in the rules
+        assert ei.value.code == 403
+
+    def test_controller_runtime_works_with_auth_on(self, authed):
+        """The full remote-controller loop (watch + reconcile + status) runs
+        against the gated apiserver with a role token."""
+        store, base = authed
+        run_gc_loop(store, interval=0.05)
+        remote = RemoteStore(base, token="ctl-token")
+        mgr = Manager(store=remote)
+        mgr.add(PodletReconciler())
+        mgr.start()
+        try:
+            remote.create(mkpod("gated"))
+            deadline = time.time() + 10
+            phase = ""
+            while time.time() < deadline:
+                phase = remote.get(PODS, "gated", "default").get("status", {}).get("phase", "")
+                if phase == "Running":
+                    break
+                time.sleep(0.05)
+            assert phase == "Running"
+        finally:
+            mgr.stop()
+
+    def test_anonymous_read_toggle(self):
+        from kubeflow_tpu.apiserver.auth import ApiAuth, RBACAuthorizer, TokenAuthenticator
+
+        store = Store()
+        auth = ApiAuth(TokenAuthenticator(), RBACAuthorizer(store), anonymous_read=True)
+        server = make_apiserver_app(store, auth=auth).serve(0)
+        try:
+            anon = RemoteStore(f"http://127.0.0.1:{server.port}", token="")
+            assert anon.list(PODS, "default") == []  # read allowed
+            from kubeflow_tpu.apiserver.store import ApiError
+
+            with pytest.raises(ApiError) as ei:
+                anon.create(mkpod("nope"))
+            assert ei.value.code == 401  # writes still need identity
+        finally:
+            server.close()
+
+    def test_token_table_from_env(self, monkeypatch, tmp_path):
+        from kubeflow_tpu.apiserver.auth import TokenAuthenticator
+
+        f = tmp_path / "tokens.csv"
+        f.write_text('filetok,carol@example.com,uid3,"system:kubeflow-tpu,extra"\n')
+        monkeypatch.setenv("APISERVER_TOKENS", "t1:bob@example.com:system:masters")
+        monkeypatch.setenv("APISERVER_TOKEN_FILE", str(f))
+        authn = TokenAuthenticator.from_env()
+        bob = authn.authenticate_token("t1")
+        assert bob.user == "bob@example.com"
+        carol = authn.authenticate_token("filetok")
+        assert carol.user == "carol@example.com"
+        assert "system:kubeflow-tpu" in carol.groups and "extra" in carol.groups

@@ -1,0 +1,89 @@
+"""Composed dp x fsdp x tp x pp GPT step (parallel/composite.py).
+
+The strongest check available on the virtual mesh: the SAME init run under
+different mesh factorizations must produce the SAME loss trajectory — the
+composition of pipeline ppermute streaming, Megatron psums, ZeRO gathers,
+and batch sharding is exactly arithmetic-equivalent to the plain program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshConfig, make_mesh
+from kubeflow_tpu.parallel.composite import (
+    CompositeConfig,
+    batch_sharding,
+    init_params,
+    make_train_step,
+    param_shardings,
+)
+
+CFG = CompositeConfig(vocab_size=64, d_model=32, n_heads=4, d_ff=64, n_layers=4, seq=16)
+
+
+def _run_steps(mesh, n_steps=3, micro=4, mb=8):
+    rng = jax.random.PRNGKey(0)
+    params = init_params(rng, CFG, mesh)
+    ids = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (micro, mb, CFG.seq), 0, CFG.vocab_size),
+        batch_sharding(mesh),
+    )
+    step = make_train_step(CFG, mesh)
+    losses = []
+    for _ in range(n_steps):
+        params, loss = step(params, ids)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_full_composition_trains():
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+    params, losses = _run_steps(mesh, n_steps=4)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+def test_factorizations_are_equivalent():
+    """dp8 (trivial pp/tp/fsdp) and fsdp2 x tp2 x pp2 compute the same math."""
+    mesh_a = make_mesh(MeshConfig(data=8))
+    mesh_b = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+    _, losses_a = _run_steps(mesh_a)
+    _, losses_b = _run_steps(mesh_b)
+    np.testing.assert_allclose(losses_a, losses_b, rtol=2e-4)
+
+
+def test_checkpoint_restores_across_factorization(tmp_path):
+    """Save under one factorization, restore under another, keep training —
+    the elastic-resume path dryrun phase 5 drives (VERDICT r3 #6)."""
+    from kubeflow_tpu.training.checkpoint import Checkpointer
+
+    mesh_a = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+    params, losses_a = _run_steps(mesh_a, n_steps=2)
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(1, params)
+
+    mesh_b = make_mesh(MeshConfig(data=2, fsdp=2, model=1, pipe=2))
+    template = param_shardings(CFG, mesh_b)
+    abstract = jax.tree_util.tree_map(
+        lambda p, s: jax.ShapeDtypeStruct(p.shape, p.dtype, sharding=s), params, template
+    )
+    restored = ckpt.restore(abstract)
+    ckpt.close()
+    # restored arrays land sharded for mesh_b and training continues
+    ids = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 8, CFG.seq), 0, CFG.vocab_size),
+        batch_sharding(mesh_b),
+    )
+    step_b = make_train_step(CFG, mesh_b)
+    restored, loss = step_b(restored, ids)
+    assert np.isfinite(float(loss))
+    # the post-restore loss continues the mesh_a trajectory (same math)
+    assert float(loss) < losses_a[0]
+
+
+def test_rejects_indivisible_layers():
+    mesh = make_mesh(MeshConfig(data=2, pipe=4))
+    with pytest.raises(ValueError, match="not divisible"):
+        init_params(jax.random.PRNGKey(0), CompositeConfig(n_layers=3), mesh)

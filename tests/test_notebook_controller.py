@@ -314,6 +314,29 @@ def test_warning_events_mirrored_onto_notebook(platform):
     assert mirrored[0]["message"] == "volume not found"
 
 
+def test_mirror_memo_bounded_and_cleared_on_delete(platform, monkeypatch):
+    """The mirrored-event dedupe memo is FIFO-capped and dropped per
+    notebook on delete (round-3 advisor: unbounded per-(reason, message)
+    growth in a long-lived controller)."""
+    from kubeflow_tpu.controllers import notebook as nbmod
+
+    monkeypatch.setattr(nbmod, "MIRROR_MEMO_CAP", 8)
+    rec = next(
+        c.reconciler for c in platform._controllers
+        if isinstance(c.reconciler, nbmod.NotebookReconciler)
+    )
+    platform.client.create(mknotebook())
+    assert platform.wait_idle()
+    pod = platform.client.get("v1", "Pod", "nb-0", "team-a")
+    for i in range(20):
+        platform.client.emit_event(pod, "FailedMount", f"msg-{i}", type_="Warning")
+        platform.wait_idle()
+    assert 0 < len(rec._mirrored_keys) <= 8
+    platform.client.delete("kubeflow.org/v1beta1", "Notebook", "nb", "team-a")
+    assert platform.wait_idle()
+    assert all(k[:2] != ("team-a", "nb") for k in rec._mirrored_keys)
+
+
 def test_notebook_delete_cascades(platform):
     platform.client.create(mknotebook(tpu={"generation": "v5e", "topology": "2x4"}))
     assert platform.wait_idle()

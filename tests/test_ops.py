@@ -167,18 +167,34 @@ class TestAutoTiling:
         assert _auto_block(1280, 512) == 256  # largest 128-aligned divisor
         assert _auto_block(64, 512) == 64     # shorter than a lane tile
         assert _auto_block(128, 512) == 128
-        assert _auto_block(192, 512) == 192   # no 128-aligned divisor: plain
-        assert _auto_block(960, 512) == 480   # largest plain divisor <= cap
+        assert _auto_block(192, 512) == 192   # no 128-aligned divisor: 8-aligned
+        assert _auto_block(960, 512) == 480   # largest 8-aligned divisor <= cap
         assert _auto_block(1021, 512) == 1021  # prime: ONE whole-length block
-        assert _auto_block(1250, 512) == 250   # plain divisor above the floor
-        assert _auto_block(1255, 512) == 251   # 5*251: divisor >= 64 exists
-        assert _auto_block(127 * 2, 512) == 254  # 2*127: 127 < floor? no, 254
-        # tiny-divisor-only lengths never tile below 64
+        # Fallback divisors must be 8-aligned (Mosaic sublane tiling): 1250's
+        # divisors (250, 125, ...) are all rejected -> whole length, which the
+        # TPU path then refuses with a clear error (ADVICE r3).
+        assert _auto_block(1250, 512) == 1250
+        assert _auto_block(1255, 512) == 1255  # 251 not 8-aligned
+        assert _auto_block(1216, 512) == 304   # 8-aligned non-128 divisor kept
+        # lengths either tile 8-aligned >= 64 or run as one whole block
         from kubeflow_tpu.ops.flash_attention import _auto_block as ab
-        for length in (1021, 1031, 2047):
+        for length in (1021, 1031, 2047, 1250, 254):
             b = ab(length, 512)
-            assert b >= 64 or b == length, (length, b)
+            assert (b >= 64 and b % 8 == 0) or b == length, (length, b)
             assert length % b == 0
+
+    def test_non_tileable_length_rejected_on_tpu_path(self):
+        import pytest
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+
+        q = jnp.zeros((1, 1021, 2, 64), jnp.float32)
+        # interpret=False takes the TPU path; the 8-alignment check fires
+        # before any pallas_call, so this is testable on CPU.
+        with pytest.raises(ValueError, match="8-aligned"):
+            flash_attention(q, q, q, interpret=False)
+        # interpret mode still runs whole-length blocks of any size
+        out = flash_attention(q, q, q, interpret=True)
+        assert out.shape == q.shape
 
     def test_auto_block_always_divides(self):
         from kubeflow_tpu.ops.flash_attention import _auto_block
