@@ -250,10 +250,12 @@ def _run_serving(platform: str) -> dict:
     """Serving rows condensed for the summary: BERT HTTP p50 at batch 8 and
     KV-decode tokens/s at batch 8 (full sweep on the per-metric line)."""
     try:
-        from e2e.serving_bench import bench_bert_http, bench_gpt_decode
+        from e2e.serving_bench import bench_bert_http, bench_continuous, bench_gpt_decode
 
         bert = bench_bert_http()
         decode = bench_gpt_decode()
+        cont = (bench_continuous()
+                if os.environ.get("BENCH_CONTINUOUS", "1") == "1" else None)
         b8 = next((r for r in bert if r["batch"] == 8), bert[-1])
         d8 = next((r for r in decode if r["batch"] == 8), decode[-1])
         return _emit({
@@ -264,6 +266,7 @@ def _run_serving(platform: str) -> dict:
             "bert_http_p50_ms_b8": b8["p50_ms"],
             "bert_http_rows": bert,
             "decode_rows": decode,
+            "continuous_batching": cont,
             "platform": platform,
         })
     except Exception as e:
@@ -277,8 +280,10 @@ def _run_hpo(platform: str) -> dict:
         from e2e.studyjob_driver import run_studyjob_e2e
 
         max_trials = int(os.environ.get("BENCH_HPO_TRIALS", "16"))
+        early = os.environ.get("BENCH_HPO_EARLYSTOP", "1") == "1"
         status = run_studyjob_e2e(
-            "mnist", max_trials=max_trials, parallel=4, timeout=900.0)
+            "mnist", max_trials=max_trials, parallel=4, timeout=900.0,
+            early_stopping=early)
         return _emit({
             "metric": "hpo_mnist_trials_per_hour",
             "value": status["trialsPerHour"],

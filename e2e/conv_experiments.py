@@ -176,6 +176,145 @@ def conv_stem_s2d() -> Dict[str, Any]:
     return _conv_probe(B, 112, 12, 64, 4, 1, f"stem-s2d conv4x4 b{B} 112x112x12->64 (XLA)")
 
 
+def _conv_bwd_probe(which: str, cin: int = C, hw: int = HW) -> Dict[str, Any]:
+    """Backward-pass decomposition at the stage-1 3x3 shape: time
+    fwd+grad-wrt-x ('x'), fwd+grad-wrt-w ('w'), or the full training shape
+    ('both'). The loss is sum(abs(conv)) so dY depends on x (a plain sum
+    would make dY constant-foldable); grads feed the next chain step so
+    nothing is dead."""
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (B, hw, hw, cin), jnp.bfloat16)
+    k0 = jax.random.normal(key, (3, 3, cin, cin), jnp.bfloat16) * 0.05
+    dn = jax.lax.conv_dimension_numbers(x0.shape, k0.shape, ("NHWC", "HWIO", "NHWC"))
+
+    def loss(x, k):
+        y = jax.lax.conv_general_dilated(x, k, (1, 1), "SAME", dimension_numbers=dn)
+        return jnp.sum(jnp.abs(y.astype(jnp.float32)))
+
+    @jax.jit
+    def run(x, k):
+        def body(x, _):
+            for _i in range(CHAIN):
+                if which == "x":
+                    dx = jax.grad(loss, argnums=0)(x, k)
+                    x = (jnp.abs(dx) * 0.01).astype(jnp.bfloat16)
+                elif which == "w":
+                    dw = jax.grad(loss, argnums=1)(x, k)
+                    # dw is tiny [3,3,cin,cin]; keep it live through x
+                    x = x * (1.0 + jnp.sum(jnp.abs(dw)) * jnp.bfloat16(1e-30))
+                else:
+                    dx, dw = jax.grad(loss, argnums=(0, 1))(x, k)
+                    x = (jnp.abs(dx) * 0.01
+                         + jnp.sum(jnp.abs(dw)) * jnp.bfloat16(1e-30)).astype(jnp.bfloat16)
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x0, k0), ITERS * CHAIN)
+    conv_f = 2.0 * B * hw * hw * 9 * cin * cin
+    flops = conv_f * (3.0 if which == "both" else 2.0)  # fwd + 1-2 grad convs
+    return {"kernel": f"conv3x3 {hw}x{hw}x{cin} fwd+grad_{which}",
+            "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def _conv1x1_bwd_probe(cin: int, cout: int, hw: int = HW) -> Dict[str, Any]:
+    """fwd+bwd of the bottleneck's 1x1 convs (projection GEMMs)."""
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (B, hw, hw, cin), jnp.bfloat16)
+    k0 = jax.random.normal(key, (1, 1, cin, cout), jnp.bfloat16) * 0.05
+    dn = jax.lax.conv_dimension_numbers(x0.shape, k0.shape, ("NHWC", "HWIO", "NHWC"))
+
+    def loss(x, k):
+        y = jax.lax.conv_general_dilated(x, k, (1, 1), "SAME", dimension_numbers=dn)
+        return jnp.sum(jnp.abs(y.astype(jnp.float32)))
+
+    @jax.jit
+    def run(x, k):
+        def body(x, _):
+            for _i in range(CHAIN):
+                dx, dw = jax.grad(loss, argnums=(0, 1))(x, k)
+                x = (jnp.abs(dx) * 0.01
+                     + jnp.sum(jnp.abs(dw)) * jnp.bfloat16(1e-30)).astype(jnp.bfloat16)
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x0, k0), ITERS * CHAIN)
+    flops = 3.0 * 2.0 * B * hw * hw * cin * cout
+    return {"kernel": f"conv1x1 {hw}x{hw} {cin}->{cout} fwd+grad_both",
+            "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def conv1x1_grad_reduce() -> Dict[str, Any]:
+    return _conv1x1_bwd_probe(256, 64)
+
+
+def conv1x1_grad_expand() -> Dict[str, Any]:
+    return _conv1x1_bwd_probe(64, 256)
+
+
+def bottleneck_block_fwd_bwd() -> Dict[str, Any]:
+    """The WHOLE stage-1 bottleneck (1x1 256->64, 3x3 64->64, 1x1 64->256 +
+    relu + residual; frozen scale/bias norm) fwd+bwd — isolates whether the
+    stage tower's deficit is the conv mix itself or the BN/elementwise
+    interleave around it."""
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, (B, HW, HW, 256), jnp.bfloat16) * 0.1
+    ks = {
+        "k1": jax.random.normal(key, (1, 1, 256, 64), jnp.bfloat16) * 0.05,
+        "k2": jax.random.normal(key, (3, 3, 64, 64), jnp.bfloat16) * 0.05,
+        "k3": jax.random.normal(key, (1, 1, 64, 256), jnp.bfloat16) * 0.05,
+        "s1": jnp.ones((64,), jnp.bfloat16), "b1": jnp.zeros((64,), jnp.bfloat16),
+        "s2": jnp.ones((64,), jnp.bfloat16), "b2": jnp.zeros((64,), jnp.bfloat16),
+        "s3": jnp.ones((256,), jnp.bfloat16), "b3": jnp.zeros((256,), jnp.bfloat16),
+    }
+
+    def block(x, p):
+        def conv(x, k):
+            dn = jax.lax.conv_dimension_numbers(x.shape, k.shape, ("NHWC", "HWIO", "NHWC"))
+            return jax.lax.conv_general_dilated(x, k, (1, 1), "SAME", dimension_numbers=dn)
+        y = jnp.maximum(conv(x, p["k1"]) * p["s1"] + p["b1"], 0)
+        y = jnp.maximum(conv(y, p["k2"]) * p["s2"] + p["b2"], 0)
+        y = conv(y, p["k3"]) * p["s3"] + p["b3"]
+        return jnp.maximum(x + y, 0)
+
+    def loss(x, p):
+        return jnp.sum(jnp.abs(block(x, p).astype(jnp.float32)))
+
+    @jax.jit
+    def run(x, p):
+        def body(x, _):
+            for _i in range(CHAIN):
+                dx, dp = jax.grad(loss, argnums=(0, 1))(x, p)
+                dpsum = sum(jnp.sum(jnp.abs(g)) for g in jax.tree_util.tree_leaves(dp))
+                x = (jnp.abs(dx) * 0.05 + dpsum * jnp.bfloat16(1e-30)).astype(jnp.bfloat16)
+            return x, ()
+        x, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return jnp.sum(x.astype(jnp.float32))
+
+    dt = _timed(run, (x0, ks), ITERS * CHAIN)
+    conv_f = 2.0 * B * HW * HW * (256 * 64 + 9 * 64 * 64 + 64 * 256)
+    flops = 3.0 * conv_f  # fwd + dX + dW
+    return {"kernel": "bottleneck(256->64->64->256) fwd+bwd frozen-norm",
+            "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def conv_grad_x() -> Dict[str, Any]:
+    return _conv_bwd_probe("x")
+
+
+def conv_grad_w() -> Dict[str, Any]:
+    return _conv_bwd_probe("w")
+
+
+def conv_grad_both() -> Dict[str, Any]:
+    return _conv_bwd_probe("both")
+
+
+def conv_grad_both_128() -> Dict[str, Any]:
+    return _conv_bwd_probe("both", cin=128, hw=28)
+
+
 PROBES: Dict[str, Callable[[], Dict[str, Any]]] = {
     "gemm_conv_style": gemm_conv_style,
     "gemm_spatial_lanes": gemm_spatial_lanes,
@@ -184,6 +323,13 @@ PROBES: Dict[str, Callable[[], Dict[str, Any]]] = {
     "conv_xla_fused": conv_xla_fused,
     "conv_stem": conv_stem,
     "conv_stem_s2d": conv_stem_s2d,
+    "conv_grad_x": conv_grad_x,
+    "conv_grad_w": conv_grad_w,
+    "conv_grad_both": conv_grad_both,
+    "conv_grad_both_128": conv_grad_both_128,
+    "conv1x1_grad_reduce": conv1x1_grad_reduce,
+    "conv1x1_grad_expand": conv1x1_grad_expand,
+    "bottleneck_block_fwd_bwd": bottleneck_block_fwd_bwd,
 }
 
 

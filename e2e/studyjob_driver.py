@@ -24,7 +24,12 @@ from .junit import run_driver
 OBJECTIVES = {"quadratic": quadratic_objective, "mnist": mnist_objective}
 
 
-def studyjob_cr(name: str, ns: str, max_trials: int, parallel: int) -> Dict[str, Any]:
+def studyjob_cr(name: str, ns: str, max_trials: int, parallel: int,
+                early_stopping: bool = False) -> Dict[str, Any]:
+    spec_extra: Dict[str, Any] = {}
+    if early_stopping:
+        spec_extra["earlyStopping"] = {
+            "algorithmName": "medianstop", "settings": {"minTrials": 3}}
     return {
         "apiVersion": STUDY_API,
         "kind": "StudyJob",
@@ -34,6 +39,7 @@ def studyjob_cr(name: str, ns: str, max_trials: int, parallel: int) -> Dict[str,
             "algorithm": {"algorithmName": "bayesian"},
             "parallelTrialCount": parallel,
             "maxTrialCount": max_trials,
+            **spec_extra,
             "parameters": [
                 {
                     "name": "lr",
@@ -56,15 +62,20 @@ def run_studyjob_e2e(
     max_trials: int = 6,
     parallel: int = 2,
     timeout: float = 120.0,
+    early_stopping: bool = False,
 ) -> Dict[str, Any]:
     """Create a StudyJob, drive it to completion, return its final status
-    (including measured trials/hour — the BASELINE Katib metric)."""
+    (including measured trials/hour — the BASELINE Katib metric).
+    ``early_stopping`` turns on the median-stopping rule: bad trials get
+    pruned mid-run (hpo/earlystop.py), raising trials/hour at equal
+    best-trial quality."""
     import time as _time
 
     with E2ECluster(trial_runner=InProcessTrialRunner(OBJECTIVES[objective])) as cluster:
         ns = cluster.create_profile("katib-e2e@example.com", unique_namespace("katib"))
         t_start = _time.perf_counter()
-        cluster.client.create(studyjob_cr("study-e2e", ns, max_trials, parallel))
+        cluster.client.create(
+            studyjob_cr("study-e2e", ns, max_trials, parallel, early_stopping))
 
         def get_phase() -> str:
             study = cluster.client.get(STUDY_API, "StudyJob", "study-e2e", ns)
@@ -82,7 +93,8 @@ def run_studyjob_e2e(
 
         study = cluster.client.get(STUDY_API, "StudyJob", "study-e2e", ns)
         status = study["status"]
-        assert status["trialsSucceeded"] == max_trials, status
+        finished = status["trialsSucceeded"] + status.get("trialsPruned", 0)
+        assert finished == max_trials, status
         optimal = status.get("currentOptimalTrial")
         assert optimal, "completed study published no optimal trial"
         best = optimal["observation"]["accuracy"]
@@ -105,12 +117,16 @@ def main(argv=None) -> int:
         parser.add_argument("--objective", choices=sorted(OBJECTIVES), default="quadratic")
         parser.add_argument("--max-trials", type=int, default=6)
         parser.add_argument("--timeout", type=float, default=120.0)
+        parser.add_argument("--early-stopping", action="store_true",
+                            help="enable the median-stopping pruner")
 
     return run_driver(
         "e2e-studyjob",
         "StudyJobE2E",
         lambda args: f"studyjob-{args.objective}",
-        lambda args: lambda: run_studyjob_e2e(args.objective, args.max_trials, timeout=args.timeout),
+        lambda args: lambda: run_studyjob_e2e(
+            args.objective, args.max_trials, timeout=args.timeout,
+            early_stopping=args.early_stopping),
         argv=argv,
         add_args=add_args,
         default_junit="junit_studyjob.xml",

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
+from ..hpo import earlystop
 from ..hpo.suggest import GridSuggester, ParamSpec, make_suggester
 from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
@@ -79,6 +80,7 @@ class StudyJobReconciler(Reconciler):
             maximize = objective.get("type", "maximize") == "maximize"
             algorithm = (spec.get("algorithm") or {}).get("algorithmName", "random")
             suggester = make_suggester(algorithm, specs, maximize, seed=spec.get("seed", 0))
+            early = earlystop.parse_early_stopping(spec)
         except ValueError as e:
             self._set_status(client, study, {"phase": "Failed", "reason": "InvalidSpec", "message": str(e)})
             METRICS.counter("studyjob_failed_total").inc()
@@ -91,13 +93,21 @@ class StudyJobReconciler(Reconciler):
         ]
         completed = [t for t in trials if t.get("status", {}).get("phase") == "Succeeded"]
         failed = [t for t in trials if t.get("status", {}).get("phase") == "Failed"]
-        active = [t for t in trials if t not in completed and t not in failed]
+        pruned = [t for t in trials if t.get("status", {}).get("phase") == "Pruned"]
+        active = [t for t in trials
+                  if t not in completed and t not in failed and t not in pruned]
 
         metric_name = objective.get("objectiveMetricName", "objective")
-        for t in completed:
+        # Pruned trials feed the suggester too: their last observation is a
+        # real (censored) measurement — dropping it would make the GP re-ask
+        # near known-bad regions.
+        for t in completed + pruned:
             value = (t.get("status", {}).get("metrics") or {}).get(metric_name)
             if value is not None:
                 suggester.tell(t.get("spec", {}).get("parameters", {}), float(value))
+
+        if early is not None and active:
+            self._apply_median_stopping(client, active, completed, maximize, early)
 
         max_trials = int(spec.get("maxTrialCount", 10))
         parallel = int(spec.get("parallelTrialCount", 3))
@@ -108,7 +118,7 @@ class StudyJobReconciler(Reconciler):
         if best is not None and goal is not None:
             goal_reached = best.objective >= float(goal) if maximize else best.objective <= float(goal)
 
-        done = len(completed) + len(failed)
+        done = len(completed) + len(failed) + len(pruned)
         exhausted = False
         if isinstance(suggester, GridSuggester):
             # Fast-forward the deterministic grid cursor past every point a
@@ -124,6 +134,7 @@ class StudyJobReconciler(Reconciler):
                 "trialsTotal": len(trials),
                 "trialsSucceeded": len(completed),
                 "trialsFailed": len(failed),
+                "trialsPruned": len(pruned),
                 "goalReached": goal_reached,
             }
             if exhausted and not goal_reached and done < max_trials:
@@ -156,6 +167,7 @@ class StudyJobReconciler(Reconciler):
             "trialsTotal": len(trials),
             "trialsSucceeded": len(completed),
             "trialsFailed": len(failed),
+            "trialsPruned": len(pruned),
             "trialsRunning": len(active) + created,
         }
         if best:
@@ -165,6 +177,37 @@ class StudyJobReconciler(Reconciler):
             }
         self._set_status(client, study, new_status)
         return Result()
+
+    def _apply_median_stopping(
+        self,
+        client: Client,
+        active: List[Dict[str, Any]],
+        completed: List[Dict[str, Any]],
+        maximize: bool,
+        early: Dict[str, Any],
+    ) -> None:
+        """Mark active losers with the early-stop annotation (the trial side
+        reads it at its next intermediate report and exits — earlystop.py)."""
+        histories = {
+            apimeta.name_of(t): earlystop.observations_of(t) for t in active + completed
+        }
+        for t in active:
+            name = apimeta.name_of(t)
+            if earlystop.EARLY_STOP_ANNOTATION in apimeta.annotations_of(t):
+                continue
+            mine = histories.get(name) or []
+            others = [h for n, h in histories.items() if n != name and h]
+            if earlystop.should_stop(
+                mine, others, maximize=maximize,
+                min_trials=early["min_trials"], min_step=early["min_step"],
+            ):
+                client.patch(
+                    STUDY_API, "Trial", name,
+                    {"metadata": {"annotations": {
+                        earlystop.EARLY_STOP_ANNOTATION: "medianstop"}}},
+                    apimeta.namespace_of(t),
+                )
+                METRICS.counter("studyjob_trials_pruned_total").inc()
 
     def _create_trial(
         self, client: Client, study: Dict[str, Any], params: Dict[str, Any], index: int
@@ -260,17 +303,43 @@ class TrialPodRunner(Reconciler):
             self._set_phase(client, trial, "Running")
             return Result()
 
+        annotations = apimeta.annotations_of(trial)
         pod_phase = pod.get("status", {}).get("phase")
-        results = apimeta.annotations_of(trial).get("results")
+        results = annotations.get("results")
+        observations = self._parse_observations(annotations)
         if pod_phase == "Succeeded" or results:
             metrics = json.loads(results) if results else {}
-            self._set_phase(client, trial, "Succeeded", metrics)
+            # an early-stopped pod still exits 0 with its last metrics — the
+            # annotation distinguishes pruned from fully-run (earlystop.py)
+            phase = ("Pruned" if earlystop.EARLY_STOP_ANNOTATION in annotations
+                     else "Succeeded")
+            self._set_phase(client, trial, phase, metrics, observations)
         elif pod_phase == "Failed":
             self._set_phase(client, trial, "Failed")
+        elif observations:
+            # fold the reporter's intermediate observations into status so
+            # the StudyJobReconciler's median-stopping pass sees them
+            self._set_phase(client, trial, "Running", None, observations)
         return Result()
 
+    @staticmethod
+    def _parse_observations(annotations: Dict[str, str]) -> Optional[List[Dict]]:
+        raw = annotations.get(earlystop.OBSERVATIONS_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            obs = json.loads(raw)
+            return obs if isinstance(obs, list) else None
+        except ValueError:
+            return None
+
     def _set_phase(
-        self, client: Client, trial: Dict[str, Any], phase: str, metrics: Optional[Dict] = None
+        self,
+        client: Client,
+        trial: Dict[str, Any],
+        phase: str,
+        metrics: Optional[Dict] = None,
+        observations: Optional[List[Dict]] = None,
     ) -> None:
         fresh = client.get_opt(*self.FOR, apimeta.name_of(trial), apimeta.namespace_of(trial))
         if fresh is None:
@@ -278,6 +347,8 @@ class TrialPodRunner(Reconciler):
         status = {"phase": phase}
         if metrics:
             status["metrics"] = metrics
+        if observations:
+            status["observations"] = observations
         if fresh.get("status") == status:
             return
         fresh = apimeta.deepcopy(fresh)
@@ -291,20 +362,61 @@ class InProcessTrialRunner(Reconciler):
     The CPU analog of a TPU trial pod (the reference's katib e2e is likewise
     CPU-only — SURVEY §4). ``objective_fn(params) -> {metric: value}`` is
     typically a short JAX training run (see kubeflow_tpu.hpo.trials).
+    Objectives that accept a ``report_fn`` kwarg get intermediate-metric
+    reporting: each report lands in ``status.observations`` (which triggers
+    the StudyJobReconciler's median-stopping pass via the OWNS watch), and
+    the returned bool tells the objective whether to continue — False once
+    the study controller marked this trial with the early-stop annotation.
     """
 
     FOR = (STUDY_API, "Trial")
 
-    def __init__(self, objective_fn: Callable[[Dict[str, Any]], Dict[str, float]]):
+    def __init__(self, objective_fn: Callable[..., Dict[str, float]]):
+        import inspect
+
         self.objective_fn = objective_fn
+        try:
+            self._accepts_report = "report_fn" in inspect.signature(objective_fn).parameters
+        except (TypeError, ValueError):
+            self._accepts_report = False
 
     def reconcile(self, client: Client, req: Request) -> Result:
         trial = client.get_opt(*self.FOR, req.name, req.namespace)
-        if trial is None or trial.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+        if trial is None or trial.get("status", {}).get("phase") in (
+            "Succeeded", "Failed", "Pruned",
+        ):
             return Result()
+        spec = trial.get("spec", {})
+        metric_name = spec.get("objectiveMetricName", "objective")
+        observations: List[Dict[str, float]] = []
+
+        def report_fn(step: float, metrics: Dict[str, float]) -> bool:
+            fresh = client.get_opt(*self.FOR, req.name, req.namespace)
+            value = metrics.get(metric_name)
+            if value is not None and fresh is not None:
+                observations.append({"step": float(step), "value": float(value)})
+                updated = apimeta.deepcopy(fresh)
+                updated["status"] = {"phase": "Running", "observations": list(observations)}
+                client.update_status(updated)
+            # the early-stop mark from a PREVIOUS report interval arrives by
+            # now via the study reconciler; one fetch serves both purposes
+            stopped = fresh is not None and (
+                earlystop.EARLY_STOP_ANNOTATION in apimeta.annotations_of(fresh)
+            )
+            return not stopped
+
         try:
-            metrics = self.objective_fn(trial.get("spec", {}).get("parameters", {}))
-            status = {"phase": "Succeeded", "metrics": metrics}
+            if self._accepts_report:
+                metrics = self.objective_fn(spec.get("parameters", {}), report_fn=report_fn)
+            else:
+                metrics = self.objective_fn(spec.get("parameters", {}))
+            fresh = client.get_opt(*self.FOR, req.name, req.namespace)
+            was_pruned = fresh is not None and (
+                earlystop.EARLY_STOP_ANNOTATION in apimeta.annotations_of(fresh)
+            )
+            status = {"phase": "Pruned" if was_pruned else "Succeeded", "metrics": metrics}
+            if observations:
+                status["observations"] = observations
         except Exception as e:  # a failed trial is data, not a controller error
             log.warning("trial %s failed: %s", req.name, e)
             status = {"phase": "Failed", "message": str(e)}

@@ -14,3 +14,4 @@ from kubeflow_tpu.hpo.suggest import (  # noqa: F401
     RandomSuggester,
     make_suggester,
 )
+from kubeflow_tpu.hpo.earlystop import should_stop as median_should_stop  # noqa: F401

@@ -77,6 +77,48 @@ def report(
     )
 
 
+def report_intermediate(
+    step: float,
+    metrics: Dict[str, float],
+    name: str,
+    namespace: str,
+    url: Optional[str] = None,
+    client=None,
+) -> bool:
+    """Append (step, metrics) to the Trial's observations annotation and
+    return whether to CONTINUE — False once the StudyJob controller marked
+    this trial with the early-stop annotation (median stopping,
+    hpo/earlystop.py). The trial then exits 0 with its last metrics."""
+    from ..api import meta as apimeta
+    from ..apiserver.client import Client
+    from ..hpo.earlystop import EARLY_STOP_ANNOTATION, OBSERVATIONS_ANNOTATION
+    from ..runtime.bootstrap import connect
+
+    api = "katib.kubeflow.org/v1alpha1"
+    client = client or Client(connect(url))
+    trial = client.get(api, "Trial", name, namespace)
+    annotations = apimeta.annotations_of(trial)
+    try:
+        obs = json.loads(annotations.get(OBSERVATIONS_ANNOTATION) or "[]")
+    except ValueError:
+        obs = []
+    # the OBJECTIVE metric, not whichever dict entry comes first — median
+    # stopping on the wrong metric would prune the best trials of a
+    # minimize study
+    metric_name = trial.get("spec", {}).get("objectiveMetricName", "objective")
+    value = metrics.get(metric_name)
+    if not isinstance(value, (int, float)):
+        value = next((v for v in metrics.values() if isinstance(v, (int, float))), None)
+    obs.append({"step": float(step), "value": value, "metrics": metrics})
+    client.patch(
+        api, "Trial", name,
+        {"metadata": {"annotations": {OBSERVATIONS_ANNOTATION: json.dumps(obs)}}},
+        namespace,
+    )
+    fresh = client.get(api, "Trial", name, namespace)
+    return EARLY_STOP_ANNOTATION not in apimeta.annotations_of(fresh)
+
+
 def main(env: Optional[Mapping[str, str]] = None) -> int:
     """Run the objective named by the environment and report the metrics.
 
@@ -90,9 +132,27 @@ def main(env: Optional[Mapping[str, str]] = None) -> int:
         log.error("TRIAL_NAME / TRIAL_NAMESPACE not set; not running under a trial pod")
         return 2
     try:
+        import inspect
+
         params = json.loads(env.get("TRIAL_PARAMETERS") or "{}")
         objective = resolve_objective(env.get("TRIAL_OBJECTIVE", "mnist"))
-        metrics = objective(params)
+        kwargs = {}
+        try:
+            accepts_report = "report_fn" in inspect.signature(objective).parameters
+        except (TypeError, ValueError):
+            accepts_report = False
+        if accepts_report:
+            url = env.get("APISERVER_URL")
+
+            def report_fn(step, metrics):
+                try:
+                    return report_intermediate(step, metrics, name, namespace, url=url)
+                except Exception:
+                    log.exception("intermediate report failed; continuing")
+                    return True
+
+            kwargs["report_fn"] = report_fn
+        metrics = objective(params, **kwargs)
         if not isinstance(metrics, dict) or not metrics:
             raise ValueError(f"objective returned {metrics!r}, expected a non-empty dict")
     except Exception:

@@ -16,12 +16,23 @@ import jax.numpy as jnp
 import optax
 
 
-def mnist_objective(params: Dict[str, Any], steps: int = 30, batch: int = 64) -> Dict[str, float]:
+def mnist_objective(
+    params: Dict[str, Any],
+    steps: int = 30,
+    batch: int = 64,
+    report_fn=None,
+    report_every: int = 5,
+) -> Dict[str, float]:
     """Train MnistCNN briefly on synthetic data; returns final accuracy/loss.
 
     Tunable params: lr (double), dropout (double), width (int).
     Synthetic labels are a deterministic function of the input so the task
     is learnable and hyperparameters matter.
+
+    ``report_fn(step, {metric: value}) -> bool`` (optional) receives
+    intermediate metrics every ``report_every`` steps; returning False stops
+    the run early (median-stopping — hpo/earlystop.py) and the last metrics
+    become the trial's (censored) result.
     """
     from kubeflow_tpu.models import MnistCNN
     from kubeflow_tpu.training import ClassifierTask
@@ -29,6 +40,7 @@ def mnist_objective(params: Dict[str, Any], steps: int = 30, batch: int = 64) ->
     lr = float(params.get("lr", 1e-3))
     dropout = float(params.get("dropout", 0.1))
     width = int(params.get("width", 16))
+    steps = int(params.get("steps", steps))
 
     rng = jax.random.PRNGKey(0)
     model = MnistCNN(width=width, dropout_rate=dropout, dtype=jnp.float32)
@@ -39,8 +51,13 @@ def mnist_objective(params: Dict[str, Any], steps: int = 30, batch: int = 64) ->
     state = task.init(rng, imgs)
     step = task.make_train_step()
     metrics = {}
-    for _ in range(steps):
+    for i in range(steps):
         state, metrics = step(state, imgs, labels)
+        if report_fn is not None and (i + 1) % report_every == 0 and i + 1 < steps:
+            cont = report_fn(i + 1, {"accuracy": float(metrics["accuracy"]),
+                                     "loss": float(metrics["loss"])})
+            if cont is False:
+                break
     return {
         "accuracy": float(metrics["accuracy"]),
         "loss": float(metrics["loss"]),

@@ -65,12 +65,17 @@ class GptConfig:
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding. x: [b, L, heads, head_dim]; positions: [L]."""
+    """Rotary embedding. x: [b, L, heads, head_dim]; positions: [L] (shared
+    across the batch) or [b, L] (per-row — continuous batching, where each
+    slot sits at its own sequence position)."""
     half = x.shape[-1] // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [L, half]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., L, half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if positions.ndim == 1:
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:  # [b, L, half] -> broadcast over heads
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
@@ -86,6 +91,7 @@ class GptAttention(nn.Module):
     cfg: GptConfig
     attention_fn: Callable = causal_flash_attention
     decode: bool = False
+    per_slot: bool = False  # per-row cache cursors (continuous batching)
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -120,7 +126,13 @@ class GptAttention(nn.Module):
         """Incremental attention against a KV cache (prefill: L>1 from
         position 0; decode steps: L==1 appended at the cache cursor).
         Static shapes throughout — the cache is [b, max_seq, h, d] and the
-        validity mask makes unwritten slots invisible."""
+        validity mask makes unwritten slots invisible.
+
+        ``per_slot=True`` keeps a cursor PER ROW (``cursors`` [b]) so every
+        batch slot sits at its own sequence position — the cache layout
+        continuous batching needs (serving/continuous.py): sequences join
+        and leave the running batch without touching other rows.
+        """
         cfg = self.cfg
         b, seg_len = x.shape[0], x.shape[1]
         cache_k = self.variable(
@@ -129,21 +141,51 @@ class GptAttention(nn.Module):
         cache_v = self.variable(
             "cache", "v", jnp.zeros, (b, cfg.max_seq, cfg.n_heads, cfg.head_dim), cfg.dtype
         )
-        cursor = self.variable("cache", "cursor", lambda: jnp.zeros((), jnp.int32))
-        start = cursor.value
-        seg_positions = start + jnp.arange(seg_len)
-
-        q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
-        k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
-        v = dense(name="value")(x)
-        keys = jax.lax.dynamic_update_slice(cache_k.value, k, (0, start, 0, 0))
-        values = jax.lax.dynamic_update_slice(cache_v.value, v, (0, start, 0, 0))
+        if self.per_slot:
+            cursors = self.variable("cache", "cursors", lambda: jnp.zeros((b,), jnp.int32))
+            start = cursors.value                                   # [b]
+            seg_positions = start[:, None] + jnp.arange(seg_len)    # [b, L]
+            q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
+            k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
+            v = dense(name="value")(x)
+            if seg_len == 1:
+                # broadcast-select instead of vmapped dynamic_update_slice:
+                # the vmap form lowers to a scatter (measured ~3x slower
+                # per decode step); a where over the cache fuses into one
+                # elementwise pass
+                at = (jnp.arange(cfg.max_seq)[None, :, None, None]
+                      == start[:, None, None, None])                # [b,max,1,1]
+                keys = jnp.where(at, k, cache_k.value)
+                values = jnp.where(at, v, cache_v.value)
+            else:
+                upd = jax.vmap(
+                    lambda cache_row, seg, s: jax.lax.dynamic_update_slice(
+                        cache_row, seg, (s, 0, 0))
+                )
+                keys = upd(cache_k.value, k, start)
+                values = upd(cache_v.value, v, start)
+            mask = (jnp.arange(cfg.max_seq)[None, None, None, :]
+                    <= seg_positions[:, None, :, None])             # [b,1,L,max]
+        else:
+            cursor = self.variable("cache", "cursor", lambda: jnp.zeros((), jnp.int32))
+            start = cursor.value
+            seg_positions = start + jnp.arange(seg_len)
+            q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
+            k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
+            v = dense(name="value")(x)
+            keys = jax.lax.dynamic_update_slice(cache_k.value, k, (0, start, 0, 0))
+            values = jax.lax.dynamic_update_slice(cache_v.value, v, (0, start, 0, 0))
+            mask = (jnp.arange(cfg.max_seq)[None, None, None, :]
+                    <= seg_positions[None, None, :, None])
         # flax init runs the forward once for shapes/params — the cache must
         # not advance then, or the first real prefill starts mid-cache.
         if not self.is_initializing():
             cache_k.value = keys
             cache_v.value = values
-            cursor.value = start + seg_len
+            if self.per_slot:
+                cursors.value = start + seg_len
+            else:
+                cursor.value = start + seg_len
 
         scale = cfg.head_dim**-0.5
         scores = (
@@ -154,8 +196,6 @@ class GptAttention(nn.Module):
             )
             * scale
         )
-        key_positions = jnp.arange(cfg.max_seq)
-        mask = key_positions[None, None, None, :] <= seg_positions[None, None, :, None]
         scores = jnp.where(mask, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, values.astype(jnp.float32))
@@ -180,12 +220,14 @@ class GptBlock(nn.Module):
     attention_fn: Callable = causal_flash_attention
     mesh: Optional[Any] = None
     decode: bool = False
+    per_slot: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
-        x = x + GptAttention(cfg, self.attention_fn, self.decode, name="attention")(
+        x = x + GptAttention(cfg, self.attention_fn, self.decode, self.per_slot,
+                             name="attention")(
             ln(name="ln_attn")(x).astype(cfg.dtype), positions
         )
         normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
@@ -216,6 +258,7 @@ class GptLM(nn.Module):
     attention_fn: Callable = causal_flash_attention
     mesh: Optional[Any] = None
     decode: bool = False
+    per_slot: bool = False
 
     @nn.compact
     def __call__(self, input_ids: jax.Array) -> jax.Array:
@@ -233,9 +276,8 @@ class GptLM(nn.Module):
         if cfg.remat:
             block = nn.remat(GptBlock, static_argnums=())
         for i in range(cfg.n_layers):
-            x = block(cfg, self.attention_fn, self.mesh, self.decode, name=f"block_{i}")(
-                x, positions
-            )
+            x = block(cfg, self.attention_fn, self.mesh, self.decode, self.per_slot,
+                      name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
         # tied LM head in f32 (embed.attend would compute in the module's
         # bf16 dtype; the final softmax wants full precision)

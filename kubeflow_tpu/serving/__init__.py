@@ -8,4 +8,5 @@ is one jitted, batched call.
 """
 
 from kubeflow_tpu.serving.server import ModelServer, ServedModel  # noqa: F401
+from kubeflow_tpu.serving.continuous import ContinuousBatcher  # noqa: F401
 from kubeflow_tpu.serving.controller import InferenceServiceReconciler  # noqa: F401

@@ -178,11 +178,20 @@ class GenerativeModel(ServedModel):
     """Serves autoregressive generation through the predict surface:
     instances = equal-length token-id prompts, predictions = full generated
     sequences. Decoding manages its own compilation cache (models/gpt.py
-    generate), so the bucket-jit path is bypassed."""
+    generate), so the bucket-jit path is bypassed.
+
+    ``continuous=True`` routes greedy requests through the slot-based
+    continuous-batching engine (serving/continuous.py): concurrent HTTP
+    requests share one running decode batch, each sequence retiring at its
+    own budget instead of the batch's max (VERDICT r3 #8). Sampled
+    (temperature>0) requests keep the static path — per-request keys don't
+    compose with a shared running batch."""
 
     cfg: Any = None
     max_new_tokens: int = 16
     temperature: float = 0.0
+    continuous: bool = False
+    slots: int = 8
 
     def __post_init__(self):
         # Per-request sampling state: a base key seeded from OS entropy folded
@@ -193,6 +202,21 @@ class GenerativeModel(ServedModel):
         self._base_rng = jax.random.PRNGKey(
             int.from_bytes(os.urandom(4), "little")
         )
+        self._engine = None
+        self._engine_lock = threading.Lock()
+
+    def _continuous_engine(self):
+        from .continuous import ContinuousBatcher
+
+        with self._engine_lock:
+            if self._engine is None:
+                self._engine = ContinuousBatcher(self.cfg, self.params, slots=self.slots)
+            return self._engine
+
+    def close(self) -> None:
+        if self._engine is not None:
+            self._engine.close()
+            self._engine = None
 
     def predict(self, instances: Sequence[Any]) -> List[Any]:
         from kubeflow_tpu.models.gpt import generate
@@ -202,6 +226,10 @@ class GenerativeModel(ServedModel):
         prompts = np.asarray(instances, dtype=np.int32)
         if prompts.ndim != 2:
             raise HttpError(400, "instances must be equal-length token-id lists")
+        if self.continuous and self.temperature <= 0.0:
+            eng = self._continuous_engine()
+            futs = [eng.submit(row, self.max_new_tokens) for row in prompts]
+            return [row.tolist() + f.result(timeout=600.0) for row, f in zip(prompts, futs)]
         # Batch-bucket like ServedModel.predict: arbitrary client batch
         # sizes must not mint unbounded XLA compilations.
         n = prompts.shape[0]

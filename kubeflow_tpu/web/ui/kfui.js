@@ -23,9 +23,9 @@
 //     data-kf-confirm="Delete {name}?"          confirm dialog first
 //     data-kf-then="refresh:#tbl"               refresh:<sel> | reload | none
 //   data-kf-form="POST:/api/namespaces/{ns}/notebooks"  submit → JSON body
-//     (field names become JSON keys; dots nest: tpus.generation;
-//      data-kf-omit-if="none" drops the field when it holds that value;
-//      data-kf-group="x" wraps following named fields under key x)
+//     (field names become JSON keys; dots nest: tpus.generation, numeric
+//      segments index arrays: dataVolumes.0.name;
+//      data-kf-omit-if="none" drops the field when it holds that value)
 //   data-kf-options="/api/tpus;tpus;generation;{generation}"  select options
 //     data-kf-keep-first                        keep the static first <option>
 //   data-kf-depends="#f-gen"                    re-derive options on change:
@@ -194,14 +194,65 @@
     const url = node.getAttribute("data-kf-table");
     const itemsPath = node.getAttribute("data-kf-items") || ".";
     const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
+    const pageSize = parseInt(node.getAttribute("data-kf-page-size") || "0", 10);
     // explicit data-kf-empty="" means "render nothing", only absence defaults
     const emptyText = node.hasAttribute("data-kf-empty")
       ? node.getAttribute("data-kf-empty") : "none";
     const template = node.querySelector("template[data-kf-row]");
     const tbody = node.querySelector("tbody") || node;
+    node._kfPage = 0;
+
+    // th[data-kf-sort="<path>"]: click toggles asc/desc on that item path;
+    // numeric when every key parses as a number, else locale string order.
+    function sortRows(rows) {
+      const s = node._kfSort;
+      if (!s) return rows;
+      const keyed = rows.map((r) => {
+        const v = lookup(r, s.path);
+        return [v === null || v === undefined ? "" : v, r];
+      });
+      const numeric = keyed.every(([v]) => v === "" || !isNaN(Number(v)));
+      keyed.sort(([a], [b]) => {
+        const cmp = numeric ? Number(a || 0) - Number(b || 0)
+                            : String(a).localeCompare(String(b));
+        return s.dir === "desc" ? -cmp : cmp;
+      });
+      return keyed.map(([, r]) => r);
+    }
+
+    // [data-kf-pager] child (usually a tfoot cell) gets prev/label/next
+    function renderPager(total, pages) {
+      const pager = node.querySelector("[data-kf-pager]");
+      if (!pager) return;
+      pager.replaceChildren();
+      const prev = document.createElement("button");
+      prev.type = "button";
+      prev.className = "kf-page-prev";
+      prev.textContent = "‹";
+      prev.disabled = node._kfPage <= 0;
+      prev.onclick = () => { node._kfPage -= 1; render(node._kfLast); };
+      const label = document.createElement("span");
+      label.className = "kf-page-label";
+      label.textContent = (pages ? node._kfPage + 1 : 0) + "/" + pages + " (" + total + ")";
+      const next = document.createElement("button");
+      next.type = "button";
+      next.className = "kf-page-next";
+      next.textContent = "›";
+      next.disabled = node._kfPage >= pages - 1;
+      next.onclick = () => { node._kfPage += 1; render(node._kfLast); };
+      pager.append(prev, label, next);
+    }
 
     function render(data) {
-      const rows = itemsAt(data, itemsPath, {});
+      node._kfLast = data;
+      let rows = sortRows(itemsAt(data, itemsPath, {}).slice());
+      const total = rows.length;
+      if (pageSize > 0) {
+        const pages = Math.max(1, Math.ceil(total / pageSize));
+        node._kfPage = Math.max(0, Math.min(node._kfPage, pages - 1));
+        rows = rows.slice(node._kfPage * pageSize, (node._kfPage + 1) * pageSize);
+        renderPager(total, pages);
+      }
       tbody.replaceChildren();
       if (!rows.length) {
         const tr = document.createElement("tr");
@@ -224,6 +275,17 @@
     }
     node._kfRender = render;
     node._kfRefresh = refresh;
+    for (const th of node.querySelectorAll("th[data-kf-sort]")) {
+      th.addEventListener("click", () => {
+        const path = th.getAttribute("data-kf-sort");
+        const dir = node._kfSort && node._kfSort.path === path &&
+          node._kfSort.dir === "asc" ? "desc" : "asc";
+        node._kfSort = { path, dir };
+        for (const o of node.querySelectorAll("th[data-kf-sort]")) o.removeAttribute("aria-sort");
+        th.setAttribute("aria-sort", dir === "asc" ? "ascending" : "descending");
+        if (node._kfLast !== undefined) render(node._kfLast);
+      });
+    }
     refresh().catch((e) => kf.snack(String(e.message || e), "error"));
     if (pollMs > 0) node._kfPoller = kf.poller(refresh, pollMs);
   }
@@ -253,8 +315,24 @@
         const [got, want] = hideWhen.split("==");
         if (got === want) { eln.remove(); continue; }
       }
+      const statusVal = eln.getAttribute("data-kf-status");
+      if (statusVal !== null) applyStatus(eln, statusVal);
       if (eln.hasAttribute("data-kf-action")) wireAction(eln, ctx);
     }
+  }
+
+  // data-kf-status="{status.phase}" — status icon: phase-keyed class +
+  // glyph (reference: common-lib status icons / status.component.ts).
+  const STATUS_GLYPHS = {
+    running: "●", ready: "●", succeeded: "●",
+    waiting: "◌", pending: "◌", creating: "◌", unknown: "◌",
+    failed: "✕", error: "✕", stopped: "■",
+  };
+  function applyStatus(eln, value) {
+    const key = String(value || "unknown").toLowerCase();
+    eln.classList.add("kf-status", "kf-status-" + key);
+    if (!eln.textContent.trim()) eln.textContent = STATUS_GLYPHS[key] || "●";
+    eln.setAttribute("title", value);
   }
 
   // ---- component: action buttons -------------------------------------------
@@ -372,9 +450,49 @@
   }
   kf.formBody = formBody;
 
+  // data-kf-validate="required pattern:<re> min:<n> max:<n>" — submit-time
+  // per-field validation with inline .kf-error messages (reference:
+  // common-lib form validators + mat-error rendering). Rules are
+  // SPACE-separated (| belongs to regex alternation in pattern rules).
+  function validateField(field) {
+    const rules = (field.getAttribute("data-kf-validate") || "").split(/\s+/).filter(Boolean);
+    const v = field.type === "checkbox" ? String(field.checked) : field.value;
+    for (const rule of rules) {
+      const [name, ...rest] = rule.split(":");
+      const arg = rest.join(":");
+      if (name === "required" && !v) return "required";
+      if (name === "pattern" && v && !new RegExp("^(?:" + arg + ")$").test(v)) {
+        return field.getAttribute("data-kf-error") || "invalid format";
+      }
+      if ((name === "min" || name === "max") && v !== "") {
+        if (isNaN(Number(v))) return "must be a number";
+        if (name === "min" && Number(v) < Number(arg)) return "min " + arg;
+        if (name === "max" && Number(v) > Number(arg)) return "max " + arg;
+      }
+    }
+    return null;
+  }
+  function validateForm(form) {
+    let ok = true;
+    for (const field of form.querySelectorAll("[data-kf-validate]")) {
+      let err = field.nextElementSibling;
+      if (!(err && err.classList && err.classList.contains("kf-error"))) {
+        err = document.createElement("span");
+        err.className = "kf-error";
+        field.after(err);
+      }
+      const msg = validateField(field);
+      err.textContent = msg || "";
+      field.classList.toggle("kf-invalid", !!msg);
+      if (msg) ok = false;
+    }
+    return ok;
+  }
+
   function initForm(form) {
     form.addEventListener("submit", async (ev) => {
       ev.preventDefault();
+      if (!validateForm(form)) return; // inline errors rendered, no HTTP
       const [method, ...rest] = form.getAttribute("data-kf-form").split(":");
       const url = subst(rest.join(":"), {});
       try {
@@ -511,6 +629,53 @@
     if (pollMs > 0) node._kfPoller = kf.poller(load, pollMs);
   }
 
+  // data-kf-chart-line="/url;itemsPath;labelPath;valuePath" — rolling
+  // time-series chart: each load appends one [0,1] sample per series label
+  // to a client-side window (data-kf-window, default 30) and renders one
+  // polyline per series. The reference's resource-chart.js keeps the same
+  // client-side sliding sample window (resource-chart.js:1-353).
+  async function initChartLine(node) {
+    const [url, itemsPath, labelPath, valuePath] =
+      node.getAttribute("data-kf-chart-line").split(";");
+    const windowN = parseInt(node.getAttribute("data-kf-window") || "30", 10);
+    const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
+    node._kfHistory = {};
+    const load = async () => {
+      const data = await kf.api("GET", subst(url, {}));
+      for (const item of itemsAt(data, itemsPath, {})) {
+        const label = String(lookup(item, labelPath));
+        const v = Math.max(0, Math.min(1, Number(lookup(item, valuePath)) || 0));
+        const h = (node._kfHistory[label] = node._kfHistory[label] || []);
+        h.push(v);
+        if (h.length > windowN) h.shift();
+      }
+      const svgNS = "http://www.w3.org/2000/svg";
+      const svg = document.createElementNS(svgNS, "svg");
+      svg.setAttribute("viewBox", "0 0 100 44");
+      svg.setAttribute("class", "kf-chart-line");
+      const step = windowN > 1 ? 100 / (windowN - 1) : 100;
+      let si = 0;
+      for (const [label, h] of Object.entries(node._kfHistory)) {
+        const line = document.createElementNS(svgNS, "polyline");
+        line.setAttribute("class", "kf-line kf-line-" + (si % 8));
+        line.setAttribute("data-series", label);
+        line.setAttribute("points",
+          h.map((v, i) => (i * step).toFixed(2) + "," + (42 - v * 40).toFixed(2)).join(" "));
+        const text = document.createElementNS(svgNS, "text");
+        text.setAttribute("x", "0");
+        text.setAttribute("y", String(6 + si * 6));
+        text.setAttribute("class", "kf-line-label");
+        text.textContent = label + " " + Math.round(h[h.length - 1] * 100) + "%";
+        svg.append(line, text);
+        si += 1;
+      }
+      node.replaceChildren(svg);
+    };
+    node._kfRefresh = load;
+    await load().catch(() => {});
+    if (pollMs > 0) node._kfPoller = kf.poller(load, pollMs);
+  }
+
   // ---- component: namespace selector (namespace-selector.js analog) --------
   async function initNsSelect(sel) {
     const data = await kf.api("GET", "/api/namespaces").catch(() => []);
@@ -556,6 +721,7 @@
     for (const n of root.querySelectorAll("[data-kf-text]")) await initText(n);
     for (const n of root.querySelectorAll("[data-kf-show-if]")) await initShowIf(n);
     for (const n of root.querySelectorAll("[data-kf-chart]")) await initChart(n);
+    for (const n of root.querySelectorAll("[data-kf-chart-line]")) await initChartLine(n);
     for (const n of root.querySelectorAll("[data-kf-table]")) initTable(n);
     for (const n of root.querySelectorAll("form[data-kf-form]")) initForm(n);
     // page-level action buttons (row-level ones are wired by materialize)

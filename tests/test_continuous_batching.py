@@ -1,0 +1,149 @@
+"""Continuous batching engine (serving/continuous.py, VERDICT r3 #8):
+slot admission/retirement on a shared per-slot KV cache, exact greedy
+equivalence with the static decode path, and queue overflow behavior."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeflow_tpu.models.gpt import GptConfig, GptLM, generate
+from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+CFG = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64, max_seq=128, vocab_size=101)
+
+
+@pytest.fixture(scope="module")
+def params():
+    rng = jax.random.PRNGKey(0)
+    sample = jax.random.randint(rng, (1, 8), 0, CFG.vocab_size)
+    return GptLM(CFG).init(rng, sample)["params"]
+
+
+def prompt(seed, n):
+    return np.asarray(
+        jax.random.randint(jax.random.PRNGKey(seed), (n,), 0, CFG.vocab_size))
+
+
+def test_greedy_tokens_match_static_generate(params):
+    """The engine's per-slot cache math is exactly the static decode math —
+    different prompt lengths riding the same running batch."""
+    p1, p2, p3 = prompt(1, 7), prompt(2, 12), prompt(3, 30)
+    refs = [
+        np.asarray(generate(CFG, params, p[None, :], max_new_tokens=n))[0, len(p):].tolist()
+        for p, n in ((p1, 10), (p2, 6), (p3, 9))
+    ]
+    eng = ContinuousBatcher(CFG, params, slots=2)  # 3 requests, 2 slots
+    try:
+        futs = [eng.submit(p1, 10), eng.submit(p2, 6), eng.submit(p3, 9)]
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.close()
+    assert got == refs
+
+
+def test_sequences_join_and_leave_mid_flight(params):
+    """A late, short request admitted while a long one decodes must finish
+    FIRST — the definition of continuous batching (no drain barrier)."""
+    import threading
+    import time
+
+    eng = ContinuousBatcher(CFG, params, slots=4)
+    order = []
+    lock = threading.Lock()
+
+    def run(name, p, budget, delay):
+        time.sleep(delay)
+        f = eng.submit(p, budget)
+        f.result(timeout=180)
+        with lock:
+            order.append(name)
+
+    try:
+        threads = [
+            threading.Thread(target=run, args=("long", prompt(1, 8), 60, 0.0)),
+            threading.Thread(target=run, args=("short", prompt(2, 8), 3, 0.3)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+    finally:
+        eng.close()
+    assert order and order[0] == "short", order
+
+
+def test_eos_frees_the_slot_early(params):
+    # greedy decode of this model emits 70 repeatedly (see equivalence
+    # test) — using it as eos stops the request at its first occurrence
+    eng = ContinuousBatcher(CFG, params, slots=2)
+    try:
+        f = eng.submit(prompt(1, 7), 50, eos_id=70)
+        toks = f.result(timeout=120)
+    finally:
+        eng.close()
+    assert toks[-1] == 70 and len(toks) < 50
+
+
+def test_oversize_prompt_rejected(params):
+    eng = ContinuousBatcher(CFG, params, slots=1)
+    try:
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(prompt(1, 120), 20)
+    finally:
+        eng.close()
+
+
+def test_single_token_budget_completes_at_admit(params):
+    eng = ContinuousBatcher(CFG, params, slots=1)
+    try:
+        toks = eng.submit(prompt(1, 7), 1).result(timeout=60)
+    finally:
+        eng.close()
+    assert len(toks) == 1
+
+
+def test_generative_model_continuous_predict_surface(params):
+    """The HTTP predict surface rides the engine: concurrent requests share
+    the running batch and return prompt+generated like the static path."""
+    from kubeflow_tpu.serving.server import GenerativeModel, ModelServer
+
+    served = GenerativeModel(name="gpt-cont", apply_fn=None, params=params,
+                             cfg=CFG, max_new_tokens=6, continuous=True, slots=2)
+    server = ModelServer()
+    server.add(served)
+    try:
+        p = prompt(1, 7)
+        ref = np.asarray(generate(CFG, params, p[None, :], max_new_tokens=6))[0].tolist()
+        resp = server.app.call(
+            "POST", "/v1/models/gpt-cont:predict", {"instances": [p.tolist()]})
+        assert resp.status == 200, resp.body
+        assert resp.body["predictions"][0] == ref
+    finally:
+        served.close()
+
+
+def test_failed_admission_does_not_leak_the_slot(params):
+    """A prompt that passes the submit length check but exceeds every
+    prefill bucket fails ONLY its own request; the slot stays usable."""
+    big_cfg = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                        max_seq=512, vocab_size=101)
+    rng = jax.random.PRNGKey(0)
+    big_params = GptLM(big_cfg).init(
+        rng, jax.random.randint(rng, (1, 8), 0, big_cfg.vocab_size))["params"]
+    eng = ContinuousBatcher(big_cfg, big_params, slots=1)
+    try:
+        bad = eng.submit(prompt(1, 300), 32)  # 300 > largest bucket (256)
+        with pytest.raises(ValueError, match="exceeds the largest prefill bucket"):
+            bad.result(timeout=60)
+        good = eng.submit(prompt(2, 7), 3)  # the single slot must still work
+        assert len(good.result(timeout=120)) == 3
+    finally:
+        eng.close()
+
+
+def test_close_fails_queued_and_future_requests(params):
+    eng = ContinuousBatcher(CFG, params, slots=1)
+    eng.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(prompt(1, 7), 3)

@@ -334,3 +334,118 @@ class TestServing:
             assert bad["status"]["conditions"][0]["reason"] == "InvalidSpec"
         finally:
             mgr.stop()
+
+
+class TestMedianStopping:
+    """hpo/earlystop.py + the StudyJob pruning pass (VERDICT r3 #7)."""
+
+    def test_rule_math(self):
+        from kubeflow_tpu.hpo.earlystop import running_average_at, should_stop
+
+        goods = [[(i, 0.1 * i) for i in range(1, 6)] for _ in range(3)]
+        bad = [(1, 0.01), (2, 0.01), (3, 0.02)]
+        assert running_average_at(goods[0], 3) == pytest.approx(0.2)
+        assert should_stop(bad, goods, maximize=True)
+        # a trial above the median survives
+        leader = [(1, 0.5), (2, 0.6)]
+        assert not should_stop(leader, goods, maximize=True)
+        # not enough siblings -> never stop
+        assert not should_stop(bad, goods[:2], maximize=True)
+        # minimize flips the comparison
+        assert should_stop([(3, 9.0)], [[(3, 1.0)], [(3, 1.1)], [(3, 0.9)]],
+                           maximize=False)
+        assert not should_stop([(3, 0.5)], [[(3, 1.0)], [(3, 1.1)], [(3, 0.9)]],
+                               maximize=False)
+
+    def test_parse_settings(self):
+        from kubeflow_tpu.hpo.earlystop import parse_early_stopping
+
+        assert parse_early_stopping({}) is None
+        got = parse_early_stopping({"earlyStopping": {
+            "algorithmName": "medianstop", "settings": {"minTrials": "5"}}})
+        assert got == {"min_trials": 5, "min_step": 1}
+        with pytest.raises(ValueError, match="unknown earlyStopping"):
+            parse_early_stopping({"earlyStopping": {"algorithmName": "hyperband"}})
+
+    def test_study_prunes_bad_trials_and_counts_them(self):
+        """Bad trials get cut mid-run once three siblings have histories;
+        pruned + succeeded still adds up to the trial budget and the best
+        trial is a good one."""
+        steps_run = {}
+
+        def objective(params, report_fn=None):
+            q = float(params["lr"])  # quality proxy: high lr = good trial here
+            last = 0.0
+            ran = 0
+            for i in range(1, 11):
+                ran = i
+                last = q * i / 10.0
+                if report_fn is not None and report_fn(i, {"accuracy": last}) is False:
+                    break
+                time.sleep(0.02)  # give the study controller a mark window
+            steps_run[round(q, 6)] = ran
+            return {"accuracy": last}
+
+        # Grid runs the list in order: strong trials first so the median has
+        # histories by the time the weak ones start (early trials can never
+        # be pruned — there is no field to compare against yet).
+        study = mkstudy(algorithm="grid", max_trials=8, parallel=2)
+        study["spec"]["parameters"] = [
+            {"name": "lr", "parameterType": "categorical",
+             "feasibleSpace": {"list": [0.8, 0.75, 0.7, 0.65, 0.1, 0.12, 0.11, 0.13]}},
+        ]
+        study["spec"]["earlyStopping"] = {
+            "algorithmName": "medianstop", "settings": {"minTrials": 3}}
+        mgr = build_platform(trial_runner=InProcessTrialRunner(objective)).start()
+        try:
+            mgr.client.create(study)
+            deadline = time.time() + 60
+            status = {}
+            while time.time() < deadline:
+                got = mgr.client.get(STUDY_API, "StudyJob", "study", "team-a")
+                status = got.get("status") or {}
+                if status.get("phase") == "Completed":
+                    break
+                time.sleep(0.1)
+            assert status.get("phase") == "Completed", status
+            total = status["trialsSucceeded"] + status["trialsPruned"] + status["trialsFailed"]
+            assert total == status["trialsTotal"]
+            assert status["trialsPruned"] >= 1, (status, steps_run)
+            # pruned trials actually saved steps
+            trials = [t for t in mgr.client.list(STUDY_API, "Trial", "team-a")]
+            pruned = [t for t in trials if t["status"]["phase"] == "Pruned"]
+            for t in pruned:
+                q = round(float(t["spec"]["parameters"]["lr"]), 6)
+                assert steps_run[q] < 10, f"pruned trial ran full budget: {steps_run}"
+            # the winner is never a pruned loser: best accuracy tops the field
+            best = status["currentOptimalTrial"]["observation"]["accuracy"]
+            for t in trials:
+                v = (t["status"].get("metrics") or {}).get("accuracy")
+                if v is not None:
+                    assert v <= best + 1e-9
+        finally:
+            mgr.stop()
+
+    def test_study_without_early_stopping_never_prunes(self):
+        def objective(params, report_fn=None):
+            for i in range(1, 4):
+                if report_fn is not None:
+                    assert report_fn(i, {"accuracy": 0.01}) is True
+            return {"accuracy": 0.01}
+
+        mgr = build_platform(trial_runner=InProcessTrialRunner(objective)).start()
+        try:
+            mgr.client.create(mkstudy(max_trials=4, parallel=2))
+            deadline = time.time() + 30
+            status = {}
+            while time.time() < deadline:
+                got = mgr.client.get(STUDY_API, "StudyJob", "study", "team-a")
+                status = got.get("status") or {}
+                if status.get("phase") == "Completed":
+                    break
+                time.sleep(0.1)
+            assert status.get("phase") == "Completed", status
+            assert status["trialsPruned"] == 0
+            assert status["trialsSucceeded"] == 4
+        finally:
+            mgr.stop()

