@@ -24,7 +24,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -109,6 +109,46 @@ def conv_sustained(batch: int, hw: int, cin: int, cout: int, iters: int = 20) ->
             "tflops": flops / dt / 1e12, "iter_s": dt}
 
 
+def flash_seq_sustained(batch: int, seq: int, heads: int = 16, head_dim: int = 64,
+                        iters: int = 8) -> Dict[str, Any]:
+    """Pallas flash attention fwd+bwd at long sequence lengths — the
+    long-context kernel evidence (8192 tokens held constant across the
+    sweep; the quadratic score work grows with seq while the token count
+    stays fixed, so rates show how the kernel scales with context)."""
+    from kubeflow_tpu.ops.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    shape = (batch, seq, heads, head_dim)
+    q0 = jax.random.normal(key, shape, jnp.bfloat16) * 0.1
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.abs(
+            flash_attention(q, k, v, causal=True, interpret=False).astype(jnp.float32)))
+
+    @jax.jit
+    def run(q):
+        def body(q, _):
+            for _i in range(CHAIN):
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, q, q)
+                q = (jnp.abs(dq) * 0.1 + (jnp.abs(dk) + jnp.abs(dv))
+                     * jnp.bfloat16(1e-3)).astype(jnp.bfloat16) * 0.3
+            return q, ()
+        q, _ = jax.lax.scan(body, q, None, length=iters)
+        return jnp.sum(q.astype(jnp.float32))
+
+    dt = _timed(run, (q0,), iters * CHAIN)
+    # causal fwd = 2 matmuls over the lower triangle ~ 2*2*b*h*L^2*d/2;
+    # flash bwd recomputes scores + 4 more matmuls ~ 2.5x fwd
+    fwd = 2.0 * b_h_l2_d(batch, heads, seq, head_dim)
+    flops = 3.5 * fwd
+    return {"kernel": f"flash_attn_fwd_bwd_b{batch}_L{seq}",
+            "tflops": flops / dt / 1e12, "iter_s": dt}
+
+
+def b_h_l2_d(b: int, h: int, l: int, d: int) -> float:
+    return b * h * float(l) * l * d  # one causal-triangle matmul's MACs*2/2
+
+
 def hbm_triad(mib: int = 512, iters: int = 20) -> Dict[str, Any]:
     """f32 y <- |y|*0.9999 + x : 2 reads + 1 write per element -> GB/s.
     abs() makes each chain step non-linear so XLA cannot algebraically
@@ -148,11 +188,27 @@ def sweep() -> Dict[str, Any]:
     return {"kernels": results, "hbm": bw, "ceiling_tflops": ceiling}
 
 
-def main() -> None:
+def flash_sweep() -> List[Dict[str, Any]]:
+    """Long-context flash rows (8192 tokens held constant) —
+    ``python -m e2e.ceiling --flash``; BASELINE.md round-4 table."""
+    return [flash_seq_sustained(b, L)
+            for b, L in ((8, 1024), (4, 2048), (2, 4096), (1, 8192))]
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import sys
+
     from kubeflow_tpu.training.flops import detect_generation, peak_flops_per_chip
 
+    argv = sys.argv[1:] if argv is None else argv
     gen = detect_generation()
     peak = peak_flops_per_chip(gen) / 1e12
+    if "--flash" in argv:
+        rows = flash_sweep()
+        for r in rows:
+            print(f"{r['kernel']:45s} {r['tflops']:9.1f} TF {100 * r['tflops'] / peak:7.1f}%")
+        print(json.dumps({"metric": f"flash_seq_sweep_{gen}", "rows": rows}))
+        return
     out = sweep()
     print(f"{'kernel':45s} {'sustained':>12s} {'of peak':>8s}")
     for r in out["kernels"]:

@@ -725,7 +725,9 @@ class Page:
     #: rules are SPACE-separated — | belongs to regex alternation.
     def _validate_field(self, field: Element) -> Optional[str]:
         rules = field.attrs.get("data-kf-validate", "").split()
-        v = str(field.checked) if field.attrs.get("type") == "checkbox" else field.value
+        # .lower(): JS String(checked) yields 'true'/'false' — lockstep parity
+        v = (str(field.checked).lower() if field.attrs.get("type") == "checkbox"
+             else field.value)
         for rule in rules:
             name, _, arg = rule.partition(":")
             if name == "required" and not v:

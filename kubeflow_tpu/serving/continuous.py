@@ -283,9 +283,28 @@ class ContinuousBatcher:
             # compute too — static shapes are the TPU contract; their
             # outputs are ignored, and a retiring row's tail tokens are
             # discarded below)
-            self.cache, self.last_tok, toks = self._step_fn(
-                self.params, self.cache, self.last_tok)
-            toks = np.asarray(toks)  # host fetch = chunk barrier
+            try:
+                self.cache, self.last_tok, toks = self._step_fn(
+                    self.params, self.cache, self.last_tok)
+                toks = np.asarray(toks)  # host fetch = chunk barrier
+            except Exception as e:
+                # a device/RPC failure must not wedge the engine silently:
+                # fail everything in flight and queued, refuse new work
+                with self._lock:
+                    self._closed = True
+                err = RuntimeError(f"decode step failed: {e}")
+                for req in self._active.values():
+                    req.error = err
+                    req.done.set()
+                self._active.clear()
+                while True:
+                    try:
+                        rest = self._queue.get_nowait()
+                    except queue.Empty:
+                        return
+                    if rest is not None:
+                        rest.error = err
+                        rest.done.set()
             for slot in list(self._active):
                 req = self._active[slot]
                 for j in range(toks.shape[1]):

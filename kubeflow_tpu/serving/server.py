@@ -227,9 +227,23 @@ class GenerativeModel(ServedModel):
         if prompts.ndim != 2:
             raise HttpError(400, "instances must be equal-length token-id lists")
         if self.continuous and self.temperature <= 0.0:
+            from .continuous import PREFILL_BUCKETS
+
+            # client errors must surface as 4xx BEFORE anything is enqueued
+            # (a mid-listcomp failure would abandon submitted futures)
+            if prompts.shape[1] > PREFILL_BUCKETS[-1]:
+                raise HttpError(
+                    413, f"prompt length {prompts.shape[1]} exceeds the "
+                    f"continuous-batching prefill limit {PREFILL_BUCKETS[-1]}")
+            if prompts.shape[1] + self.max_new_tokens > self.cfg.max_seq:
+                raise HttpError(413, "prompt + generation budget exceeds max_seq")
             eng = self._continuous_engine()
             futs = [eng.submit(row, self.max_new_tokens) for row in prompts]
-            return [row.tolist() + f.result(timeout=600.0) for row, f in zip(prompts, futs)]
+            try:
+                return [row.tolist() + f.result(timeout=600.0)
+                        for row, f in zip(prompts, futs)]
+            except RuntimeError as e:
+                raise HttpError(503, f"decode engine unavailable: {e}") from e
         # Batch-bucket like ServedModel.predict: arbitrary client batch
         # sizes must not mint unbounded XLA compilations.
         n = prompts.shape[0]
