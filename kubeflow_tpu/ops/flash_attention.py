@@ -87,6 +87,12 @@ def _block_sizes(lq: int, lk: int, block_q: Optional[int], block_k: Optional[int
     # against MXU dots and cut grid-step overhead ~3x (GPT-2-medium step:
     # 20.9% -> 41.2% MFU). Scores VMEM is bq*bk*4B = 4 MiB at the caps, far
     # under the 128 MiB budget even with q/k/v/o blocks alongside.
+    # Round-4 note: an ISOLATED grad-chain probe preferred (512,1024) by
+    # 13%, but the full GPT-2-medium train step measured consistently WORSE
+    # with a 512 q-cap (41.4 vs 42.4% MFU, two runs each) — in-model, XLA
+    # overlaps the flash bwd with surrounding matmuls differently than any
+    # attention-only microbenchmark. The 1024 cap stands on the end-to-end
+    # number; tune via explicit block_q/block_k, not the auto default.
     cap = _auto_tile_cap()
     bq = _auto_block(lq, cap) if block_q is None else min(block_q, lq)
     bk = _auto_block(lk, cap) if block_k is None else min(block_k, lk)

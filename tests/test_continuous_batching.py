@@ -147,3 +147,38 @@ def test_close_fails_queued_and_future_requests(params):
     eng.close()
     with pytest.raises(RuntimeError, match="closed"):
         eng.submit(prompt(1, 7), 3)
+
+
+def test_concurrent_submitters_and_midflight_close_all_resolve(params):
+    """Stress: many threads submitting while close() lands mid-flight —
+    every future must resolve (result or error), none may hang."""
+    import threading
+
+    eng = ContinuousBatcher(CFG, params, slots=2)
+    outcomes = []
+    lock = threading.Lock()
+
+    def submitter(seed):
+        try:
+            f = eng.submit(prompt(seed, 7), 30)
+            toks = f.result(timeout=120)
+            with lock:
+                outcomes.append(("ok", len(toks)))
+        except Exception as e:  # record ANY failure — a dead thread would
+            with lock:          # fail the count assert with no root cause
+                outcomes.append(("err", type(e).__name__))
+
+    try:
+        threads = [threading.Thread(target=submitter, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        import time
+        time.sleep(0.5)
+    finally:
+        eng.close()
+    for t in threads:
+        t.join(timeout=150)
+    assert not any(t.is_alive() for t in threads), "a submitter hung"
+    assert len(outcomes) == 12, outcomes
+    # no TimeoutError: every request was either served or failed FAST
+    assert all(o != ("err", "TimeoutError") for o in outcomes), outcomes
