@@ -31,6 +31,16 @@ DEFAULT_NODES: List[Tuple[str, str, int, int]] = [
 ]
 
 
+def free_port() -> int:
+    """Pick a free TCP port so concurrent runs (pytest-xdist, parallel CI
+    jobs) each get their own listener instead of colliding."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def unique_namespace(prefix: str = "e2e") -> str:
     """Namespace-per-run isolation (deploy_utils.py:25-43 pattern)."""
     return f"{prefix}-{uuid.uuid4().hex[:8]}"

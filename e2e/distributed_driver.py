@@ -31,21 +31,14 @@ from kubeflow_tpu.tpu.env import (
     env_list_to_dict,
 )
 
-from .cluster import E2ECluster, csrf_headers, http_json, unique_namespace, wait_for_condition
+from .cluster import (E2ECluster, csrf_headers, free_port, http_json,
+                      unique_namespace, wait_for_condition)
 from .junit import run_driver
 
 OWNER = "dist-e2e@example.com"
 IDENTITY = {"kubeflow-userid": OWNER}
 
 
-def _free_port() -> int:
-    """Pick a free TCP port so concurrent runs (pytest-xdist, parallel CI
-    jobs) each get their own coordinator instead of colliding."""
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 WORKER_PROGRAM = r"""
 import os, sys
@@ -121,7 +114,7 @@ def run_distributed_e2e(timeout: float = 120.0) -> Dict[str, Any]:
         # Boot one real OS process per worker with that env; localhost TCP
         # stands in for the headless-service DNS the address names.
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        coord_port = _free_port()
+        coord_port = free_port()
         procs = []
         try:
             for pod_name, env in worker_envs:

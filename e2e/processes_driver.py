@@ -1,0 +1,192 @@
+"""Five-OS-process e2e WITH the apiserver auth gate on (VERDICT r3 #3).
+
+The strongest deployment-shaped check the image allows: every role runs as
+its own OS process wired only by HTTP + env — exactly how the manifests
+deploy them — with the apiserver in deny-by-default token/RBAC mode:
+
+  apiserver (APISERVER_AUTH=token, token table from a Secret-shaped CSV)
+  admission webhook     (own token, group system:kubeflow-tpu)
+  substrate controller  (StatefulSet/Deployment/podlet; own token)
+  notebook controller   (own token)
+  jupyter web app       (own token; user-facing dev-auth for the driver)
+
+Flow driven over the wire: anonymous apiserver write -> 401; admin creates
+the namespace; the spawner HTTP POST creates a Notebook; the controllers
+materialize StatefulSet -> pod (CREATE routed through the EXTERNAL webhook
+process); the notebook reaches ready. Run:
+    python -m e2e.processes_driver
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from typing import Any, Dict, List
+
+from .cluster import free_port
+from .junit import run_driver
+
+ROLES = {
+    "admin": ("e2e-admin", "system:masters"),
+    "controllers": ("system:serviceaccount:kubeflow:controllers", "system:kubeflow-tpu"),
+    "webhook": ("system:serviceaccount:kubeflow:admission-webhook", "system:kubeflow-tpu"),
+    "webapps": ("system:serviceaccount:kubeflow:webapps", "system:kubeflow-tpu"),
+}
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    last: Any = None
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2.0):
+                return
+        except Exception as e:
+            last = e
+            time.sleep(0.2)
+    raise TimeoutError(f"{url} never became ready: {last}")
+
+
+def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
+    from kubeflow_tpu.api.meta import REGISTRY, new_object
+    from kubeflow_tpu.apiserver.remote import RemoteStore
+    from kubeflow_tpu.apiserver.store import ApiError
+
+    procs: List[subprocess.Popen] = []
+    logs: List[Any] = []
+    tokens = {role: f"tok-{role}-{os.getpid()}" for role in ROLES}
+    api_port, wh_port, jwa_port = free_port(), free_port(), free_port()
+    api_url = f"http://127.0.0.1:{api_port}"
+
+    def spawn(tmp: str, mod: str, extra_env: Dict[str, str]) -> subprocess.Popen:
+        # scrub ambient auth knobs: stray APISERVER_TOKENS/ANONYMOUS_READ in
+        # the outer shell would silently change what this test asserts
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("APISERVER_") and k != "APP_DISABLE_AUTH"}
+        env.update({
+            "JAX_PLATFORMS": "cpu",  # control-plane roles need no chip
+            "APISERVER_URL": api_url,
+            "METRICS_PORT": "0",  # ephemeral ops port per process
+            "LOG_LEVEL": "WARNING",
+            **extra_env,
+        })
+        # per-child log FILE, not a pipe: an unread pipe deadlocks a chatty
+        # child, and the log carries the diagnostics on failure
+        log = open(os.path.join(tmp, mod.rsplit(".", 1)[-1] + ".log"), "w+b")
+        logs.append(log)
+        p = subprocess.Popen([sys.executable, "-m", mod], env=env,
+                             stdout=log, stderr=subprocess.STDOUT)
+        procs.append(p)
+        return p
+
+    with tempfile.TemporaryDirectory() as tmp:
+        token_file = os.path.join(tmp, "tokens.csv")
+        with open(token_file, "w") as f:
+            for i, (role, (user, group)) in enumerate(ROLES.items()):
+                f.write(f'{tokens[role]},{user},u{i},"{group}"\n')
+        try:
+            spawn(tmp, "kubeflow_tpu.apiserver", {
+                "API_PORT": str(api_port),
+                "APISERVER_AUTH": "token",
+                "APISERVER_TOKEN_FILE": token_file,
+                "WEBHOOK_URL": f"http://127.0.0.1:{wh_port}/apply-poddefault",
+            })
+            _wait_http(f"{api_url}/healthz")
+            spawn(tmp, "kubeflow_tpu.webhook", {
+                "PORT": str(wh_port), "APISERVER_TOKEN": tokens["webhook"]})
+            spawn(tmp, "kubeflow_tpu.controllers.builtin", {
+                "APISERVER_TOKEN": tokens["controllers"]})
+            spawn(tmp, "kubeflow_tpu.controllers.notebook", {
+                "APISERVER_TOKEN": tokens["controllers"]})
+            spawn(tmp, "kubeflow_tpu.services.jupyter", {
+                "PORT": str(jwa_port),
+                "APISERVER_TOKEN": tokens["webapps"],
+                "APP_DISABLE_AUTH": "true",  # user-level SAR off for the
+                # driver; the APISERVER gate below stays deny-by-default
+            })
+            _wait_http(f"http://127.0.0.1:{wh_port}/healthz")
+            _wait_http(f"http://127.0.0.1:{jwa_port}/healthz")
+
+            # deny-by-default holds on the wire: anonymous write -> 401
+            anon = RemoteStore(api_url, token="")
+            try:
+                anon.create(new_object("v1", "Namespace", "intruder", None))
+                raise AssertionError("unauthenticated write was accepted")
+            except ApiError as e:
+                assert e.code == 401, f"expected 401, got {e.code}"
+
+            admin = RemoteStore(api_url, token=tokens["admin"])
+            admin.create(new_object("v1", "Namespace", "team-proc", None))
+
+            # spawn a notebook through the webapp's HTTP surface
+            import json as _json
+
+            body = _json.dumps({"name": "proc-nb"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{jwa_port}/api/namespaces/team-proc/notebooks",
+                body, {"content-type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200, resp.status
+
+            nb_res = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
+            pod_res = REGISTRY.for_kind("v1", "Pod")
+            deadline = time.monotonic() + timeout
+            ready = 0
+            nb: Dict[str, Any] = {}
+            while time.monotonic() < deadline:
+                nb = admin.get(nb_res, "proc-nb", "team-proc")
+                ready = int((nb.get("status") or {}).get("readyReplicas") or 0)
+                if ready >= 1:
+                    break
+                time.sleep(0.3)
+            if ready < 1:
+                for log in logs:  # surface child diagnostics in the failure
+                    log.flush()
+                    log.seek(0)
+                    tail = log.read()[-1500:].decode(errors="replace")
+                    print(f"--- {log.name} ---\n{tail}", file=sys.stderr)
+                raise AssertionError(
+                    f"notebook never became ready across 5 processes "
+                    f"(status={nb.get('status')})")
+            pods = admin.list(pod_res, "team-proc")
+            assert any(p["metadata"]["name"].startswith("proc-nb") for p in pods), \
+                "no pod materialized for the notebook"
+            return {
+                "processes": len(procs),
+                "auth": "token+rbac deny-by-default",
+                "readyReplicas": ready,
+                "pods": [p["metadata"]["name"] for p in pods],
+            }
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            for log in logs:
+                log.close()
+
+
+def main(argv=None) -> int:
+    def add_args(parser):
+        parser.add_argument("--timeout", type=float, default=90.0)
+
+    return run_driver(
+        "e2e-processes",
+        "ProcessesE2E",
+        lambda args: "five-process-auth-on",
+        lambda args: lambda: run_processes_e2e(timeout=args.timeout),
+        argv=argv,
+        add_args=add_args,
+        default_junit="junit_processes.xml",
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -44,6 +44,16 @@ class TestDrivers:
         # the address the webhook wrote names the headless service DNS
         assert ".svc.cluster.local:" in result["coordinator_env"]
 
+    def test_five_processes_with_auth_on(self):
+        """apiserver + webhook + substrate + notebook controller + spawner
+        as separate OS processes, apiserver deny-by-default (VERDICT r3 #3:
+        'all e2e drivers green with auth on')."""
+        from e2e.processes_driver import run_processes_e2e
+
+        result = run_processes_e2e()
+        assert result["processes"] == 5
+        assert result["readyReplicas"] >= 1 and result["pods"]
+
 
 class TestLoadtest:
     def test_loadtest_probe(self):
