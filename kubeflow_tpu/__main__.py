@@ -6,7 +6,9 @@ web service on consecutive ports: the single-binary dev/demo deployment
 
 Env: API_PORT (8001), DASHBOARD_PORT (8082), JUPYTER_PORT (5001),
 TENSORBOARDS_PORT (5002), VOLUMES_PORT (5003), KFAM_PORT (8081),
-APP_DISABLE_AUTH for local use.
+APP_DISABLE_AUTH for local use; APISERVER_AUTH=token (+ APISERVER_TOKENS /
+APISERVER_TOKEN_FILE) turns on the same deny-by-default REST gate as the
+per-role apiserver (apiserver/auth.py).
 """
 
 from __future__ import annotations
@@ -33,8 +35,14 @@ def main() -> None:
     auth = auth_from_env()
 
     # Manager.start() already runs the GC sweep on this same Store; REST
-    # writers are covered by it (no second sweep needed here).
-    servers = [("apiserver", make_apiserver_app(store).serve(int(os.environ.get("API_PORT", "8001"))))]
+    # writers are covered by it (no second sweep needed here). The same
+    # APISERVER_AUTH=token gate as the per-role server applies (off by
+    # default for local/dev use; in-process components bypass REST anyway).
+    from .apiserver.auth import auth_from_env as api_auth_from_env
+
+    servers = [("apiserver", make_apiserver_app(
+        store, auth=api_auth_from_env(store),
+    ).serve(int(os.environ.get("API_PORT", "8001"))))]
 
     # ONE InformerCache for every co-hosted app: kfam, dashboard, and
     # jupyter all mirror overlapping kinds (Namespace, Node, Event) — a
