@@ -13,6 +13,7 @@ from typing import Optional
 from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
+from ..utils.quantity import parse_quantity
 from ..web.openapi import annotate, install_apidocs
 from ..web.resources import install_cluster_api
 from ..web.static import install_spa, load_ui
@@ -44,6 +45,10 @@ def make_volumes_app(client: Client, auth: Optional[AuthConfig] = None) -> App:
                     "name": apimeta.name_of(p),
                     "namespace": ns,
                     "capacity": (p.get("spec", {}).get("resources", {}).get("requests") or {}).get("storage", ""),
+                    # numeric for column sorting: '20Gi' < '100Gi' must not
+                    # compare lexicographically (utils/quantity.py)
+                    "capacityBytes": parse_quantity(
+                        (p.get("spec", {}).get("resources", {}).get("requests") or {}).get("storage")),
                     "modes": p.get("spec", {}).get("accessModes", []),
                     "class": p.get("spec", {}).get("storageClassName"),
                     "inUse": apimeta.name_of(p) in mounted,

@@ -1,0 +1,35 @@
+"""Kubernetes resource-quantity parsing (the apimachinery `resource.Quantity`
+subset the platform needs: PVC capacities, memory requests).
+
+Reference semantics (apimachinery/pkg/api/resource): binary suffixes
+Ki/Mi/Gi/Ti/Pi/Ei (1024-based), decimal k/M/G/T/P/E (1000-based), bare
+numbers, and decimal fractions ("1.5Gi", "0.5"). Milli ("500m") supported
+for completeness. Unparseable input returns None — callers sort/display
+raw strings in that case rather than crash a list endpoint."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+_SUFFIX = {
+    "Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+    "Pi": 1024**5, "Ei": 1024**6,
+    "k": 1000, "M": 1000**2, "G": 1000**3, "T": 1000**4,
+    "P": 1000**5, "E": 1000**6,
+    "m": 1e-3, "": 1,
+}
+
+_RX = re.compile(r"^\s*([0-9]+(?:\.[0-9]+)?)\s*(Ki|Mi|Gi|Ti|Pi|Ei|k|M|G|T|P|E|m)?\s*$")
+
+
+def parse_quantity(s: object) -> Optional[float]:
+    """'20Gi' -> 21474836480.0; '500m' -> 0.5; garbage -> None."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    if not isinstance(s, str):
+        return None
+    m = _RX.match(s)
+    if not m:
+        return None
+    return float(m.group(1)) * _SUFFIX[m.group(2) or ""]

@@ -282,6 +282,11 @@ class TestTensorboardsAndVolumesFlows:
         page.tick("#tb-table")
         row = next(r for r in page.table_rows("#tb-table") if r[0] == "tb1")
         assert "ready" in row[2]
+        # round-4 richness: the table is sortable and paginated here too
+        assert "1/1 (1)" in page.text("#tb-table .kf-page-label")
+        page.click(page.doc.one("#tb-table th[data-kf-sort=name]"))
+        assert page.doc.one("#tb-table th[data-kf-sort=name]").attrs["aria-sort"] == "ascending"
+
         # Connect link appears once ready.
         links = [a.attrs["href"] for a in page.doc.one("#tb-table").css("a")]
         assert "/tensorboard/team-a/tb1/" in links
@@ -300,6 +305,7 @@ class TestTensorboardsAndVolumesFlows:
         assert page.snacks[-1] == ("volume created", "ok")
         row = next(r for r in page.table_rows("#pvc-table") if r[0] == "data")
         assert row[1] == "20Gi" and "unused" in row[4]
+        assert "(1)" in page.text("#pvc-table .kf-page-label")
 
         # Mount it from a pod: badge flips, delete is refused with the error
         # surfaced in the snack bar.

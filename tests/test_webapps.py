@@ -257,3 +257,18 @@ def test_notebook_status_derivation():
     assert s["phase"] == "warning" and "scheduling" in s["message"]
     nb = {"metadata": {}, "status": {"conditions": [{"type": "Failed", "status": "True", "message": "bad"}]}}
     assert notebook_status(nb, [])["phase"] == "error"
+
+
+def test_quantity_parser_and_capacity_sort_field():
+    """PVC rows carry numeric capacityBytes so the Size column sorts by
+    magnitude, not lexicographically ('100Gi' < '20Gi' as strings)."""
+    from kubeflow_tpu.utils.quantity import parse_quantity
+
+    assert parse_quantity("20Gi") == 20 * 1024**3
+    assert parse_quantity("1.5Gi") == 1.5 * 1024**3
+    assert parse_quantity("512Mi") < parse_quantity("1Gi")
+    assert parse_quantity("100Gi") > parse_quantity("20Gi")
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("3") == 3.0
+    assert parse_quantity("garbage") is None
+    assert parse_quantity(None) is None
