@@ -38,6 +38,30 @@ def mknotebook(i: int, ns: str) -> Dict[str, Any]:
     )
 
 
+def ready_statefulsets(cluster, ns: str) -> int:
+    """StatefulSets with >= 1 ready replica (the churn-wave convergence
+    metric; shared with the race tier's churn regression test)."""
+    sts = cluster.client.list("apps/v1", "StatefulSet", ns)
+    return sum(1 for s in sts if (s.get("status") or {}).get("readyReplicas", 0) >= 1)
+
+
+def annotate_stop(cluster, ns: str, i: int, stop: bool) -> None:
+    """get->modify->update with Conflict retry: the controller's status
+    writes bump resourceVersion concurrently (optimistic-concurrency loop,
+    same shape as client-go's RetryOnConflict)."""
+
+    def attempt() -> None:
+        nb = cluster.client.get(NOTEBOOK_API, "Notebook", f"load-{i}", ns)
+        anns = nb["metadata"].setdefault("annotations", {})
+        if stop:
+            anns[STOP_ANNOTATION] = "now"
+        else:
+            anns.pop(STOP_ANNOTATION, None)
+        cluster.client.update(nb)
+
+    run_with_retry(attempt, retries=10, delay=0.02, retry_on=(Conflict,))
+
+
 def run_loadtest(n: int = 50, timeout: float = 120.0) -> Dict[str, Any]:
     # Single-host notebooks (no TPU block): the probe stresses the reconcile
     # plane, not the fake scheduler's capacity math.
@@ -46,24 +70,10 @@ def run_loadtest(n: int = 50, timeout: float = 120.0) -> Dict[str, Any]:
         reconciles_before = METRICS.total("controller_reconcile_total")
 
         def running_count() -> int:
-            sts = cluster.client.list("apps/v1", "StatefulSet", ns)
-            return sum(1 for s in sts if (s.get("status") or {}).get("readyReplicas", 0) >= 1)
+            return ready_statefulsets(cluster, ns)
 
         def annotate(i: int, stop: bool) -> None:
-            """get→modify→update with Conflict retry: the controller's status
-            writes bump resourceVersion concurrently (optimistic-concurrency
-            loop, same shape as client-go's RetryOnConflict)."""
-
-            def attempt() -> None:
-                nb = cluster.client.get(NOTEBOOK_API, "Notebook", f"load-{i}", ns)
-                anns = nb["metadata"].setdefault("annotations", {})
-                if stop:
-                    anns[STOP_ANNOTATION] = "now"
-                else:
-                    anns.pop(STOP_ANNOTATION, None)
-                cluster.client.update(nb)
-
-            run_with_retry(attempt, retries=10, delay=0.02, retry_on=(Conflict,))
+            annotate_stop(cluster, ns, i, stop)
 
         t0 = time.perf_counter()
         for i in range(n):

@@ -33,6 +33,21 @@ class _OwnedPodsMixin:
     reconciler runs under a Manager — the per-reconcile list of EVERY pod in
     the namespace was a top cost in the 500-notebook loadtest profile."""
 
+    #: per-object consecutive-unconverged counts → capped backoff for the
+    #: stale-informer insurance requeue (a set that CANNOT converge — e.g.
+    #: pods Pending on exhausted TPU capacity — must not poll at 5 Hz
+    #: forever; one that just raced the mirror must retry fast).
+    def _insurance_requeue(self, key) -> "Result":
+        if not hasattr(self, "_unconverged"):
+            self._unconverged = {}
+        n = self._unconverged.get(key, 0)
+        self._unconverged[key] = n + 1
+        return Result(requeue_after=min(0.2 * (2 ** min(n, 6)), 5.0))
+
+    def _note_converged(self, key) -> None:
+        if hasattr(self, "_unconverged"):
+            self._unconverged.pop(key, None)
+
     def _owned_pods(self, client: Client, namespace: Optional[str], owner_uid: str):
         if self.cache is None:
             return [
@@ -93,6 +108,7 @@ class StatefulSetReconciler(_OwnedPodsMixin, Reconciler):
         owned = self._owned_pods(client, req.namespace, apimeta.uid_of(sts))
         existing = {apimeta.name_of(p): p for p in owned}
         want_names = [f"{req.name}-{i}" for i in range(replicas)]
+        mutated = False
         for i, name in enumerate(want_names):
             if name in existing:
                 continue
@@ -106,8 +122,10 @@ class StatefulSetReconciler(_OwnedPodsMixin, Reconciler):
                 "statefulset.kubernetes.io/pod-name"
             ] = name
             self._create_pod_tolerant(client, pod)
+            mutated = True
         for name in set(existing) - set(want_names):
             client.delete_opt("v1", "Pod", name, req.namespace)
+            mutated = True
         # Pod template drift → recreate (simplified rolling update).
         for name in want_names:
             pod = existing.get(name)
@@ -115,11 +133,26 @@ class StatefulSetReconciler(_OwnedPodsMixin, Reconciler):
                 continue
             if _template_drifted(pod["spec"], template.get("spec", {})):
                 client.delete_opt("v1", "Pod", name, req.namespace)
+                mutated = True
 
         pods = self._owned_pods(client, req.namespace, apimeta.uid_of(sts))
         ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
         sts["status"] = {"replicas": len(pods), "readyReplicas": ready, "currentReplicas": len(pods)}
         client.update_status(sts)
+        key = (req.namespace, req.name)
+        if mutated or ready != replicas or len(pods) != replicas:
+            # Not converged (or this pass mutated based on the mirror view):
+            # requeue instead of trusting the next watch event to arrive
+            # AFTER the informer mirror has applied it. The trigger watch and
+            # the informer are independent streams — a reconcile fired by the
+            # final pod event of a churn wave can read a mirror that hasn't
+            # seen that event yet, write stale status, and (being the last
+            # event) never run again. Caught live at 500-notebook churn: pod
+            # Running, status stuck at readyReplicas 0. ``mutated`` also
+            # covers the drift-delete path, where a stale mirror can make
+            # the post-delete recount LOOK converged.
+            return self._insurance_requeue(key)
+        self._note_converged(key)
         return Result()
 
 
@@ -159,11 +192,14 @@ class DeploymentReconciler(_OwnedPodsMixin, Reconciler):
         owned = self._owned_pods(client, req.namespace, apimeta.uid_of(dep))
         existing = {apimeta.name_of(p): p for p in owned}
         want_names = [f"{req.name}-{i}" for i in range(replicas)]
+        mutated = False
         for name in want_names:
             if name not in existing:
                 self._create_pod_tolerant(client, _pod_for_template(dep, name, template, selector_labels))
+                mutated = True
         for name in set(existing) - set(want_names):
             client.delete_opt("v1", "Pod", name, req.namespace)
+            mutated = True
         pods = self._owned_pods(client, req.namespace, apimeta.uid_of(dep))
         ready = sum(1 for p in pods if p.get("status", {}).get("phase") == "Running")
         dep["status"] = {
@@ -179,6 +215,11 @@ class DeploymentReconciler(_OwnedPodsMixin, Reconciler):
             ],
         }
         client.update_status(dep)
+        key = (req.namespace, req.name)
+        if mutated or ready != replicas or len(pods) != replicas:
+            # same stale-informer insurance as the StatefulSet reconciler
+            return self._insurance_requeue(key)
+        self._note_converged(key)
         return Result()
 
 

@@ -192,3 +192,32 @@ def _churn_and_assert(store, inf, index, index_lock):
                 for p in store.list(PODS)}
     assert got == want, (len(got), len(want), got ^ want)
     assert idx == want, (len(idx), len(want), idx ^ want)
+
+
+def test_churn_wave_converges_despite_informer_trigger_race():
+    """Round-4 latent-race fix: the trigger watch and the informer mirror
+    are independent streams, so a reconcile fired by the LAST pod event of
+    a churn wave can read a mirror that has not applied that event yet and
+    write stale sts status — with nothing left to re-trigger it (caught
+    live at 500-notebook churn on the pre-fix code, ~20% per wave). The
+    substrate reconcilers now requeue while unconverged; waves must always
+    settle."""
+    from e2e.cluster import E2ECluster, unique_namespace, wait_for_condition
+    from e2e.loadtest import annotate_stop, mknotebook, ready_statefulsets
+
+    n = 120
+    with E2ECluster(nodes=[]) as cluster:
+        ns = cluster.create_profile("churn@example.com", unique_namespace("churn"))
+        for i in range(n):
+            cluster.client.create(mknotebook(i, ns))
+        wait_for_condition(lambda: ready_statefulsets(cluster, ns) == n, 60,
+                           desc="all running")
+        for _wave in range(3):
+            for i in range(n):
+                annotate_stop(cluster, ns, i, True)
+            wait_for_condition(lambda: ready_statefulsets(cluster, ns) == 0, 60,
+                               desc="all stopped")
+            for i in range(n):
+                annotate_stop(cluster, ns, i, False)
+            wait_for_condition(lambda: ready_statefulsets(cluster, ns) == n, 60,
+                               desc="all restarted")
