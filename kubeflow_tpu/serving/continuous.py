@@ -26,6 +26,7 @@ workload; BASELINE.md records the numbers.
 from __future__ import annotations
 
 import functools
+import os
 import queue
 import threading
 from dataclasses import dataclass, field
@@ -57,6 +58,7 @@ class _Request:
     tokens: List[int] = field(default_factory=list)
     error: Optional[BaseException] = None
     eos_id: Optional[int] = None
+    temperature: float = 0.0  # 0 = greedy; >0 samples with a per-slot key
     done_at: Optional[float] = None  # perf_counter at retirement (latency acct)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -96,6 +98,15 @@ class ContinuousBatcher:
         self._prefill_model = GptLM(cfg, decode=True)  # [1, P], scalar cursor
         self.cache = self._fresh_cache()
         self.last_tok = jnp.zeros((slots,), jnp.int32)
+        # per-slot sampling state: temperature 0 = greedy; each admission
+        # folds a fresh counter into the base key so sampled requests draw
+        # independent streams (same recipe as GenerativeModel's rng)
+        self.temps = jnp.zeros((slots,), jnp.float32)
+        self._base_rng = jax.random.PRNGKey(int.from_bytes(os.urandom(4), "little"))
+        self._rng_counter = 0
+        # split (not fold_in) for the initial keys so they can never collide
+        # with the admission counter's fold_in stream
+        self.rngs = jax.random.split(self._base_rng, slots)
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._active: Dict[int, _Request] = {}
         self._free = list(range(slots))
@@ -125,31 +136,40 @@ class ContinuousBatcher:
         model = self.model
         chunk = self.chunk
 
-        # donate cache+tok: without donation every dispatch COPIES the full
-        # multi-GB KV cache into fresh output buffers (measured: the copy,
-        # not the math, dominated chunked stepping)
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def step(params, cache, tok):
+        # donate cache+tok+rngs: without donation every dispatch COPIES the
+        # full multi-GB KV cache into fresh output buffers (measured: the
+        # copy, not the math, dominated chunked stepping)
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 4))
+        def step(params, cache, tok, temps, rngs):
             def one(carry, _):
-                cache, tok = carry
+                cache, tok, rngs = carry
                 logits, updated = model.apply(
                     {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
                 )
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (updated["cache"], nxt), nxt
+                lg = logits[:, -1]                               # [slots, vocab]
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                pairs = jax.vmap(jax.random.split)(rngs)   # [slots, 2, 2]
+                rngs, keys = pairs[:, 0], pairs[:, 1]
+                sampled = jax.vmap(
+                    lambda k, l, t: jax.random.categorical(k, l / jnp.maximum(t, 1e-6))
+                )(keys, lg, temps).astype(jnp.int32)
+                nxt = jnp.where(temps > 0.0, sampled, greedy)
+                return (updated["cache"], nxt, rngs), nxt
 
-            (cache, tok), toks = jax.lax.scan(one, (cache, tok), None, length=chunk)
-            return cache, tok, jnp.moveaxis(toks, 0, 1)  # [slots, chunk]
+            (cache, tok, rngs), toks = jax.lax.scan(
+                one, (cache, tok, rngs), None, length=chunk)
+            return cache, tok, rngs, jnp.moveaxis(toks, 0, 1)  # [slots, chunk]
 
         return step
 
     def _build_adopt(self):
-        @functools.partial(jax.jit, donate_argnums=(0, 5))
-        def adopt(cache, small, slot, true_len, first_tok, last_tok):
+        @functools.partial(jax.jit, donate_argnums=(0, 5, 6, 7))
+        def adopt(cache, small, slot, true_len, first_tok, last_tok,
+                  temps, rngs, temperature, slot_rng):
             """Splice a [1, max_seq] prefill cache into row ``slot`` and
             reset that row's cursor to the TRUE prompt length (bucket
             padding beyond it stays invisible and is overwritten by the
-            next decode steps)."""
+            next decode steps). Also installs the slot's sampling state."""
             out = {}
             for name, layer in cache.items():
                 att, small_att = layer["attention"], small[name]["attention"]
@@ -157,23 +177,29 @@ class ContinuousBatcher:
                 v = jax.lax.dynamic_update_slice(att["v"], small_att["v"], (slot, 0, 0, 0))
                 cursors = att["cursors"].at[slot].set(true_len)
                 out[name] = {"attention": {"k": k, "v": v, "cursors": cursors}}
-            return out, last_tok.at[slot].set(first_tok)
+            return (out, last_tok.at[slot].set(first_tok),
+                    temps.at[slot].set(temperature),
+                    rngs.at[slot].set(slot_rng))
 
         return adopt
 
-    def _prefill(self, prompt: np.ndarray) -> Any:
+    def _prefill(self, prompt: np.ndarray, temperature: float, key) -> Any:
         bucket = _bucket_for(len(prompt))
         if bucket not in self._prefill_fns:
             model = self._prefill_model
 
             @jax.jit
-            def prefill(params, cache, ids, true_len):
+            def prefill(params, cache, ids, true_len, temperature, key):
                 logits, updated = model.apply(
                     {"params": params, "cache": cache}, ids, mutable=["cache"]
                 )
                 # first generated token comes from the TRUE last prompt
                 # position, not the padded bucket end
-                first = jnp.argmax(logits[0, true_len - 1], axis=-1).astype(jnp.int32)
+                lg = logits[0, true_len - 1]
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                sampled = jax.random.categorical(
+                    key, lg / jnp.maximum(temperature, 1e-6)).astype(jnp.int32)
+                first = jnp.where(temperature > 0.0, sampled, greedy)
                 return updated["cache"], first
 
             self._prefill_fns[bucket] = prefill
@@ -190,15 +216,17 @@ class ContinuousBatcher:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, : len(prompt)] = prompt
         return self._prefill_fns[bucket](self.params, small, jnp.asarray(padded),
-                                         len(prompt))
+                                         len(prompt), jnp.float32(temperature), key)
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               eos_id: Optional[int] = None) -> _Request:
+               eos_id: Optional[int] = None,
+               temperature: float = 0.0) -> _Request:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + budget exceeds max_seq")
-        req = _Request(prompt, max_new_tokens, eos_id=eos_id)
+        req = _Request(prompt, max_new_tokens, eos_id=eos_id,
+                       temperature=float(temperature))
         # closed-check and enqueue under one lock: a put racing close()
         # could otherwise land AFTER the shutdown sentinel and hang its
         # caller forever (the worker stops at the sentinel)
@@ -216,15 +244,20 @@ class ContinuousBatcher:
 
     # -- engine loop ---------------------------------------------------------
     def _admit(self, req: _Request) -> None:
+        # fresh sampling key per admission (distinct stream per request)
+        self._rng_counter += 1
+        slot_rng = jax.random.fold_in(self._base_rng, self._rng_counter)
         # prefill BEFORE taking the slot: a failing prefill (e.g. prompt
         # outside every bucket) must fail only this request, not leak a slot
-        small, first = self._prefill(req.prompt)
+        small, first = self._prefill(req.prompt, req.temperature, slot_rng)
         slot = self._free.pop()
         # drop the scalar cursor — adopt() resets the row cursor itself
         small = {n: {"attention": {"k": l["attention"]["k"], "v": l["attention"]["v"]}}
                  for n, l in small.items()}
-        self.cache, self.last_tok = self._adopt_fn(
-            self.cache, small, slot, len(req.prompt), first, self.last_tok)
+        self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
+            self.cache, small, slot, len(req.prompt), first, self.last_tok,
+            self.temps, self.rngs, jnp.float32(req.temperature),
+            jax.random.fold_in(slot_rng, 1))
         req.tokens.append(int(first))
         hit_eos = req.eos_id is not None and req.tokens[-1] == req.eos_id
         if req.max_new_tokens <= 1 or hit_eos:
@@ -284,8 +317,8 @@ class ContinuousBatcher:
             # outputs are ignored, and a retiring row's tail tokens are
             # discarded below)
             try:
-                self.cache, self.last_tok, toks = self._step_fn(
-                    self.params, self.cache, self.last_tok)
+                self.cache, self.last_tok, self.rngs, toks = self._step_fn(
+                    self.params, self.cache, self.last_tok, self.temps, self.rngs)
                 toks = np.asarray(toks)  # host fetch = chunk barrier
             except Exception as e:
                 # a device/RPC failure must not wedge the engine silently:

@@ -180,12 +180,11 @@ class GenerativeModel(ServedModel):
     sequences. Decoding manages its own compilation cache (models/gpt.py
     generate), so the bucket-jit path is bypassed.
 
-    ``continuous=True`` routes greedy requests through the slot-based
+    ``continuous=True`` routes requests through the slot-based
     continuous-batching engine (serving/continuous.py): concurrent HTTP
     requests share one running decode batch, each sequence retiring at its
-    own budget instead of the batch's max (VERDICT r3 #8). Sampled
-    (temperature>0) requests keep the static path — per-request keys don't
-    compose with a shared running batch."""
+    own budget instead of the batch's max (VERDICT r3 #8). Sampling rides
+    per-slot temperatures and keys inside the shared batch."""
 
     cfg: Any = None
     max_new_tokens: int = 16
@@ -226,7 +225,7 @@ class GenerativeModel(ServedModel):
         prompts = np.asarray(instances, dtype=np.int32)
         if prompts.ndim != 2:
             raise HttpError(400, "instances must be equal-length token-id lists")
-        if self.continuous and self.temperature <= 0.0:
+        if self.continuous:
             from .continuous import PREFILL_BUCKETS
 
             # client errors must surface as 4xx BEFORE anything is enqueued
@@ -238,7 +237,8 @@ class GenerativeModel(ServedModel):
             if prompts.shape[1] + self.max_new_tokens > self.cfg.max_seq:
                 raise HttpError(413, "prompt + generation budget exceeds max_seq")
             eng = self._continuous_engine()
-            futs = [eng.submit(row, self.max_new_tokens) for row in prompts]
+            futs = [eng.submit(row, self.max_new_tokens,
+                               temperature=self.temperature) for row in prompts]
             try:
                 return [row.tolist() + f.result(timeout=600.0)
                         for row, f in zip(prompts, futs)]

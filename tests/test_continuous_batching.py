@@ -182,3 +182,27 @@ def test_concurrent_submitters_and_midflight_close_all_resolve(params):
     assert len(outcomes) == 12, outcomes
     # no TimeoutError: every request was either served or failed FAST
     assert all(o != ("err", "TimeoutError") for o in outcomes), outcomes
+
+
+def test_mixed_greedy_and_sampled_slots(params):
+    """A sampled request and a greedy request share the running batch:
+    the greedy slot stays token-exact vs the static path while the sampled
+    slot draws distinct sequences across requests."""
+    eng = ContinuousBatcher(CFG, params, slots=2)
+    try:
+        p = prompt(1, 7)
+        ref = np.asarray(generate(CFG, params, p[None, :], max_new_tokens=12))[0, 7:].tolist()
+        greedy = eng.submit(p, 12)
+        s1 = eng.submit(prompt(2, 7), 12, temperature=1.0)
+        got_greedy = greedy.result(timeout=120)
+        t1 = s1.result(timeout=120)
+        # greedy unaffected by the sampled neighbor
+        assert got_greedy == ref
+        # two sampled requests with the SAME prompt draw different streams
+        s2 = eng.submit(prompt(2, 7), 12, temperature=1.0)
+        s3 = eng.submit(prompt(2, 7), 12, temperature=1.0)
+        t2, t3 = s2.result(timeout=120), s3.result(timeout=120)
+        assert t2 != t3 or t1 != t2, (t1, t2, t3)
+        assert all(0 <= t < CFG.vocab_size for seq in (t1, t2, t3) for t in seq)
+    finally:
+        eng.close()
