@@ -81,10 +81,7 @@ def test_every_component_attribute_has_fixture_coverage():
         attr for attr, meta in SPEC["attributes"].items()
         if meta["kind"] == "component" and attr not in html
     ]
-    # ns-select needs the full page rig (real /api/namespaces): covered by
-    # tests/test_ui_dom.py flows instead.
-    allowed = {"data-kf-ns-select"}
-    assert set(uncovered) <= allowed, f"components without fixtures: {uncovered}"
+    assert not uncovered, f"components without fixtures: {uncovered}"
 
 
 def test_fixture_runner_detects_semantic_drift():
