@@ -847,6 +847,14 @@ class Page:
         values = [o.attrs.get("value", "") for o in options]
         assert value in values, f"option {value!r} not in {values} for {selector}"
         sel.value = value
+        if "data-kf-ns-select" in sel.attrs:
+            # kfui's change handler navigates with the new ?ns= (initNsSelect
+            # edits the full URL via searchParams.set; this harness has no
+            # URL bar, so the sink records only the percent-encoded ns pair —
+            # fixtures must not assert other query state around it)
+            from urllib.parse import quote
+
+            self.location = f"?ns={quote(value)}"
         for other in self.doc.css("[data-kf-depends]"):
             if other.attrs.get("data-kf-depends", "") and self.doc.one(
                 other.attrs["data-kf-depends"]
