@@ -228,9 +228,12 @@ class PodletReconciler(Reconciler):
 
     Scheduling honors nodeSelector and extended-resource capacity
     (``google.com/tpu``), so tests exercise the same admission → selector →
-    capacity path a GKE TPU node pool enforces. With zero nodes in the store
-    the cluster is treated as unschedulable-free (pods just run) to keep
-    non-scheduling tests lightweight.
+    capacity path a GKE TPU node pool enforces. With zero nodes in the store,
+    pods with no TPU request just run (keeps non-scheduling tests
+    lightweight), but a pod requesting ``google.com/tpu`` chips is
+    Unschedulable until a node with capacity exists — exactly like a GKE
+    cluster with zero TPU node pools, so tests cannot silently pass without
+    modeling capacity.
     """
 
     FOR = ("v1", "Pod")
@@ -244,7 +247,7 @@ class PodletReconciler(Reconciler):
             return Result()
         nodes = client.list("v1", "Node")
         node_name = None
-        if nodes:
+        if nodes or pod_tpu_chips(pod):
             node_name = self._schedule(client, pod, nodes)
             if node_name is None:
                 pod["status"] = {
