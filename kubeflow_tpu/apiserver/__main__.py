@@ -3,7 +3,11 @@
 Env: API_PORT (default 8001), WEBHOOK_URL (external PodDefault admission;
 unset = in-process admission, the all-in-one default), KUBEFLOW_TPU_NATIVE
 (storage backend selection), APISERVER_AUTH=token (+ APISERVER_TOKENS /
-APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py).
+APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py),
+APISERVER_TLS_CERT_FILE + APISERVER_TLS_KEY_FILE to serve HTTPS (the
+reference substrate is TLS-only; clients verify via APISERVER_CA_FILE —
+web/tls.py). Bearer tokens over plaintext HTTP are only acceptable for
+loopback dev runs.
 """
 
 from __future__ import annotations
@@ -31,10 +35,24 @@ def main() -> None:
         )
     run_gc_loop(store)
     port = int(os.environ.get("API_PORT", "8001"))
-    server = app.serve(port, host="0.0.0.0")
+    ctx = None
+    cert = os.environ.get("APISERVER_TLS_CERT_FILE", "")
+    key = os.environ.get("APISERVER_TLS_KEY_FILE", "")
+    if cert or key:
+        # Half-configured TLS must fail CLOSED at startup, not silently
+        # serve the bearer-token boundary over plaintext.
+        if not (cert and key):
+            raise SystemExit(
+                "APISERVER_TLS_CERT_FILE and APISERVER_TLS_KEY_FILE must "
+                "both be set (or both unset for loopback dev)")
+        from ..web.tls import server_context
+
+        ctx = server_context(cert, key)
+    server = app.serve(port, host="0.0.0.0", ssl_context=ctx)
     logging.getLogger("kubeflow_tpu.apiserver").info(
-        "apiserver on :%d (backend=%s, admission=%s, auth=%s)",
+        "apiserver on :%d (%s, backend=%s, admission=%s, auth=%s)",
         server.port,
+        "TLS" if ctx else "plain HTTP",
         type(store.backend).__name__,
         webhook_url or "in-process",
         "token+rbac" if auth else "open",

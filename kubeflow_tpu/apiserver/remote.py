@@ -87,7 +87,8 @@ class RemoteWatch:
 
 
 class RemoteStore:
-    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None):
+    def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None,
+                 ca_file: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         # Role identity for the apiserver's token/RBAC gate (auth.py). Env
@@ -96,6 +97,18 @@ class RemoteStore:
         import os
 
         self.token = token if token is not None else os.environ.get("APISERVER_TOKEN") or None
+        # https apiservers are verified against APISERVER_CA_FILE (a path)
+        # or APISERVER_CA_DATA (inline PEM from a Secret key) — web/tls.py
+        # contract; never unverified. A client with neither falls back to
+        # the system bundle (real-CA deployments).
+        self._ssl_context = None
+        if self.base_url.startswith("https"):
+            from ..web.tls import client_context
+
+            self._ssl_context = client_context(
+                ca_file if ca_file is not None else os.environ.get("APISERVER_CA_FILE") or None,
+                os.environ.get("APISERVER_CA_DATA") or None,
+            )
 
     # -- wire helpers --------------------------------------------------------
     @staticmethod
@@ -124,7 +137,8 @@ class RemoteStore:
             headers["authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
-            return urllib.request.urlopen(req, timeout=timeout or self.timeout)
+            return urllib.request.urlopen(
+                req, timeout=timeout or self.timeout, context=self._ssl_context)
         except urllib.error.HTTPError as e:
             payload = e.read()
             try:

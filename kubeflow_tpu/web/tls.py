@@ -1,0 +1,101 @@
+"""TLS plumbing for the platform's HTTP boundaries.
+
+The substrate the reference builds on is TLS-only (the Kubernetes API
+server), and the reference webhook refuses to start without certs
+(admission-webhook/main.go:595-596, certs at /etc/webhook/certs). This
+module gives every role the same three pieces:
+
+- :func:`server_context` / :func:`client_context` — ssl.SSLContext
+  construction from PEM files (server: cert+key; client: a CA bundle to
+  verify the apiserver's cert against).
+- :func:`generate_self_signed` — a dev/e2e CA-less self-signed cert with
+  the SANs the in-cluster service DNS uses, so the five-process e2e and
+  unit tests exercise the real TLS handshake without external tooling.
+  Production deployments mount real certs (manifests/apiserver).
+
+Env contract (consumed by apiserver/__main__.py and RemoteStore):
+``APISERVER_TLS_CERT_FILE``/``APISERVER_TLS_KEY_FILE`` enable HTTPS on the
+apiserver; ``APISERVER_CA_FILE`` is the bundle clients verify with.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import ssl
+from typing import Optional, Sequence, Tuple
+
+#: SANs every generated cert carries — the names clients dial in-cluster
+#: (service DNS, short forms) and in tests (loopback).
+DEFAULT_SANS = (
+    "localhost",
+    "apiserver",
+    "apiserver.kubeflow",
+    "apiserver.kubeflow.svc",
+    "apiserver.kubeflow.svc.cluster.local",
+)
+
+
+def server_context(cert_file: str, key_file: str) -> ssl.SSLContext:
+    """TLS server context; certs load (and fail) before any socket binds."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    return ctx
+
+
+def client_context(ca_file: Optional[str] = None, ca_data: Optional[str] = None) -> ssl.SSLContext:
+    """Verifying client context. ``ca_file`` (a path) or ``ca_data`` (the
+    PEM itself — the kubeconfig ``certificate-authority-data`` pattern, so
+    manifests can inject the bundle from a Secret key without a volume
+    mount) is REQUIRED to trust a private cert — verification is never
+    disabled; a client that cannot verify must fail the handshake, not
+    silently trust."""
+    return ssl.create_default_context(cafile=ca_file or None, cadata=ca_data or None)
+
+
+def generate_self_signed(
+    directory: str,
+    common_name: str = "apiserver",
+    sans: Sequence[str] = DEFAULT_SANS,
+    days: int = 7,
+) -> Tuple[str, str]:
+    """Write ``tls.crt``/``tls.key`` under ``directory`` and return their
+    paths. Key is 2048-bit RSA; SANs cover DEFAULT_SANS + 127.0.0.1 so the
+    same cert verifies for loopback tests and in-cluster DNS."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    alt_names = [x509.DNSName(s) for s in sans]
+    alt_names.append(x509.IPAddress(ipaddress.ip_address("127.0.0.1")))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName(alt_names), critical=False)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = os.path.join(directory, "tls.crt")
+    key_path = os.path.join(directory, "tls.key")
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    os.chmod(key_path, 0o600)
+    return cert_path, key_path

@@ -14,7 +14,6 @@ import base64
 import json
 import logging
 import os
-import ssl
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
@@ -67,9 +66,10 @@ def main(argv=None) -> None:
     app = make_webhook_app(Client(store), os.environ.get("CLUSTER_DOMAIN", "cluster.local"))
     ctx = None
     if args.tls_cert_file and args.tls_key_file:
+        from ..web.tls import server_context
+
         # Certs load (and fail) before any socket accepts a connection.
-        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ctx.load_cert_chain(args.tls_cert_file, args.tls_key_file)
+        ctx = server_context(args.tls_cert_file, args.tls_key_file)
     server = app.serve(args.port, host="0.0.0.0", ssl_context=ctx)
     logging.getLogger("kubeflow_tpu.webhook").info(
         "webhook on :%d (%s)", server.port, "TLS" if ctx else "plain HTTP"

