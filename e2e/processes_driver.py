@@ -115,7 +115,8 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
                 "APISERVER_TOKEN_FILE": token_file,
                 "APISERVER_TLS_CERT_FILE": cert_file,
                 "APISERVER_TLS_KEY_FILE": key_file,
-                "WEBHOOK_URL": f"http://127.0.0.1:{wh_port}/apply-poddefault",
+                # NOTE: no WEBHOOK_URL — admission is registered by writing a
+                # MutatingWebhookConfiguration over the wire below (r4 #5)
             })
             _wait_http(f"{api_url}/healthz", context=ctx)
             spawn(tmp, "kubeflow_tpu.webhook", {
@@ -142,6 +143,16 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
                 assert e.code == 401, f"expected 401, got {e.code}"
 
             admin = RemoteStore(api_url, token=tokens["admin"], ca_file=cert_file)
+
+            # dynamic admission registration: write the configuration object
+            # (failurePolicy Fail — TPU env injection is gang-critical; an
+            # unmutated multi-host pod set wedges silently)
+            from kubeflow_tpu.apiserver.admission import webhook_configuration
+
+            admin.create(webhook_configuration(
+                "poddefault-webhook",
+                f"http://127.0.0.1:{wh_port}/apply-poddefault",
+                failure_policy="Fail"))
             admin.create(new_object("v1", "Namespace", "team-proc", None))
 
             # spawn a notebook through the webapp's HTTP surface

@@ -1,7 +1,10 @@
 """python -m kubeflow_tpu.apiserver — the REST control-plane server.
 
-Env: API_PORT (default 8001), WEBHOOK_URL (external PodDefault admission;
-unset = in-process admission, the all-in-one default), KUBEFLOW_TPU_NATIVE
+Env: API_PORT (default 8001), WEBHOOK_URL (legacy sugar: seeds a
+MutatingWebhookConfiguration object for the external PodDefault webhook —
+admission is ALWAYS driven by those stored objects, apiserver/admission.py;
+unset + no objects = in-process admission, the all-in-one default),
+KUBEFLOW_TPU_NATIVE
 (storage backend selection), APISERVER_AUTH=token (+ APISERVER_TOKENS /
 APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py),
 APISERVER_TLS_CERT_FILE + APISERVER_TLS_KEY_FILE to serve HTTPS (the

@@ -237,8 +237,8 @@ class RemoteStore:
 
     def register_admission(self, hook) -> None:
         raise RuntimeError(
-            "admission runs server-side; deploy the webhook and point the "
-            "apiserver at it (WEBHOOK_URL)"
+            "admission runs server-side; deploy the webhook and register it "
+            "by creating a MutatingWebhookConfiguration object"
         )
 
     def wait_ready(self, timeout: float = 30.0) -> None:

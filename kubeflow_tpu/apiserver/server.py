@@ -13,14 +13,14 @@ Auth model (VERDICT r3 #3): pass an :class:`~.auth.ApiAuth` to gate every
 verb — bearer-token identity + RBAC over the store's Role/Binding objects,
 deny-by-default, the K8s-API-server half of the reference's two-gate model
 (user-facing SAR stays in the web apps, crud_backend model, SURVEY §2.7).
-``auth=None`` keeps the open in-process/all-in-one behavior. Admission: a
-``webhook_url`` wires pod CREATEs through the external PodDefault webhook
-(AdmissionReview + JSONPatch), the MutatingWebhookConfiguration analog.
+``auth=None`` keeps the open in-process/all-in-one behavior. Admission is
+driven by stored MutatingWebhookConfiguration objects (admission.py —
+rules, namespaceSelector, failurePolicy); ``webhook_url`` is legacy sugar
+that seeds one such object for pod CREATEs.
 """
 
 from __future__ import annotations
 
-import base64
 import json
 import threading
 import time
@@ -31,7 +31,7 @@ from ..api.conversion import convert, convert_fragment, hub_resource
 from ..api.meta import REGISTRY, Resource
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .auth import ApiAuth, Identity, Unauthenticated
-from .store import ApiError, Forbidden, Store
+from .store import ApiError, Store
 
 
 def _selector_of(req: Request) -> Optional[Dict[str, str]]:
@@ -71,55 +71,46 @@ def apply_json_patch(obj: Dict[str, Any], ops: List[Dict[str, Any]]) -> Dict[str
     return out
 
 
-def webhook_admission_hook(webhook_url: str, timeout: float = 5.0):
-    """Admission hook POSTing AdmissionReview to an external webhook and
-    applying the returned base64 JSONPatch (failurePolicy: Ignore — an
-    unreachable webhook must not brick pod creation, matching the
-    manifests' MutatingWebhookConfiguration)."""
-    import urllib.error
-    import urllib.request
+_MWC_RES = REGISTRY.for_plural("admissionregistration.k8s.io/v1",
+                               "mutatingwebhookconfigurations")
 
-    def hook(op: str, res: Resource, obj: Dict[str, Any]) -> Dict[str, Any]:
-        if op != "CREATE" or res.kind != "Pod":
-            return obj
-        review = {
-            "apiVersion": "admission.k8s.io/v1",
-            "kind": "AdmissionReview",
-            "request": {
-                "uid": "admit-" + apimeta.name_of(obj),
-                "operation": op,
-                "namespace": apimeta.namespace_of(obj),
-                "object": obj,
-            },
-        }
-        req = urllib.request.Request(
-            webhook_url, json.dumps(review).encode(), {"content-type": "application/json"}
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                body = json.loads(resp.read())
-        except (urllib.error.URLError, OSError, ValueError):
-            return obj  # failurePolicy: Ignore
-        response = body.get("response") or {}
-        if not response.get("allowed", True):
-            # 403, as the Kubernetes API server returns for admission denial
-            # — a 5xx would make clients retry a request that can't succeed.
-            raise Forbidden(response.get("status", {}).get("message", "admission denied"))
-        patch_b64 = response.get("patch")
-        if patch_b64:
-            ops = json.loads(base64.b64decode(patch_b64))
-            obj = apply_json_patch(obj, ops)
-        return obj
 
-    return hook
+def seed_webhook_config(store: Store, url: str, failure_policy: str = "Ignore",
+                        name: str = "env-registered-webhook") -> None:
+    """Materialize the legacy ``WEBHOOK_URL`` env wiring as a stored
+    MutatingWebhookConfiguration, so there is exactly one admission-
+    registration mechanism — the object (apiserver/admission.py). Ignore
+    policy preserves the env path's historical fail-open behavior; native
+    registrations should write their own object with Fail.
+
+    Upsert: the env always reflects the CURRENT url — re-wiring an
+    all-in-one with a new WEBHOOK_URL must not leave a stale endpoint."""
+    from .admission import webhook_configuration
+    from .store import Conflict
+
+    desired = webhook_configuration(name, url, failure_policy)
+    try:
+        store.create(desired)
+    except Conflict:
+        existing = store.get(_MWC_RES, name)
+        if existing.get("webhooks") != desired["webhooks"]:
+            existing["webhooks"] = desired["webhooks"]
+            store.update(existing)
 
 
 def make_apiserver_app(
     store: Store, webhook_url: Optional[str] = None, auth: Optional[ApiAuth] = None
 ) -> App:
+    from .admission import dynamic_admission_hook
+
     app = App("apiserver")
+    # once per store: building two apps over one store (tests, all-in-one)
+    # must not double-invoke every matching webhook
+    if not getattr(store, "_dynamic_admission_registered", False):
+        store.register_admission(dynamic_admission_hook(store))
+        store._dynamic_admission_registered = True
     if webhook_url:
-        store.register_admission(webhook_admission_hook(webhook_url))
+        seed_webhook_config(store, webhook_url)
 
     if auth is not None:
         @app.middleware

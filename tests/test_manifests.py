@@ -185,8 +185,11 @@ def test_webhook_configuration_targets_pod_create():
     assert len(hooks) == 1
     rule = hooks[0]["webhooks"][0]["rules"][0]
     assert rule["operations"] == ["CREATE"] and rule["resources"] == ["pods"]
-    # Ignore failures: a down webhook must not brick pod creation platform-wide
-    assert hooks[0]["webhooks"][0]["failurePolicy"] == "Ignore"
+    # Fail within profile namespaces: TPU injection is gang-critical, an
+    # unmutated slice wedges silently (VERDICT r4 #4); the namespaceSelector
+    # bounds the blast radius so system pods never depend on the webhook.
+    assert hooks[0]["webhooks"][0]["failurePolicy"] == "Fail"
+    assert hooks[0]["webhooks"][0]["namespaceSelector"]["matchLabels"]
 
 
 def test_spawner_configmap_parses_into_spawner_config():
