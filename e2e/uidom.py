@@ -231,6 +231,9 @@ class Page:
         self.reloaded = False
         self._pollers: Dict[int, "Poller"] = {}
         self.calls: List[Tuple[str, str]] = []  # request log (method, url)
+        # Browser-faithful cookie jar: Set-Cookie from responses rides on
+        # subsequent requests (session login flows — the gateway tier).
+        self.cookies: Dict[str, str] = {}
         self.init()
 
     # -- transport (fetch analog, in-process) ---------------------------------
@@ -245,8 +248,33 @@ class Page:
 
     def _fetch(self, method: str, url: str, body: Any = None):
         self.calls.append((method, url))
-        resp = self.app.call(method, url, body, self.headers)
+        headers = dict(self.headers)
+        # one cookie store, jar (fresher) wins over statically-seeded pairs
+        effective: Dict[str, str] = {}
+        for pair in filter(None, (headers.get("cookie") or "").split(";")):
+            name, _, value = pair.strip().partition("=")
+            if name:
+                effective[name] = value
+        effective.update(self.cookies)
+        if effective:
+            headers["cookie"] = "; ".join(f"{k}={v}" for k, v in effective.items())
+        # kfui.js transport: the x-xsrf-token header is read from the
+        # XSRF-TOKEN cookie per request (kfui.js cookie("XSRF-TOKEN"))
+        if effective.get("XSRF-TOKEN"):
+            headers["x-xsrf-token"] = effective["XSRF-TOKEN"]
+        resp = self.app.call(method, url, body, headers)
+        for raw in getattr(resp, "cookies", []) or []:
+            pair = raw.split(";", 1)[0]
+            name, _, value = pair.partition("=")
+            if name:
+                if "max-age=0" in raw.lower():
+                    self.cookies.pop(name.strip(), None)
+                else:
+                    self.cookies[name.strip()] = value
         data = resp.body
+        if isinstance(data, (bytes, str)) and resp.content_type.startswith("application/json"):
+            # fetch().json() analog: proxied responses arrive as raw bytes
+            data = json.loads(data) if data else None
         if resp.status >= 400:
             msg = (data or {}).get("error") if isinstance(data, dict) else None
             raise RuntimeError(msg or f"HTTP {resp.status}")

@@ -84,6 +84,7 @@ def auth_from_env():
         disable_auth=env_flag("APP_DISABLE_AUTH"),
         cluster_admins=[a for a in os.environ.get("CLUSTER_ADMIN", "").split(",") if a],
         secure_cookies=env_flag("APP_SECURE_COOKIES"),
+        gateway_secret=os.environ.get("GATEWAY_SHARED_SECRET", ""),
     )
 
 

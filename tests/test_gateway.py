@@ -1,0 +1,206 @@
+"""Authenticating front gateway (VERDICT r4 missing #2 / next #4).
+
+Reference: the Dex/IAP login the e2e suite drives (testing/auth.py,
+test_jwa.py:7-9) + Istio as the only identity-header writer
+(profile_controller.go:340-438). Here: services/gateway.py is the trust
+root; backends with gateway_secret reject hand-written identity headers.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.services.gateway import (
+    SESSION_COOKIE, SessionSigner, check_password, hash_password,
+    make_gateway_app, routes_from_env,
+)
+from kubeflow_tpu.web.auth import AuthConfig, user_of
+from kubeflow_tpu.web.http import App, HttpError, Request
+
+ALICE = "alice@example.com"
+SECRET = "gw-secret-for-tests"
+
+
+def upstream_echo_app():
+    """Upstream that echoes the identity + gateway-token headers it saw."""
+    app = App("echo")
+
+    # the gateway strips the matched /jupyter prefix (VirtualService
+    # rewrite analog), so the upstream serves at /api/... like the real JWA
+    @app.route("/api/whoami")
+    def whoami(req: Request):
+        return {"user": req.header("kubeflow-userid"),
+                "gateway_token": req.header("x-gateway-token")}
+
+    return app
+
+
+@pytest.fixture()
+def stack():
+    upstream = upstream_echo_app().serve(0)
+    gw_app = make_gateway_app(
+        users={ALICE: hash_password("open-sesame")},
+        routes=[("/jupyter", f"http://127.0.0.1:{upstream.port}")],
+        shared_secret=SECRET,
+    )
+    gw = gw_app.serve(0)
+    yield f"http://127.0.0.1:{gw.port}", upstream
+    gw.close()
+    upstream.close()
+
+
+def http(url, method="GET", body=None, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode() if body is not None else None,
+        method=method, headers={"content-type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}"), resp.headers
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, (json.loads(payload) if payload else {}), e.headers
+
+
+import urllib.error  # noqa: E402
+
+
+class TestPasswordTable:
+    def test_roundtrip(self):
+        entry = hash_password("s3cret")
+        assert check_password("s3cret", entry)
+        assert not check_password("wrong", entry)
+        assert not check_password("s3cret", "garbage")
+
+
+class TestSessionSigner:
+    def test_issue_verify(self):
+        s = SessionSigner(key=b"k" * 32)
+        assert s.verify(s.issue(ALICE)) == ALICE
+
+    def test_forged_and_expired(self):
+        s = SessionSigner(key=b"k" * 32)
+        other = SessionSigner(key=b"x" * 32)
+        assert s.verify(other.issue(ALICE)) is None  # wrong key
+        assert s.verify("AAAA") is None  # garbage
+        expired = SessionSigner(key=b"k" * 32, ttl=-1)
+        assert s.verify(expired.issue(ALICE)) is None  # same key, expired
+
+
+class TestGatewayFlow:
+    def test_unauthenticated_api_request_401(self, stack):
+        base, _ = stack
+        status, body, _ = http(f"{base}/jupyter/api/whoami")
+        assert status == 401
+
+    def test_login_then_proxied_identity(self, stack):
+        base, _ = stack
+        status, body, headers = http(f"{base}/login", "POST",
+                                     {"email": ALICE, "password": "open-sesame"})
+        assert status == 200 and body["user"] == ALICE
+        cookie = headers["set-cookie"].split(";")[0]
+        assert cookie.startswith(SESSION_COOKIE + "=")
+        status, body, _ = http(f"{base}/jupyter/api/whoami", headers={"cookie": cookie})
+        assert status == 200
+        assert body["user"] == ALICE
+        assert body["gateway_token"] == SECRET  # attached by the gateway
+
+    def test_bad_credentials_401(self, stack):
+        base, _ = stack
+        status, _, _ = http(f"{base}/login", "POST",
+                            {"email": ALICE, "password": "nope"})
+        assert status == 401
+        status, _, _ = http(f"{base}/login", "POST",
+                            {"email": "ghost@example.com", "password": "x"})
+        assert status == 401
+
+    def test_spoofed_header_is_stripped(self, stack):
+        """A logged-in client cannot override its own identity upstream."""
+        base, _ = stack
+        _, _, headers = http(f"{base}/login", "POST",
+                             {"email": ALICE, "password": "open-sesame"})
+        cookie = headers["set-cookie"].split(";")[0]
+        status, body, _ = http(f"{base}/jupyter/api/whoami",
+                               headers={"cookie": cookie,
+                                        "kubeflow-userid": "admin@evil.com"})
+        assert status == 200
+        assert body["user"] == ALICE  # session identity wins, spoof dies at the gate
+
+    def test_browser_redirects_to_login(self, stack):
+        base, _ = stack
+        req = urllib.request.Request(f"{base}/jupyter/", headers={"accept": "text/html"})
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **k):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        try:
+            opener.open(req, timeout=10)
+            raise AssertionError("expected 302")
+        except urllib.error.HTTPError as e:
+            assert e.code == 302 and e.headers["location"] == "/login"
+
+    def test_logout_invalidates(self, stack):
+        base, _ = stack
+        _, _, headers = http(f"{base}/login", "POST",
+                             {"email": ALICE, "password": "open-sesame"})
+        cookie = headers["set-cookie"].split(";")[0]
+        status, _, out = http(f"{base}/logout", "POST", headers={"cookie": cookie})
+        assert status == 200
+        cleared = out["set-cookie"]
+        assert "Max-Age=0" in cleared
+        status, _, _ = http(f"{base}/jupyter/api/whoami",
+                            headers={"cookie": SESSION_COOKIE + "="})
+        assert status == 401
+
+    def test_unrouted_path_404(self, stack):
+        base, _ = stack
+        _, _, headers = http(f"{base}/login", "POST",
+                             {"email": ALICE, "password": "open-sesame"})
+        cookie = headers["set-cookie"].split(";")[0]
+        status, _, _ = http(f"{base}/volumes/api/x", headers={"cookie": cookie})
+        assert status == 404
+
+
+class TestBackendTrustRoot:
+    """web/auth.py: gateway_secret makes the identity header gateway-only."""
+
+    def test_direct_spoof_rejected(self):
+        cfg = AuthConfig(gateway_secret=SECRET)
+        req = Request(method="GET", path="/api/x", query={},
+                      headers={"kubeflow-userid": "admin@evil.com"}, body=b"")
+        with pytest.raises(HttpError) as ei:
+            user_of(req, cfg)
+        assert ei.value.status == 401
+
+    def test_gateway_asserted_accepted(self):
+        cfg = AuthConfig(gateway_secret=SECRET)
+        req = Request(method="GET", path="/api/x", query={},
+                      headers={"kubeflow-userid": ALICE,
+                               "x-gateway-token": SECRET}, body=b"")
+        assert user_of(req, cfg) == ALICE
+
+    def test_wrong_token_rejected(self):
+        cfg = AuthConfig(gateway_secret=SECRET)
+        req = Request(method="GET", path="/api/x", query={},
+                      headers={"kubeflow-userid": ALICE,
+                               "x-gateway-token": "forged"}, body=b"")
+        with pytest.raises(HttpError):
+            user_of(req, cfg)
+
+    def test_no_secret_keeps_legacy_behavior(self):
+        cfg = AuthConfig()
+        req = Request(method="GET", path="/api/x", query={},
+                      headers={"kubeflow-userid": ALICE}, body=b"")
+        assert user_of(req, cfg) == ALICE
+
+
+class TestRoutesEnv:
+    def test_longest_prefix_wins(self, monkeypatch):
+        monkeypatch.setenv(
+            "GATEWAY_ROUTES",
+            "/=http://dash:8082;/jupyter=http://jwa:5000")
+        routes = routes_from_env()
+        assert routes[0] == ("/jupyter", "http://jwa:5000")
+        assert routes[-1] == ("/", "http://dash:8082")

@@ -565,3 +565,77 @@ class TestClientRichness:
         assert len(p2.split()) == len(p1.split()) + 1
         labels = [t.text for t in page.doc.css("#fleet-history text.kf-line-label")]
         assert labels and labels[0].startswith("tpu-node-0 ")
+
+
+class TestGatewayLoginFlow:
+    """Login → spawn THROUGH the authenticating gateway (VERDICT r4 #4):
+    the uidom harness drives the real login page against the real gateway
+    app, which proxies to a real JWA server over HTTP with the gateway-
+    asserted identity — the Selenium-through-Dex flow, CI-shaped."""
+
+    def test_login_then_spawn_through_gateway(self, platform, team_a, auth):
+        from kubeflow_tpu.services.gateway import hash_password, make_gateway_app
+
+        tpu_cluster(platform)
+        secret = "uidom-gw-secret"
+        backend_auth = AuthConfig(
+            cluster_admins=auth.cluster_admins, gateway_secret=secret)
+        jwa_server = make_jupyter_app(platform.client, backend_auth).serve(0)
+        try:
+            gateway = make_gateway_app(
+                users={"alice@example.com": hash_password("wonderland")},
+                routes=[("/jupyter", f"http://127.0.0.1:{jwa_server.port}")],
+                shared_secret=secret,
+            )
+
+            # 1. unauthenticated: the login DOM renders, bad creds stay put
+            login = Page(gateway, load_ui("login.html"))
+            login.fill("#f-email", "alice@example.com")
+            login.fill("#f-password", "wrong")
+            login.submit("#login-form")
+            assert login.location is None  # no nav on 401
+            assert login.snacks[-1][1] == "error"
+
+            # 2. real credentials: session cookie lands in the jar, nav fires
+            login.fill("#f-password", "wonderland")
+            login.submit("#login-form")
+            assert login.snacks[-1] == ("signed in", "ok")
+            assert login.location == "/"
+            assert "kubeflow-session" in login.cookies
+
+            # 3. same browser (cookie jar) opens the spawner page THROUGH
+            #    the gateway: discovery + spawn all proxy with asserted
+            #    identity. The page's app-relative /api URLs ride the
+            #    /jupyter route (the SPA is mounted under that prefix in a
+            #    real deploy; the gateway strips it like the ingress
+            #    VirtualService rewrite does).
+            class MountedApp:
+                def call(self, method, url, body=None, headers=None):
+                    mounted = "/jupyter" + url if url.startswith("/api") else url
+                    return gateway.call(method, mounted, body, headers)
+
+            session = login.cookies["kubeflow-session"]
+            spawner = Page(MountedApp(), load_ui("jupyter.html"), ns="team-a",
+                           headers={"cookie": f"kubeflow-session={session}; "
+                                              "XSRF-TOKEN=t"})
+            spawner.select("#f-tpu-gen", "v5e")
+            spawner.select("#f-tpu-topo", "2x4")
+            spawner.fill("#f-name", "gw-trainer")
+            spawner.submit("#spawn-form")
+            assert spawner.snacks[-1] == ("notebook created", "ok")
+            assert platform.wait_idle()
+            nb = platform.client.get(
+                "kubeflow.org/v1beta1", "Notebook", "gw-trainer", "team-a")
+            assert nb["spec"]["tpu"] == {"generation": "v5e", "topology": "2x4"}
+
+            # 4. bypassing the gateway with a forged header: rejected
+            import urllib.error
+            import urllib.request
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{jwa_server.port}/api/namespaces/team-a/notebooks",
+                headers={"kubeflow-userid": "alice@example.com"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 401
+        finally:
+            jwa_server.close()
