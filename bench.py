@@ -54,6 +54,35 @@ def _timed_steps() -> int:
     except ValueError:
         return 50
 
+
+def _repeats() -> int:
+    # Repeat the timed window and take the MEDIAN (VERDICT r4 #7: the
+    # flagship number must reproduce across cold driver runs within
+    # ±0.5 MFU). In-process windows measure dead-stable (30.79 ±0.01 MFU
+    # over 6 consecutive windows, round 5); the median + reported spread
+    # makes transient tunnel contention visible instead of becoming the
+    # headline.
+    try:
+        return max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    except ValueError:
+        return 3
+
+
+def _timed_windows(fn, repeats: int):
+    """Run ``fn()`` (one fetched-checksum window) ``repeats`` times; return
+    (median_seconds, [per-window seconds])."""
+    import statistics
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+        # every window's fetched results must be finite — the median time
+        # may come from any of them, so none may be a corrupted run
+        fn.check()
+    return statistics.median(times), times
+
 # XLA cost-analysis fallback: ResNet-50 fwd ~8.2 GFLOP/image @224 (2*MACs),
 # train step ~3x forward.
 ANALYTIC_FWD_FLOPS_PER_IMAGE = 8.2e9
@@ -107,14 +136,21 @@ def _bench(batch: int):
     loss, checksum = run_steps(state, images, labels)
     _ = (float(loss), float(checksum))
 
-    t0 = time.perf_counter()
-    loss, checksum = run_steps(state, images, labels)
-    loss, checksum = float(loss), float(checksum)  # host fetch = real barrier
-    total = time.perf_counter() - t0
     import math
 
-    if not (math.isfinite(loss) and math.isfinite(checksum)):
-        raise RuntimeError(f"non-finite bench result: loss={loss} checksum={checksum}")
+    results = {}
+
+    def window():
+        loss, checksum = run_steps(state, images, labels)
+        # host fetch = real barrier; finiteness checked outside the timer
+        results["loss"], results["checksum"] = float(loss), float(checksum)
+
+    def check():
+        if not all(math.isfinite(v) for v in results.values()):
+            raise RuntimeError(f"non-finite bench result: {results}")
+
+    window.check = check
+    total, window_times = _timed_windows(window, _repeats())
     dt = total / timed_steps
 
     gen = detect_generation()
@@ -122,6 +158,8 @@ def _bench(batch: int):
         "images_per_sec_per_chip": batch / dt,
         "step_seconds": dt,
         "mfu": mfu(flops, dt, num_chips=1, generation=gen),
+        "window_mfus": [round(mfu(flops, t / timed_steps, 1, gen) * 100, 2)
+                        for t in window_times],
         "generation": gen,
         "batch": batch,
         "flops_per_step": flops,
@@ -179,20 +217,28 @@ def _bench_gpt(batch: int, seq: int):
 
     loss, checksum = run_steps(params, opt_state, ids)
     _ = (float(loss), float(checksum))
-    t0 = time.perf_counter()
-    loss, checksum = run_steps(params, opt_state, ids)
-    loss, checksum = float(loss), float(checksum)
-    total = time.perf_counter() - t0
     import math
 
-    if not (math.isfinite(loss) and math.isfinite(checksum)):
-        raise RuntimeError(f"non-finite gpt bench: loss={loss} checksum={checksum}")
+    results = {}
+
+    def window():
+        loss, checksum = run_steps(params, opt_state, ids)
+        results["loss"], results["checksum"] = float(loss), float(checksum)
+
+    def check():
+        if not all(math.isfinite(v) for v in results.values()):
+            raise RuntimeError(f"non-finite gpt bench: {results}")
+
+    window.check = check
+    total, window_times = _timed_windows(window, _repeats())
     dt = total / timed_steps
     gen = detect_generation()
     return {
         "tokens_per_sec_per_chip": batch * seq / dt,
         "step_seconds": dt,
         "mfu": mfu(flops, dt, num_chips=1, generation=gen),
+        "window_mfus": [round(mfu(flops, t / timed_steps, 1, gen) * 100, 2)
+                        for t in window_times],
         "generation": gen,
         "batch": batch,
         "seq": seq,
@@ -216,6 +262,7 @@ def _run_resnet(platform: str) -> dict:
                 "vs_baseline": round(r["mfu"] / TARGET_MFU, 4),
                 "images_per_sec_per_chip": round(r["images_per_sec_per_chip"], 1),
                 "batch": r["batch"],
+                "window_mfus": r.get("window_mfus"),
                 "platform": platform,
             })
         except Exception as e:  # OOM at this batch -> try smaller
@@ -238,7 +285,8 @@ def _run_gpt(platform: str, allow_legacy_batch: bool = False) -> dict:
             "unit": "percent_mfu",
             "vs_baseline": round(r["mfu"] / TARGET_MFU, 4),
             "tokens_per_sec_per_chip": round(r["tokens_per_sec_per_chip"], 1),
-            "batch": r["batch"], "seq": r["seq"], "platform": platform,
+            "batch": r["batch"], "seq": r["seq"],
+            "window_mfus": r.get("window_mfus"), "platform": platform,
         })
     except Exception as e:
         return _emit({"metric": "gpt2_medium_train_mfu", "value": 0.0,

@@ -233,7 +233,10 @@ class Page:
         self.calls: List[Tuple[str, str]] = []  # request log (method, url)
         # Browser-faithful cookie jar: Set-Cookie from responses rides on
         # subsequent requests (session login flows — the gateway tier).
+        # _deleted tracks Max-Age=0 deletions so a statically-seeded pair
+        # (Page headers) cannot resurrect a cookie the server cleared.
         self.cookies: Dict[str, str] = {}
+        self._deleted_cookies: set = set()
         self.init()
 
     # -- transport (fetch analog, in-process) ---------------------------------
@@ -256,6 +259,8 @@ class Page:
             if name:
                 effective[name] = value
         effective.update(self.cookies)
+        for name in self._deleted_cookies:
+            effective.pop(name, None)
         if effective:
             headers["cookie"] = "; ".join(f"{k}={v}" for k, v in effective.items())
         # kfui.js transport: the x-xsrf-token header is read from the
@@ -269,8 +274,10 @@ class Page:
             if name:
                 if "max-age=0" in raw.lower():
                     self.cookies.pop(name.strip(), None)
+                    self._deleted_cookies.add(name.strip())
                 else:
                     self.cookies[name.strip()] = value
+                    self._deleted_cookies.discard(name.strip())
         data = resp.body
         if isinstance(data, (bytes, str)) and resp.content_type.startswith("application/json"):
             # fetch().json() analog: proxied responses arrive as raw bytes

@@ -45,12 +45,22 @@ from ..web.http import App, JsonResponse, Request
 SESSION_COOKIE = "kubeflow-session"
 
 #: request headers never forwarded upstream: identity is gateway-asserted,
-#: hop-by-hop headers are per-connection.
+#: hop-by-hop headers are per-connection. The cookie header is re-written
+#: separately (the session cookie must never reach backends).
 _STRIP = {USERID_HEADER, GATEWAY_TOKEN_HEADER, "host", "connection", "keep-alive",
-          "transfer-encoding", "content-length", "upgrade", "proxy-authorization"}
+          "transfer-encoding", "content-length", "upgrade", "proxy-authorization",
+          "cookie"}
 #: response headers not passed back (the gateway's server sets its own).
 _STRIP_RESP = {"connection", "keep-alive", "transfer-encoding", "content-length",
                "set-cookie"}  # multi-valued: carried via get_all, not the dict
+
+
+class _NoRedirectHandler(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
+_no_redirect_opener = urllib.request.build_opener(_NoRedirectHandler)
 
 
 def hash_password(password: str, salt: Optional[bytes] = None, rounds: int = 100_000) -> str:
@@ -233,6 +243,12 @@ def make_gateway_app(
         headers[USERID_HEADER] = email
         if shared_secret:
             headers[GATEWAY_TOKEN_HEADER] = shared_secret
+        # forward cookies MINUS the gateway session: a backend must never
+        # hold a replayable all-routes credential (oauth2-proxy behavior)
+        fwd_cookies = [p.strip() for p in (req.header("cookie") or "").split(";")
+                       if p.strip() and not p.strip().startswith(SESSION_COOKIE + "=")]
+        if fwd_cookies:
+            headers["cookie"] = "; ".join(fwd_cookies)
         from urllib.parse import urlencode
 
         qs = urlencode(req.query, doseq=True)
@@ -240,7 +256,10 @@ def make_gateway_app(
         up_req = urllib.request.Request(
             url, data=req.body or None, method=req.method, headers=headers)
         try:
-            with urllib.request.urlopen(up_req, timeout=timeout) as up:
+            # no server-side redirect following: a 3xx is RELAYED to the
+            # browser (the HTTPError path below), never fetched by the
+            # gateway itself (SSRF surface + wrong-method replays)
+            with _no_redirect_opener.open(up_req, timeout=timeout) as up:
                 body = up.read()
                 resp_headers = {k: v for k, v in up.headers.items()
                                 if k.lower() not in _STRIP_RESP}
