@@ -214,6 +214,15 @@ def _bench_gpt(batch: int, seq: int):
     if not flops:
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
         flops = 6.0 * n_params * batch * seq  # 6ND
+    # XLA cost analysis counts ZERO flops inside the Pallas flash-attention
+    # custom call (verified: identical totals for b8xL1024 and b4xL2048,
+    # whose attention flops differ 2x) — add the causal attention work the
+    # kernel actually executes, or attention-heavy configs are
+    # under-credited. Convention matches the rest of the numerator
+    # (2 flops/MAC): one causal dot = 2*L^2*d/2 flops per (b, head); fwd
+    # has 2 dots (QK^T, PV), bwd 5 (recomputed s, dp, dq, dk, dv) = 3.5x.
+    causal_dot = 2.0 * batch * cfg.n_heads * seq * seq * cfg.head_dim / 2
+    flops += 3.5 * (2 * causal_dot) * cfg.n_layers
 
     loss, checksum = run_steps(params, opt_state, ids)
     _ = (float(loss), float(checksum))

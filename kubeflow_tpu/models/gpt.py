@@ -84,7 +84,19 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def causal_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    return flash_attention(q, k, v, causal=True)
+    import os
+
+    # Experiment knobs for full-step tiling sweeps (BASELINE methodology:
+    # only the full-step bench decides — isolated probes mispredicted three
+    # times in round 4). Unset = the kernel's measured auto-tiling.
+    bq = int(os.environ.get("GPT_FLASH_BLOCK_Q", "0")) or None
+    bk = int(os.environ.get("GPT_FLASH_BLOCK_K", "0")) or None
+    if os.environ.get("GPT_ATTN_BYPASS") == "1":
+        # Diagnostic only: attention out = v isolates the NON-attention
+        # step cost (all of which is per-token, so a bypassed step must
+        # time identically across seq lengths at equal token count).
+        return v
+    return flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
 
 
 class GptAttention(nn.Module):

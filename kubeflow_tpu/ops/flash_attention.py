@@ -111,7 +111,7 @@ def _block_sizes(lq: int, lk: int, block_q: Optional[int], block_k: Optional[int
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
     *, scale: float, causal: bool, q_offset: int, k_offset: int,
-    block_q: int, block_k: int, nk: int,
+    block_q: int, block_k: int, nk: int, dot_dtype,
 ):
     ik = pl.program_id(3)
 
@@ -126,8 +126,8 @@ def _fwd_kernel(
     k_lo = k_offset + ik * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(dot_dtype)
+        k = k_ref[0, 0].astype(dot_dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -146,9 +146,10 @@ def _fwd_kernel(
             p = jnp.where(s > 0.5 * _NEG_BIG, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + p.sum(axis=-1)
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(dot_dtype)
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(dot_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         acc[:] = acc[:] * alpha[:, None] + pv
         m_scr[:, 0] = m_new
@@ -173,7 +174,7 @@ def _fwd_kernel(
 def _fwd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, causal: bool, scale: float, q_offset: int, k_offset: int,
-    block_q: int, block_k: int, interpret: bool,
+    block_q: int, block_k: int, interpret: bool, bf16_dots: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     b, lq, h, d = q.shape
     lk = k.shape[1]
@@ -189,6 +190,7 @@ def _fwd(
         _fwd_kernel, scale=scale, causal=causal,
         q_offset=q_offset, k_offset=k_offset,
         block_q=bq, block_k=bk, nk=nk,
+        dot_dtype=jnp.bfloat16 if bf16_dots else jnp.float32,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -227,7 +229,7 @@ def _fwd(
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
     *, scale: float, causal: bool, q_offset: int, k_offset: int,
-    block_q: int, block_k: int, nk: int,
+    block_q: int, block_k: int, nk: int, dot_dtype,
 ):
     ik = pl.program_id(3)
 
@@ -240,10 +242,10 @@ def _bwd_dq_kernel(
     k_lo = k_offset + ik * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(dot_dtype)
+        k = k_ref[0, 0].astype(dot_dtype)
+        v = v_ref[0, 0].astype(dot_dtype)
+        do = do_ref[0, 0].astype(dot_dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -259,7 +261,8 @@ def _bwd_dq_kernel(
         )
         ds = p * (dp - delta_ref[0, 0]) * scale
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(dot_dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -276,7 +279,7 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, scale: float, causal: bool, q_offset: int, k_offset: int,
-    block_q: int, block_k: int, nq: int,
+    block_q: int, block_k: int, nq: int, dot_dtype,
 ):
     iq = pl.program_id(3)
 
@@ -290,10 +293,10 @@ def _bwd_dkv_kernel(
     k_lo = k_offset + ik * block_k
 
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(dot_dtype)
+        k = k_ref[0, 0].astype(dot_dtype)
+        v = v_ref[0, 0].astype(dot_dtype)
+        do = do_ref[0, 0].astype(dot_dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -305,14 +308,16 @@ def _bwd_dkv_kernel(
         if causal:
             p = jnp.where(s > 0.5 * _NEG_BIG, p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(dot_dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_ref[0, 0]) * scale  # [bq, bk]
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(dot_dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
         )
 
     if causal:
@@ -329,8 +334,9 @@ def _bwd_dkv_kernel(
 def _bwd(
     q, k, v, out, lse, do,
     *, causal: bool, scale: float, q_offset: int, k_offset: int,
-    block_q: int, block_k: int, interpret: bool,
+    block_q: int, block_k: int, interpret: bool, bf16_dots: bool = False,
 ):
+    dot_dtype = jnp.bfloat16 if bf16_dots else jnp.float32
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk = _block_sizes(lq, lk, block_q, block_k)
@@ -353,6 +359,7 @@ def _bwd(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
             q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nk=nk,
+            dot_dtype=dot_dtype,
         ),
         grid=(b, h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -370,6 +377,7 @@ def _bwd(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             q_offset=q_offset, k_offset=k_offset, block_q=bq, block_k=bk, nq=nq,
+            dot_dtype=dot_dtype,
         ),
         grid=(b, h, nk, nq),
         in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
@@ -398,30 +406,33 @@ def _bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret):
+def _flash(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+           bf16_dots):
     out, _ = _fwd(
         q, k, v, causal=causal, scale=scale, q_offset=q_offset, k_offset=k_offset,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, bf16_dots=bf16_dots,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+               bf16_dots):
     out, lse = _fwd(
         q, k, v, causal=causal, scale=scale, q_offset=q_offset, k_offset=k_offset,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, bf16_dots=bf16_dots,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, scale, q_offset, k_offset, block_q, block_k, interpret,
+               bf16_dots, res, do):
     q, k, v, out, lse = res
     return _bwd(
         q, k, v, out, lse, do,
         causal=causal, scale=scale, q_offset=q_offset, k_offset=k_offset,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, bf16_dots=bf16_dots,
     )
 
 
@@ -440,6 +451,7 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    bf16_dots: Optional[bool] = None,
 ) -> jax.Array:
     """Fused attention. q: [b, lq, h, d]; k/v: [b, lk, h, d] -> [b, lq, h, d].
 
@@ -466,9 +478,13 @@ def flash_attention(
             f"({q.shape[1]}, {k.shape[1]}) have no TPU-tileable divisor — "
             "pad the sequence or pass explicit block_q/block_k"
         )
+    if bf16_dots is None:
+        import os
+
+        bf16_dots = os.environ.get("FLASH_BF16_DOTS") == "1"
     return _flash(
         q, k, v, causal, scale, int(q_offset), int(k_offset),
-        bq, bk, interpret,
+        bq, bk, interpret, bool(bf16_dots),
     )
 
 
