@@ -224,3 +224,60 @@ class TestAutoTiling:
         q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 192, 192, 2, 32)
         with pytest.raises(ValueError, match="divide"):
             flash_attention(q, k, v, block_q=128, block_k=128)
+
+
+class TestFusedBottleneck:
+    """Parity of the fused bottleneck kernel (ops/fused_bottleneck.py)
+    against the XLA composite of the same math. The kernel exists as the
+    measured answer to VERDICT r4 #1 — see e2e/fused_bottleneck_probe.py
+    and BASELINE.md round 5 for the on-chip verdict (refuted: Pallas HBM
+    streaming on this backend runs at ~0.5x XLA's rate, cancelling the
+    fusion's 1.9x traffic saving)."""
+
+    def test_parity_vs_xla_composite(self):
+        import numpy as np
+
+        from kubeflow_tpu.ops.fused_bottleneck import (
+            fused_bottleneck, reference_bottleneck,
+        )
+
+        rng = np.random.RandomState(0)
+        n, hw, cin, cmid = 2, 16, 256, 64
+        x = jnp.asarray(rng.randn(n, hw, hw, cin), jnp.bfloat16) * 0.3
+        w1 = jnp.asarray(rng.randn(cin, cmid) * 0.05, jnp.float32)
+        w2 = jnp.asarray(rng.randn(3, 3, cmid, cmid) * 0.05, jnp.float32)
+        w3 = jnp.asarray(rng.randn(cmid, cin) * 0.05, jnp.float32)
+        s1, b1 = jnp.ones(cmid), jnp.zeros(cmid) + 0.01
+        s2, b2 = jnp.ones(cmid) * 1.1, jnp.zeros(cmid) - 0.01
+        s3, b3 = jnp.ones(cin) * 0.9, jnp.zeros(cin)
+        got = np.asarray(
+            fused_bottleneck(x, w1, s1, b1, w2, s2, b2, w3, s3, b3),
+            np.float32)
+        want = np.asarray(
+            reference_bottleneck(x, w1, s1, b1, w2, s2, b2, w3, s3, b3),
+            np.float32)
+        err = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+        assert err < 2e-2, f"fused bottleneck diverges: rel err {err}"
+
+    def test_relu_and_residual_active(self):
+        """The kernel's epilogue really applies residual+relu (zeros with a
+        negative bias everywhere except where the residual wins)."""
+        import numpy as np
+
+        from kubeflow_tpu.ops.fused_bottleneck import fused_bottleneck
+
+        n, hw, cin, cmid = 1, 8, 256, 64
+        x = jnp.ones((n, hw, hw, cin), jnp.bfloat16)
+        w1 = jnp.zeros((cin, cmid))
+        w2 = jnp.zeros((3, 3, cmid, cmid))
+        w3 = jnp.zeros((cmid, cin))
+        zero = jnp.zeros(cmid)
+        out = fused_bottleneck(
+            x, w1, jnp.ones(cmid), zero, w2, jnp.ones(cmid), zero,
+            w3, jnp.ones(cin), jnp.full((cin,), -3.0))
+        # y = relu(x + (-3)) = 0 ; with bias +3: relu(1+3) = 4
+        assert np.allclose(np.asarray(out, np.float32), 0.0)
+        out2 = fused_bottleneck(
+            x, w1, jnp.ones(cmid), zero, w2, jnp.ones(cmid), zero,
+            w3, jnp.ones(cin), jnp.full((cin,), 3.0))
+        assert np.allclose(np.asarray(out2, np.float32), 4.0)
