@@ -128,7 +128,8 @@ def bench_gpt_decode(batches=(1, 8), prompt_len: int = 128,
 
 
 def bench_continuous(slots: int = 8, n_requests: int = 16,
-                     prompt_len: int = 128) -> Dict[str, Any]:
+                     prompt_len: int = 128, chunk: int = 16,
+                     pipeline: int = 3) -> Dict[str, Any]:
     """Mixed-budget decode workload: continuous batching vs the static
     batch path on the SAME requests (VERDICT r3 #8).
 
@@ -173,12 +174,14 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     static_s = time.perf_counter() - t0
 
     # -- continuous path: same requests through the slot engine ------------
-    eng = ContinuousBatcher(cfg, params, slots=slots)
+    eng = ContinuousBatcher(cfg, params, slots=slots, chunk=chunk,
+                            pipeline=pipeline)
     try:
-        # warm the engine's three programs (prefill/adopt/chunk-step) the
-        # same way the static path's generate() programs are warmed above —
-        # compiles must not sit inside the timed window
-        eng.submit(prompts[0], 2).result(timeout=1800)
+        # warm the engine's programs (per-group-size prefill, adopt, and
+        # the chunked step) the same way the static path's generate()
+        # programs are warmed above — compiles must not sit inside the
+        # timed window
+        eng.prewarm(prompt_len)
         t0 = time.perf_counter()
         futs = [eng.submit(prompts[i], budgets[i]) for i in range(n_requests)]
         for f in futs:

@@ -64,6 +64,20 @@ class GptConfig:
         return cls(d_model=1024, n_layers=24, n_heads=16, d_ff=4096)  # ~GPT-2 medium
 
 
+def _kv_kernel_enabled() -> bool:
+    """``KUBEFLOW_TPU_KV_KERNEL=1`` routes per-slot KV writes through the
+    Pallas row-update kernel (ops/kv_cache.py); default is the whole-cache
+    where-select. Measured on the round-5 dev backend
+    (e2e/kv_update_probe.py): the two are within noise in-model (3.58 vs
+    3.66 ms/token at depth-3 pipelining) because the dispatch round trip,
+    not the on-device write, dominates — the kernel's 44x cache-traffic
+    saving is kept opt-in for direct-attached deployments where HBM
+    traffic is the decode bound."""
+    import os
+
+    return os.environ.get("KUBEFLOW_TPU_KV_KERNEL", "0") == "1"
+
+
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding. x: [b, L, heads, head_dim]; positions: [L] (shared
     across the batch) or [b, L] (per-row — continuous batching, where each
@@ -161,14 +175,25 @@ class GptAttention(nn.Module):
             k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
             v = dense(name="value")(x)
             if seg_len == 1:
-                # broadcast-select instead of vmapped dynamic_update_slice:
-                # the vmap form lowers to a scatter (measured ~3x slower
-                # per decode step); a where over the cache fuses into one
-                # elementwise pass
-                at = (jnp.arange(cfg.max_seq)[None, :, None, None]
-                      == start[:, None, None, None])                # [b,max,1,1]
-                keys = jnp.where(at, k, cache_k.value)
-                values = jnp.where(at, v, cache_v.value)
+                if _kv_kernel_enabled():
+                    # Pallas row-update kernel: touches ONE [1,8,h,d] tile
+                    # per row instead of a full-cache pass per layer
+                    # (ops/kv_cache.py; the where-select below reads+writes
+                    # the whole [b,max,h,d] cache every layer — round-4's
+                    # measured 8.2 vs 3.3 ms/step gap)
+                    from ..ops.kv_cache import kv_row_update
+
+                    keys = kv_row_update(cache_k.value, k[:, 0], start)
+                    values = kv_row_update(cache_v.value, v[:, 0], start)
+                else:
+                    # broadcast-select instead of vmapped dynamic_update_slice:
+                    # the vmap form lowers to a scatter (measured ~3x slower
+                    # per decode step); a where over the cache fuses into one
+                    # elementwise pass
+                    at = (jnp.arange(cfg.max_seq)[None, :, None, None]
+                          == start[:, None, None, None])            # [b,max,1,1]
+                    keys = jnp.where(at, k, cache_k.value)
+                    values = jnp.where(at, v, cache_v.value)
             else:
                 upd = jax.vmap(
                     lambda cache_row, seg, s: jax.lax.dynamic_update_slice(

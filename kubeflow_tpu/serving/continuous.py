@@ -10,12 +10,19 @@ slot-based admission — vLLM-style scheduling expressed the TPU way:
 - the shared KV cache keeps a cursor PER ROW (models/gpt.py
   ``per_slot=True``), so rows are independent sequences at independent
   positions,
-- a new request prefills into a free slot between steps (per-bucket
-  prefill programs on a [1, P] cache, rows adopted into the big cache with
-  one jitted splice) while other slots keep decoding,
-- finished slots (budget reached / EOS) free immediately and the next
-  queued request takes the row — no drain barrier, no padding to the
-  longest request.
+- new requests admit in WAVES: arrivals coalesce, each same-prompt-bucket
+  group (chunked to at most ``min(slots, MAX_GROUP)`` rows) runs ONE
+  batched prefill padded to that fixed size and ONE multi-row adopt
+  splice — no host round trip on the admission path (first tokens are
+  fetched lazily as pipelined events),
+- finished slots (budget reached / EOS) free at event-processing time and
+  the next queued request takes the row — no drain barrier, no padding to
+  the longest request,
+- chunk dispatches overlap (bounded ``pipeline`` depth) so the backend's
+  ~115 ms dispatch+fetch round trip hides behind decode compute — the
+  round-5 change that took the engine from 0.32x to 0.9-1.1x the offline
+  static oracle's tokens/s at strictly lower mean latency (BASELINE.md
+  round-5 serving section; e2e/kv_update_probe.py for the cost model).
 
 Throughput model: mixed arrivals with budgets b_i on S slots cost
 ~max-ish(sum b_i / S) steps here vs sum-of-group-max for the static
@@ -25,12 +32,13 @@ workload; BASELINE.md records the numbers.
 
 from __future__ import annotations
 
+import collections
 import functools
 import os
 import queue
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +49,14 @@ from ..runtime.metrics import METRICS
 
 #: prompt-length buckets — one prefill compilation each (static shapes)
 PREFILL_BUCKETS = (16, 32, 64, 128, 256)
+
+#: ceiling on one batched prefill's rows: every admission group is padded
+#: to ``min(slots, MAX_GROUP)`` (ONE prefill program + ONE reusable zero
+#: template per prompt bucket; larger waves are chunked). Padding a
+#: 1-request group to 8 rows costs only hidden prefill compute — the
+#: round-5 cost model says dispatch round trips, not prompt flops, bound
+#: admission.
+MAX_GROUP = 8
 
 
 def _bucket_for(n: int) -> int:
@@ -81,19 +97,37 @@ class ContinuousBatcher:
     ``chunk`` = decode steps per dispatch: each engine iteration runs a
     jitted ``lax.scan`` of that many single-token steps and fetches the
     [slots, chunk] token block once. chunk=1 is purest continuous batching
-    but pays one dispatch + host round-trip PER TOKEN — measured 3x slower
-    than the static path on this repo's tunneled backend. Chunking
-    amortizes dispatch like the training benches amortize scan overhead;
-    admission/retirement happen at chunk boundaries (a slot finishing
-    mid-chunk discards its tail tokens — the cache stays correct because
-    adoption resets the row cursor).
+    but pays one dispatch + host round-trip PER TOKEN. Chunking amortizes
+    dispatch like the training benches amortize scan overhead; admission/
+    retirement happen at chunk boundaries (a slot finishing mid-chunk
+    discards its tail tokens — the cache stays correct because adoption
+    resets the row cursor).
+
+    ``pipeline`` = chunk dispatches kept in flight. The round-5 probes
+    (e2e/kv_update_probe.py) measured this backend's real cost model: a
+    dispatch+fetch ROUND TRIP costs ~115 ms fixed while the marginal
+    decode compute is ~2-3 ms/token — and a deep dispatch queue (10+
+    outstanding) degrades ~4x. So the engine keeps a bounded event
+    pipeline: chunks are dispatched asynchronously (token blocks fetched
+    via ``copy_to_host_async``), and retirement/admission decisions lag
+    ``pipeline`` chunks behind the dispatch frontier. Measured at depth 3:
+    51.6 ms/chunk vs 146 unpipelined — the RTT fully hidden behind
+    compute. Lagged decisions are safe because inactive rows cost nothing
+    (the batch shape is fixed; a retired row's tail tokens are discarded
+    against the dispatch-time snapshot) and adoptions join the donated
+    cache chain in dispatch order.
     """
 
-    def __init__(self, cfg: GptConfig, params: Any, slots: int = 8, chunk: int = 16):
+    def __init__(self, cfg: GptConfig, params: Any, slots: int = 8,
+                 chunk: int = 16, pipeline: int = 3):
         self.cfg = cfg
         self.params = params
         self.slots = slots
         self.chunk = max(1, int(chunk))
+        self.pipeline = max(1, int(pipeline))
+        # fixed admission-group pad: one prefill program + one zero
+        # template per prompt bucket; waves larger than this are chunked
+        self._group_pad = min(slots, MAX_GROUP)
         self.model = GptLM(cfg, decode=True, per_slot=True)
         self._prefill_model = GptLM(cfg, decode=True)  # [1, P], scalar cursor
         self.cache = self._fresh_cache()
@@ -107,14 +141,23 @@ class ContinuousBatcher:
         # split (not fold_in) for the initial keys so they can never collide
         # with the admission counter's fold_in stream
         self.rngs = jax.random.split(self._base_rng, slots)
-        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # queue items are WAVES (lists of requests enqueued atomically) so a
+        # caller can hand the worker a group it should admit together;
+        # submit() enqueues singleton waves. None is the shutdown sentinel.
+        self._queue: "queue.Queue[Optional[List[_Request]]]" = queue.Queue()
+        self._pending: "collections.deque[_Request]" = collections.deque()
         self._active: Dict[int, _Request] = {}
         self._free = list(range(slots))
         self._lock = threading.Lock()
         self._closed = False
         self._step_fn = self._build_step()
         self._adopt_fn = self._build_adopt()
-        self._prefill_fns: Dict[int, Any] = {}
+        self._prefill_fns: Dict[Tuple[int, int], Any] = {}
+        # reusable zero prefill-cache per group bucket: prefill does NOT
+        # donate its cache input, so one template serves every admission —
+        # without it each wave re-allocates 2*n_layers zero buffers on the
+        # device (measured as dispatch-stream noise on the tunnel)
+        self._zero_small: Dict[int, Any] = {}
         self._worker = threading.Thread(target=self._loop, name="continuous-batcher",
                                         daemon=True)
         self._worker.start()
@@ -163,60 +206,96 @@ class ContinuousBatcher:
         return step
 
     def _build_adopt(self):
-        @functools.partial(jax.jit, donate_argnums=(0, 5, 6, 7))
-        def adopt(cache, small, slot, true_len, first_tok, last_tok,
-                  temps, rngs, temperature, slot_rng):
-            """Splice a [1, max_seq] prefill cache into row ``slot`` and
-            reset that row's cursor to the TRUE prompt length (bucket
-            padding beyond it stays invisible and is overwritten by the
-            next decode steps). Also installs the slot's sampling state."""
+        @functools.partial(jax.jit, donate_argnums=(0, 4, 5, 6))
+        def adopt(cache, small, slots, true_lens, last_tok, temps, rngs,
+                  first_toks, temperatures, slot_rngs):
+            """Splice prefill-cache rows ``0..n-1`` of ``small`` (padded to
+            a group bucket — padding rows beyond n are ignored) into cache
+            rows ``slots[0..n-1]`` and reset those cursors to the TRUE
+            prompt lengths (bucket padding beyond them stays invisible and
+            is overwritten by the next decode steps). Also installs each
+            slot's sampling state. The group size n rides the arg shapes
+            (jit retraces per size); the per-row dynamic_update_slice chain
+            stays in place under donation — no full-cache pass."""
+            n = slots.shape[0]
             out = {}
             for name, layer in cache.items():
                 att, small_att = layer["attention"], small[name]["attention"]
-                k = jax.lax.dynamic_update_slice(att["k"], small_att["k"], (slot, 0, 0, 0))
-                v = jax.lax.dynamic_update_slice(att["v"], small_att["v"], (slot, 0, 0, 0))
-                cursors = att["cursors"].at[slot].set(true_len)
+                k, v = att["k"], att["v"]
+                for i in range(n):
+                    k = jax.lax.dynamic_update_slice(
+                        k, small_att["k"][i:i + 1], (slots[i], 0, 0, 0))
+                    v = jax.lax.dynamic_update_slice(
+                        v, small_att["v"][i:i + 1], (slots[i], 0, 0, 0))
+                cursors = att["cursors"].at[slots].set(true_lens)
                 out[name] = {"attention": {"k": k, "v": v, "cursors": cursors}}
-            return (out, last_tok.at[slot].set(first_tok),
-                    temps.at[slot].set(temperature),
-                    rngs.at[slot].set(slot_rng))
+            return (out, last_tok.at[slots].set(first_toks),
+                    temps.at[slots].set(temperatures),
+                    rngs.at[slots].set(slot_rngs))
 
         return adopt
 
-    def _prefill(self, prompt: np.ndarray, temperature: float, key) -> Any:
-        bucket = _bucket_for(len(prompt))
-        if bucket not in self._prefill_fns:
+    def _prefill_group(self, prompts: Sequence[np.ndarray],
+                       temperatures: Sequence[float], keys) -> Tuple[Any, Any]:
+        """ONE batched prefill for a same-length-bucket admission group:
+        [n_pad, bucket] prompt forward on a reused zero [n_pad, max_seq]
+        cache (shared cursor 0 — every row starts at position 0), padded
+        to the engine's single fixed group size so every group reuses one
+        compilation and one template. Returns (small cache, first token
+        per row). Round 4 measured ~141 ms of mostly fixed dispatch cost
+        PER single-prompt admission; batching amortizes that over up to
+        ``n_pad`` arrivals."""
+        n = len(prompts)
+        bucket = _bucket_for(max(len(p) for p in prompts))
+        n_pad = self._group_pad
+        if n > n_pad:
+            raise ValueError(f"admission group of {n} exceeds pad {n_pad}")
+        if (bucket, n_pad) not in self._prefill_fns:
             model = self._prefill_model
 
             @jax.jit
-            def prefill(params, cache, ids, true_len, temperature, key):
+            def prefill(params, cache, ids, true_lens, temperatures, keys):
                 logits, updated = model.apply(
                     {"params": params, "cache": cache}, ids, mutable=["cache"]
                 )
-                # first generated token comes from the TRUE last prompt
-                # position, not the padded bucket end
-                lg = logits[0, true_len - 1]
+                # each row's first generated token comes from ITS true last
+                # prompt position, not the padded bucket end
+                lg = jnp.take_along_axis(
+                    logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
                 greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-                sampled = jax.random.categorical(
-                    key, lg / jnp.maximum(temperature, 1e-6)).astype(jnp.int32)
-                first = jnp.where(temperature > 0.0, sampled, greedy)
+                sampled = jax.vmap(
+                    lambda k_, l, t: jax.random.categorical(
+                        k_, l / jnp.maximum(t, 1e-6))
+                )(keys, lg, temperatures).astype(jnp.int32)
+                first = jnp.where(temperatures > 0.0, sampled, greedy)
                 return updated["cache"], first
 
-            self._prefill_fns[bucket] = prefill
+            self._prefill_fns[(bucket, n_pad)] = prefill
         cfg = self.cfg
-        kv = (1, cfg.max_seq, cfg.n_heads, cfg.head_dim)
-        small = {
-            f"block_{i}": {"attention": {
-                "k": jnp.zeros(kv, cfg.dtype),
-                "v": jnp.zeros(kv, cfg.dtype),
-                "cursor": jnp.zeros((), jnp.int32),
-            }}
-            for i in range(cfg.n_layers)
-        }
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(prompt)] = prompt
-        return self._prefill_fns[bucket](self.params, small, jnp.asarray(padded),
-                                         len(prompt), jnp.float32(temperature), key)
+        if n_pad not in self._zero_small:
+            kv = (n_pad, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+            self._zero_small[n_pad] = {
+                f"block_{i}": {"attention": {
+                    "k": jnp.zeros(kv, cfg.dtype),
+                    "v": jnp.zeros(kv, cfg.dtype),
+                    "cursor": jnp.zeros((), jnp.int32),
+                }}
+                for i in range(cfg.n_layers)
+            }
+        small = self._zero_small[n_pad]
+        ids = np.zeros((n_pad, bucket), np.int32)
+        true_lens = np.ones((n_pad,), np.int32)
+        temps = np.zeros((n_pad,), np.float32)
+        for i, p in enumerate(prompts):
+            ids[i, : len(p)] = p
+            true_lens[i] = len(p)
+            temps[i] = temperatures[i]
+        if keys.shape[0] != n_pad:  # pad the key rows (unused rows ignored)
+            keys = jnp.concatenate(
+                [keys, jnp.zeros((n_pad - n, 2), keys.dtype)], axis=0)
+        return self._prefill_fns[(bucket, n_pad)](
+            self.params, small, jnp.asarray(ids), jnp.asarray(true_lens),
+            jnp.asarray(temps), keys)
 
     # -- public API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -233,8 +312,38 @@ class ContinuousBatcher:
         with self._lock:
             if self._closed:
                 raise RuntimeError("batcher closed")
-            self._queue.put(req)
+            self._queue.put([req])
         return req
+
+    def prewarm(self, prompt_len: int,
+                group_sizes: Optional[Sequence[int]] = None) -> None:
+        """Compile the engine's programs outside any latency-sensitive
+        window: for each admission-group size, a wave of dummy requests is
+        pushed as ONE queue item so the worker admits them together —
+        exercising the (prompt-bucket, group-bucket) prefill, the exact-n
+        adopt, and (for the largest wave) the chunked decode step, all
+        through the production path. Compilations land in the persistent
+        JAX cache when one is configured."""
+        # default: EVERY group size 1.._group_pad — the adopt program is
+        # traced per exact group size (admission chunks larger waves to
+        # _group_pad), so a size first seen mid-run would compile inside
+        # somebody's latency window
+        sizes = sorted({min(s, self._group_pad) for s in
+                        (group_sizes if group_sizes is not None
+                         else range(1, self._group_pad + 1))})
+        for idx, n in enumerate(sizes):
+            # waves run SEQUENTIALLY (each fully retired before the next is
+            # enqueued) so the worker sees exactly one n-sized admission —
+            # concurrent waves would coalesce in the pending queue
+            budget = self.chunk + 1 if idx == len(sizes) - 1 else 1
+            wave = [_Request(np.zeros((prompt_len,), np.int32), budget)
+                    for _ in range(n)]
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("batcher closed")
+                self._queue.put(wave)
+            for req in wave:
+                req.result(timeout=1800)
 
     def close(self) -> None:
         with self._lock:
@@ -243,33 +352,67 @@ class ContinuousBatcher:
         self._worker.join(timeout=30)
 
     # -- engine loop ---------------------------------------------------------
-    def _admit(self, req: _Request) -> None:
-        # fresh sampling key per admission (distinct stream per request)
-        self._rng_counter += 1
-        slot_rng = jax.random.fold_in(self._base_rng, self._rng_counter)
-        # prefill BEFORE taking the slot: a failing prefill (e.g. prompt
-        # outside every bucket) must fail only this request, not leak a slot
-        small, first = self._prefill(req.prompt, req.temperature, slot_rng)
-        slot = self._free.pop()
-        # drop the scalar cursor — adopt() resets the row cursor itself
-        small = {n: {"attention": {"k": l["attention"]["k"], "v": l["attention"]["v"]}}
-                 for n, l in small.items()}
-        self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
-            self.cache, small, slot, len(req.prompt), first, self.last_tok,
-            self.temps, self.rngs, jnp.float32(req.temperature),
-            jax.random.fold_in(slot_rng, 1))
-        req.tokens.append(int(first))
-        hit_eos = req.eos_id is not None and req.tokens[-1] == req.eos_id
-        if req.max_new_tokens <= 1 or hit_eos:
-            import time
-
-            self._free.append(slot)
-            req.done_at = time.perf_counter()
-            req.done.set()
-            METRICS.counter("serving_continuous_requests_total").inc()
-            return
-        self._active[slot] = req
+    def _admit_wave(self, reqs: List[_Request]) -> List[Tuple[str, Any, Any]]:
+        """Admit up to ``len(self._free)`` requests together: one batched
+        prefill + one adopt per same-prompt-bucket group instead of the
+        round-4 per-request dispatch chain (~141 ms each). Fully async —
+        the first tokens stay on device (the adopt consumes them there) and
+        are fetched lazily via the returned ``('first', toks, pairs)``
+        events, so an admission adds NO host round trip to the dispatch
+        chain."""
+        events: List[Tuple[str, Any, Any]] = []
+        by_bucket: Dict[int, List[Tuple[_Request, Any]]] = {}
+        for req in reqs:
+            # fresh sampling key per admission (distinct stream per request)
+            self._rng_counter += 1
+            key = jax.random.fold_in(self._base_rng, self._rng_counter)
+            try:
+                bucket = _bucket_for(len(req.prompt))
+            except Exception as e:  # bad request fails alone, takes no slot
+                req.error = e
+                req.done.set()
+                continue
+            by_bucket.setdefault(bucket, []).append((req, key))
+        groups = [chunk[i:i + self._group_pad]
+                  for chunk in by_bucket.values()
+                  for i in range(0, len(chunk), self._group_pad)]
+        for group in groups:
+            try:
+                keys = jnp.stack([k for _, k in group])
+                small, first = self._prefill_group(
+                    [r.prompt for r, _ in group],
+                    [r.temperature for r, _ in group], keys)
+            except Exception as e:  # whole-group failure takes no slots
+                for req, _ in group:
+                    req.error = e
+                    req.done.set()
+                continue
+            n = len(group)
+            slots = [self._free.pop() for _ in range(n)]
+            # drop the scalar cursor — adopt() resets the row cursors itself
+            small = {nm: {"attention": {"k": l["attention"]["k"],
+                                        "v": l["attention"]["v"]}}
+                     for nm, l in small.items()}
+            first_n = first[:n]
+            self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
+                self.cache, small, jnp.asarray(slots, dtype=jnp.int32),
+                jnp.asarray([len(r.prompt) for r, _ in group], dtype=jnp.int32),
+                self.last_tok, self.temps, self.rngs, first_n,
+                jnp.asarray([r.temperature for r, _ in group],
+                            dtype=jnp.float32),
+                jnp.stack([jax.random.fold_in(k, 1) for _, k in group]))
+            try:
+                first_n.copy_to_host_async()
+            except Exception:
+                pass
+            # activate NOW (before the first-token value is on host): the
+            # next chunk dispatch must include these rows in its snapshot
+            for (req, _), slot in zip(group, slots):
+                self._active[slot] = req
+            events.append(("first", first_n,
+                           [(req, slot) for (req, _), slot in zip(group, slots)]))
         METRICS.gauge("serving_continuous_active_slots").set(len(self._active))
+        return events
 
     def _retire(self, slot: int) -> None:
         import time
@@ -281,69 +424,114 @@ class ContinuousBatcher:
         METRICS.counter("serving_continuous_requests_total").inc()
         METRICS.gauge("serving_continuous_active_slots").set(len(self._active))
 
-    def _loop(self) -> None:
+    def _shutdown(self, cause: str) -> None:
+        """Fail everything in flight, pending, and still queued — all with
+        the SAME cause, so a device failure is debuggable from any failed
+        caller, not only the in-flight ones."""
+        for req in self._active.values():
+            req.error = RuntimeError(cause)
+            req.done.set()
+        self._active.clear()
+        while self._pending:
+            req = self._pending.popleft()
+            req.error = RuntimeError(cause)
+            req.done.set()
         while True:
-            # admit as many queued requests as there are free slots; block
-            # when fully idle (no busy-wait)
             try:
-                timeout = None if not self._active else 0.0
-                while self._free:
+                rest = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if rest is not None:
+                for req in rest:
+                    req.error = RuntimeError(cause)
+                    req.done.set()
+
+    def _process_event(self, event: Tuple[str, Any, Any]) -> None:
+        """Consume one pipelined event in dispatch order. ``first``: fetch
+        an admission group's first tokens (appended before any of that
+        request's chunk tokens — FIFO order guarantees it). ``chunk``:
+        fetch a token block and retire against the DISPATCH-TIME snapshot —
+        a row whose request finished in an earlier event is a discarded
+        tail; a row adopted after the dispatch is not in the snapshot."""
+        kind, dev, meta = event
+        block = np.asarray(dev)  # host fetch (async copy started at dispatch)
+        if kind == "first":
+            for (req, slot), tok in zip(meta, block):
+                req.tokens.append(int(tok))
+                hit_eos = req.eos_id is not None and req.tokens[-1] == req.eos_id
+                if req.max_new_tokens <= 1 or hit_eos:
+                    # the slot was activated at admission, so the normal
+                    # retirement path applies
+                    self._retire(slot)
+            return
+        for slot, req in meta.items():
+            if req.done.is_set():
+                continue  # retired in an earlier event; tail tokens discard
+            for j in range(block.shape[1]):
+                tok = int(block[slot, j])
+                req.tokens.append(tok)
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                if len(req.tokens) >= req.max_new_tokens or hit_eos:
+                    self._retire(slot)
+                    break
+
+    def _loop(self) -> None:
+        events: "collections.deque[Tuple[str, Any, Any]]" = collections.deque()
+
+        def chunk_depth() -> int:
+            return sum(1 for kind, _, _ in events if kind == "chunk")
+
+        while True:
+            # drain arrivals into the pending deque; block only when fully
+            # idle (no busy-wait). Coalescing the drain is what lets a burst
+            # of single submits admit as ONE batched prefill.
+            try:
+                timeout = (None if not (self._active or self._pending
+                                        or events) else 0.0)
+                while True:
                     item = self._queue.get(timeout=timeout) if timeout is None \
                         else self._queue.get_nowait()
                     if item is None:
-                        for req in self._active.values():
-                            req.error = RuntimeError("batcher closed mid-flight")
-                            req.done.set()
-                        while True:  # fail anything still queued behind us
-                            try:
-                                rest = self._queue.get_nowait()
-                            except queue.Empty:
-                                return
-                            if rest is not None:
-                                rest.error = RuntimeError("batcher closed")
-                                rest.done.set()
-                    try:
-                        self._admit(item)
-                    except Exception as e:  # bad request fails alone
-                        item.error = e
-                        item.done.set()
+                        self._shutdown("batcher closed mid-flight")
+                        return
+                    self._pending.extend(item)
                     timeout = 0.0
             except queue.Empty:
                 pass
-            if not self._active:
-                continue
-            # one CHUNK of decode steps for every slot (inactive rows
-            # compute too — static shapes are the TPU contract; their
-            # outputs are ignored, and a retiring row's tail tokens are
-            # discarded below)
             try:
-                self.cache, self.last_tok, self.rngs, toks = self._step_fn(
-                    self.params, self.cache, self.last_tok, self.temps, self.rngs)
-                toks = np.asarray(toks)  # host fetch = chunk barrier
+                dispatched = False
+                if self._free and self._pending:
+                    wave = [self._pending.popleft()
+                            for _ in range(min(len(self._free),
+                                               len(self._pending)))]
+                    events.extend(self._admit_wave(wave))
+                    dispatched = True
+                if self._active:
+                    # one CHUNK of decode steps for every slot (inactive
+                    # rows compute too — static shapes are the TPU
+                    # contract; their outputs are discarded when processed
+                    # against the snapshot)
+                    self.cache, self.last_tok, self.rngs, toks = self._step_fn(
+                        self.params, self.cache, self.last_tok, self.temps,
+                        self.rngs)
+                    try:
+                        toks.copy_to_host_async()
+                    except Exception:
+                        pass
+                    events.append(("chunk", toks, dict(self._active)))
+                    dispatched = True
+                # keep the dispatch frontier at most ``pipeline`` chunks
+                # ahead of the processed state; when nothing new could be
+                # dispatched, drain one event so the pipeline empties
+                while chunk_depth() > self.pipeline:
+                    self._process_event(events.popleft())
+                if not dispatched and events:
+                    self._process_event(events.popleft())
             except Exception as e:
                 # a device/RPC failure must not wedge the engine silently:
-                # fail everything in flight and queued, refuse new work
+                # fail everything in flight, pending, and queued; refuse
+                # new work
                 with self._lock:
                     self._closed = True
-                err = RuntimeError(f"decode step failed: {e}")
-                for req in self._active.values():
-                    req.error = err
-                    req.done.set()
-                self._active.clear()
-                while True:
-                    try:
-                        rest = self._queue.get_nowait()
-                    except queue.Empty:
-                        return
-                    if rest is not None:
-                        rest.error = err
-                        rest.done.set()
-            for slot in list(self._active):
-                req = self._active[slot]
-                for j in range(toks.shape[1]):
-                    tok = int(toks[slot, j])
-                    req.tokens.append(tok)
-                    hit_eos = req.eos_id is not None and tok == req.eos_id
-                    if len(req.tokens) >= req.max_new_tokens or hit_eos:
-                        self._retire(slot)
-                        break
+                self._shutdown(f"engine step failed: {e}")
+                return

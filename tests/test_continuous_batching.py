@@ -48,22 +48,31 @@ def test_sequences_join_and_leave_mid_flight(params):
     import threading
     import time
 
-    eng = ContinuousBatcher(CFG, params, slots=4)
+    # chunk=1/pipeline=1: one token per engine event, so the 100-token
+    # request spans ~100 loop iterations and the short one verifiably
+    # joins mid-flight even on a fast backend (a chunked engine can finish
+    # the whole long request between two 10ms polls of this test)
+    eng = ContinuousBatcher(CFG, params, slots=4, chunk=1, pipeline=1)
     order = []
     lock = threading.Lock()
 
-    def run(name, p, budget, delay):
-        time.sleep(delay)
-        f = eng.submit(p, budget)
-        f.result(timeout=180)
+    def run(name, fut):
+        fut.result(timeout=180)
         with lock:
             order.append(name)
 
     try:
-        threads = [
-            threading.Thread(target=run, args=("long", prompt(1, 8), 60, 0.0)),
-            threading.Thread(target=run, args=("short", prompt(2, 8), 3, 0.3)),
-        ]
+        f_long = eng.submit(prompt(1, 8), 100)
+        # admit the short request only once the long one has verifiably
+        # started producing tokens (event-based, not sleep-based: the
+        # pipelined engine can finish many chunks inside a fixed sleep)
+        deadline = time.time() + 120
+        while not f_long.tokens and time.time() < deadline:
+            time.sleep(0.01)
+        assert f_long.tokens, "long request never started"
+        f_short = eng.submit(prompt(2, 8), 3)
+        threads = [threading.Thread(target=run, args=("long", f_long)),
+                   threading.Thread(target=run, args=("short", f_short))]
         for t in threads:
             t.start()
         for t in threads:
@@ -206,3 +215,50 @@ def test_mixed_greedy_and_sampled_slots(params):
         assert all(0 <= t < CFG.vocab_size for seq in (t1, t2, t3) for t in seq)
     finally:
         eng.close()
+
+
+def test_slots_beyond_max_group_chunk_admission_waves(params):
+    """An admission wave larger than MAX_GROUP must chunk into several
+    prefill groups, not crash the whole wave (round-5 review finding:
+    slots=10 + 10 concurrent submits used to fail every request with an
+    IndexError from the padded prefill)."""
+    from kubeflow_tpu.serving.continuous import MAX_GROUP
+
+    slots = MAX_GROUP + 2
+    p = prompt(7, 9)
+    ref = np.asarray(generate(CFG, params, p[None, :],
+                              max_new_tokens=5))[0, len(p):].tolist()
+    eng = ContinuousBatcher(CFG, params, slots=slots)
+    try:
+        futs = [eng.submit(p, 5) for _ in range(slots)]
+        got = [f.result(timeout=300) for f in futs]
+    finally:
+        eng.close()
+    assert got == [ref] * slots
+
+
+def test_generative_model_long_prompt_falls_back_to_static(params):
+    """Prompts beyond the largest prefill bucket serve through the static
+    generate() path rather than 413ing — the continuous default must not
+    shrink the servable range below cfg.max_seq."""
+    from kubeflow_tpu.serving.continuous import PREFILL_BUCKETS
+    from kubeflow_tpu.serving.server import GenerativeModel
+
+    big_cfg = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                        max_seq=PREFILL_BUCKETS[-1] + 64, vocab_size=101)
+    rng = jax.random.PRNGKey(0)
+    big_params = GptLM(big_cfg).init(
+        rng, jax.random.randint(rng, (1, 8), 0, big_cfg.vocab_size))["params"]
+    model = GenerativeModel(name="g", apply_fn=None, params=big_params,
+                            cfg=big_cfg, max_new_tokens=4)
+    assert model.continuous
+    long_prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(5), (1, PREFILL_BUCKETS[-1] + 16), 0,
+        big_cfg.vocab_size))
+    try:
+        out = model.predict(long_prompt.tolist())
+        ref = np.asarray(generate(big_cfg, big_params, long_prompt,
+                                  max_new_tokens=4)).tolist()
+        assert out == ref
+    finally:
+        model.close()

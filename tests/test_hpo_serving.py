@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.builtin import make_tpu_node
 from kubeflow_tpu.controllers.studyjob import STUDY_API, InProcessTrialRunner
 from kubeflow_tpu.hpo.suggest import (
     BayesianSuggester,
@@ -311,6 +312,8 @@ class TestServing:
     def test_inference_service_controller(self):
         mgr = build_platform().start()
         try:
+            # strict scheduling: TPU pods need a node with matching capacity
+            mgr.client.create(make_tpu_node("tpu-node-0", "v5e", "2x2", 4))
             mgr.client.create(new_object(
                 SERVING_API, "InferenceService", "bert", "team-a",
                 spec={"model": "bert-base", "tpu": {"generation": "v5e", "topology": "2x2"}},
