@@ -344,26 +344,22 @@ class Page:
             self._init_memo = None
 
     def _init_all(self) -> None:
-        for n in self.doc.css("[data-kf-nav]"):
-            n.attrs["href"] = n.attrs["data-kf-nav"] + "?ns=" + self.ns
-        for n in self.doc.css("[data-kf-ns-select]"):
-            self._init_ns_select(n)
-        for n in self.doc.css("[data-kf-options]"):
-            self._init_options(n)
-        for n in self.doc.css("[data-kf-value]"):
-            self._init_value(n)
-        for n in self.doc.css("[data-kf-text]"):
-            self._init_text(n)
-        for n in self.doc.css("[data-kf-show-if]"):
-            self._init_show_if(n)
-        for n in self.doc.css("[data-kf-chart]"):
-            self._init_chart(n)
-        for n in self.doc.css("[data-kf-chart-line]"):
-            self._init_chart_line(n)
-        for n in self.doc.css("[data-kf-table]"):
-            self._init_table(n)
+        # dispatch order comes from kfspec.json's dispatch section — the
+        # SAME source the generated block in kfui.js is emitted from
+        # (python -m e2e.uidom --gen-dispatch), so the two runtimes cannot
+        # disagree about what initializes or in which order. binding=event
+        # entries (form/action) are wired at click()/submit() time here.
+        for entry in dispatch_table():
+            if entry.get("binding") != "init":
+                continue
+            handler = getattr(self, "_init_" + entry["handler"])
+            for n in self.doc.css(entry["selector"]):
+                handler(n)
 
     # -- components -----------------------------------------------------------
+    def _init_nav(self, n: Element) -> None:
+        n.attrs["href"] = n.attrs["data-kf-nav"] + "?ns=" + self.ns
+
     def _init_ns_select(self, sel: Element) -> None:
         try:
             data = self.api("GET", "/api/namespaces")
@@ -544,8 +540,10 @@ class Page:
 
     def _init_table(self, node: Element) -> None:
         url = node.attrs["data-kf-table"]
-        items_path = node.attrs.get("data-kf-items", ".")
-        empty_text = node.attrs.get("data-kf-empty", "none")
+        items_path = node.attrs.get("data-kf-items",
+                                    spec_defaults()["items_path"])
+        empty_text = node.attrs.get("data-kf-empty",
+                                    spec_defaults()["empty_text"])
         page_size = int(node.attrs.get("data-kf-page-size", "0"))
         template = node.one("template[data-kf-row]")
         tbodies = node.css("tbody")
@@ -953,11 +951,14 @@ class Poller:
     (exponential-backoff.ts semantics: double on failure, reset on
     success, capped at max)."""
 
-    def __init__(self, fn: Callable[[], None], interval: int, max_interval: int = 30000):
+    def __init__(self, fn: Callable[[], None], interval: int,
+                 max_interval: Optional[int] = None):
         self.fn = fn
-        self.base = interval
-        self.max = max_interval
-        self.interval = interval
+        # kf.poller semantics: a falsy interval/max takes the spec default
+        # (|| in the JS — so max_interval=0 must not disable the cap)
+        self.base = interval or spec_defaults()["poll_ms"]
+        self.max = max_interval or spec_defaults()["poll_max_ms"]
+        self.interval = self.base
 
     def tick(self) -> None:
         try:
@@ -975,8 +976,26 @@ SPEC_PATH = __import__("pathlib").Path(__file__).resolve().parent.parent / \
     "kubeflow_tpu" / "web" / "ui" / "kfspec.json"
 
 
+_SPEC_CACHE: Optional[Dict[str, Any]] = None
+
+
 def load_spec() -> Dict[str, Any]:
-    return json.loads(SPEC_PATH.read_text())
+    global _SPEC_CACHE
+    if _SPEC_CACHE is None:
+        _SPEC_CACHE = json.loads(SPEC_PATH.read_text())
+    return _SPEC_CACHE
+
+
+def dispatch_table() -> List[Dict[str, str]]:
+    """The init dispatch order both runtimes execute (kfspec.json
+    dispatch.init_order; kfui.js carries it as a generated block)."""
+    return load_spec()["dispatch"]["init_order"]
+
+
+def spec_defaults() -> Dict[str, Any]:
+    """Shared runtime defaults (poll interval/backoff cap, empty-state
+    text, items path, snack duration) — single-sourced from kfspec.json."""
+    return load_spec()["dispatch"]["defaults"]
 
 
 def file_sha256(path) -> str:
@@ -1095,10 +1114,62 @@ def sync_spec() -> None:
     print(f"lockstep hashes refreshed in {SPEC_PATH}")
 
 
+_GEN_BEGIN = ("  // BEGIN GENERATED (kfspec.json dispatch; "
+              "python -m e2e.uidom --gen-dispatch) — DO NOT EDIT")
+_GEN_END = "  // END GENERATED"
+
+
+def gen_dispatch_js() -> str:
+    """The kfui.js dispatch block emitted from kfspec.json: DEFAULTS,
+    DISPATCH, and the init loop. The JS runs EVERY entry at init (its
+    binding=event handlers wire listeners); uidom interprets the same
+    table, dispatching binding=event entries at click()/submit() time."""
+    d = load_spec()["dispatch"]
+    entries = ",\n".join(
+        "    " + json.dumps(e, separators=(", ", ": "))
+        for e in d["init_order"])
+    return "\n".join([
+        _GEN_BEGIN,
+        "  kf.DEFAULTS = " + json.dumps(d["defaults"],
+                                        separators=(", ", ": ")) + ";",
+        "  kf.DISPATCH = [",
+        entries + ",",
+        "  ];",
+        "  kf._initAll = async function (root) {",
+        "    for (const entry of kf.DISPATCH) {",
+        "      const handler = kf._handlers[entry.handler];",
+        "      for (const n of root.querySelectorAll(entry.selector)) "
+        "await handler(n);",
+        "    }",
+        "  };",
+        _GEN_END,
+    ])
+
+
+def gen_dispatch() -> bool:
+    """Rewrite kfui.js's generated block from the spec; True if changed.
+    (tests/test_kfui_spec.py fails when the on-disk block is stale.)"""
+    path = lockstep_files()["kfui.js"]
+    src = path.read_text()
+    begin = src.index("  // BEGIN GENERATED")
+    end = src.index(_GEN_END, begin) + len(_GEN_END)
+    new = src[:begin] + gen_dispatch_js() + src[end:]
+    if new == src:
+        return False
+    path.write_text(new)
+    return True
+
+
 if __name__ == "__main__":
     import sys as _sys
 
-    if "--sync-spec" in _sys.argv:
+    if "--gen-dispatch" in _sys.argv:
+        changed = gen_dispatch()
+        print(f"kfui.js dispatch block "
+              f"{'regenerated' if changed else 'already current'}")
+        if changed:
+            sync_spec()
+    elif "--sync-spec" in _sys.argv:
         sync_spec()
     else:
         spec = load_spec()

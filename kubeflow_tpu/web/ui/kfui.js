@@ -143,7 +143,7 @@
     bar.textContent = message;
     bar.className = "show " + (kind || "info");
     clearTimeout(bar._t);
-    bar._t = setTimeout(() => (bar.className = ""), 4000);
+    bar._t = setTimeout(() => (bar.className = ""), kf.DEFAULTS.snack_ms);
   };
 
   // ---- confirm dialog ------------------------------------------------------
@@ -168,8 +168,8 @@
 
   // ---- exponential backoff poller (exponential-backoff.ts semantics) -------
   kf.poller = function (fn, interval, maxInterval) {
-    const base = interval || 3000;
-    const max = maxInterval || 30000;
+    const base = interval || kf.DEFAULTS.poll_ms;
+    const max = maxInterval || kf.DEFAULTS.poll_max_ms;
     let cur = base;
     let timer = null;
     let stopped = false;
@@ -192,12 +192,12 @@
   // ---- component: resource table -------------------------------------------
   function initTable(node) {
     const url = node.getAttribute("data-kf-table");
-    const itemsPath = node.getAttribute("data-kf-items") || ".";
+    const itemsPath = node.getAttribute("data-kf-items") || kf.DEFAULTS.items_path;
     const pollMs = parseInt(node.getAttribute("data-kf-poll") || "0", 10);
     const pageSize = parseInt(node.getAttribute("data-kf-page-size") || "0", 10);
     // explicit data-kf-empty="" means "render nothing", only absence defaults
     const emptyText = node.hasAttribute("data-kf-empty")
-      ? node.getAttribute("data-kf-empty") : "none";
+      ? node.getAttribute("data-kf-empty") : kf.DEFAULTS.empty_text;
     const template = node.querySelector("template[data-kf-row]");
     const tbody = node.querySelector("tbody") || node;
     node._kfPage = 0;
@@ -695,13 +695,6 @@
     });
   }
 
-  function initNavLinks() {
-    for (const a of document.querySelectorAll("[data-kf-nav]")) {
-      const target = a.getAttribute("data-kf-nav");
-      a.setAttribute("href", target + "?ns=" + encodeURIComponent(kf.ns()));
-    }
-  }
-
   // ---- boot ----------------------------------------------------------------
   kf.init = async function (root) {
     root = root || document;
@@ -713,22 +706,53 @@
     }
   };
 
-  kf._initAll = async function (root) {
-    initNavLinks();
-    for (const n of root.querySelectorAll("[data-kf-ns-select]")) await initNsSelect(n);
-    for (const n of root.querySelectorAll("[data-kf-options]")) await initOptions(n);
-    for (const n of root.querySelectorAll("[data-kf-value]")) await initValue(n);
-    for (const n of root.querySelectorAll("[data-kf-text]")) await initText(n);
-    for (const n of root.querySelectorAll("[data-kf-show-if]")) await initShowIf(n);
-    for (const n of root.querySelectorAll("[data-kf-chart]")) await initChart(n);
-    for (const n of root.querySelectorAll("[data-kf-chart-line]")) await initChartLine(n);
-    for (const n of root.querySelectorAll("[data-kf-table]")) initTable(n);
-    for (const n of root.querySelectorAll("form[data-kf-form]")) initForm(n);
+  // Handler bodies are hand-written above; WHICH selectors initialize, in
+  // WHAT order, and with what defaults is owned by kfspec.json's dispatch
+  // section (the generated block below) — e2e/uidom.py builds its
+  // interpreter loop from the same section at runtime, so the two
+  // runtimes cannot disagree about dispatch.
+  kf._handlers = {
+    nav: async (a) => {
+      const target = a.getAttribute("data-kf-nav");
+      a.setAttribute("href", target + "?ns=" + encodeURIComponent(kf.ns()));
+    },
+    ns_select: initNsSelect,
+    options: initOptions,
+    value: initValue,
+    text: initText,
+    show_if: initShowIf,
+    chart: initChart,
+    chart_line: initChartLine,
+    table: async (n) => initTable(n),
+    form: async (n) => initForm(n),
     // page-level action buttons (row-level ones are wired by materialize)
-    for (const n of root.querySelectorAll("[data-kf-action]")) {
+    action: async (n) => {
       if (!n.closest("template") && !n._kfWired) { n._kfWired = true; wireAction(n, {}); }
+    },
+  };
+
+  // BEGIN GENERATED (kfspec.json dispatch; python -m e2e.uidom --gen-dispatch) — DO NOT EDIT
+  kf.DEFAULTS = {"poll_ms": 3000, "poll_max_ms": 30000, "snack_ms": 4000, "empty_text": "none", "items_path": "."};
+  kf.DISPATCH = [
+    {"selector": "[data-kf-nav]", "handler": "nav", "binding": "init"},
+    {"selector": "[data-kf-ns-select]", "handler": "ns_select", "binding": "init"},
+    {"selector": "[data-kf-options]", "handler": "options", "binding": "init"},
+    {"selector": "[data-kf-value]", "handler": "value", "binding": "init"},
+    {"selector": "[data-kf-text]", "handler": "text", "binding": "init"},
+    {"selector": "[data-kf-show-if]", "handler": "show_if", "binding": "init"},
+    {"selector": "[data-kf-chart]", "handler": "chart", "binding": "init"},
+    {"selector": "[data-kf-chart-line]", "handler": "chart_line", "binding": "init"},
+    {"selector": "[data-kf-table]", "handler": "table", "binding": "init"},
+    {"selector": "form[data-kf-form]", "handler": "form", "binding": "event"},
+    {"selector": "[data-kf-action]", "handler": "action", "binding": "event"},
+  ];
+  kf._initAll = async function (root) {
+    for (const entry of kf.DISPATCH) {
+      const handler = kf._handlers[entry.handler];
+      for (const n of root.querySelectorAll(entry.selector)) await handler(n);
     }
   };
+  // END GENERATED
 
   if (document.readyState === "loading") {
     document.addEventListener("DOMContentLoaded", () => kf.init());

@@ -14,7 +14,12 @@ Three locks:
 3. golden fixtures — the spec's DOM-in/HTTP-in → DOM-out/calls-out corpus
    executes against uidom.py (and is JS-engine-ready: pure JSON in, DOM
    assertions out) — a semantic change in the shared contract breaks a
-   fixture even when the vocabulary is unchanged.
+   fixture even when the vocabulary is unchanged,
+4. generated dispatch (VERDICT r4 #8) — the init order and shared runtime
+   defaults live ONCE in the spec's ``dispatch`` section: uidom.py
+   interprets it at runtime and kfui.js carries a generated block
+   (``python -m e2e.uidom --gen-dispatch``); these tests fail when the
+   on-disk block is stale or a handler named by the table is missing.
 """
 
 import re
@@ -66,6 +71,57 @@ def test_lockstep_hashes_current():
             "contract moved, then `python -m e2e.uidom --sync-spec` "
             f"(hash {got[:12]} != spec {want[:12]})"
         )
+
+
+def test_kfui_dispatch_block_is_generated_from_spec():
+    """The kfui.js dispatch block must byte-match what the spec generates —
+    editing either side without re-running --gen-dispatch fails here."""
+    from e2e.uidom import gen_dispatch_js
+
+    src = lockstep_files()["kfui.js"].read_text()
+    begin = src.index("  // BEGIN GENERATED")
+    end = src.index("  // END GENERATED", begin) + len("  // END GENERATED")
+    assert src[begin:end] == gen_dispatch_js(), (
+        "kfui.js generated dispatch block is stale: run "
+        "`python -m e2e.uidom --gen-dispatch`"
+    )
+
+
+def test_uidom_implements_every_dispatch_handler():
+    """Each init-bound handler in the spec table resolves to a Page method;
+    each event-bound one has its event path (click/submit) in Page."""
+    from e2e.uidom import Page, dispatch_table
+
+    for entry in dispatch_table():
+        if entry["binding"] == "init":
+            assert hasattr(Page, "_init_" + entry["handler"]), entry
+    assert hasattr(Page, "click") and hasattr(Page, "submit")
+
+
+def test_kfui_handlers_map_covers_every_dispatch_handler():
+    """kf._handlers must define every handler name the generated DISPATCH
+    table references — otherwise kf.init() awaits undefined in the real
+    browser while every Python-side check stays green."""
+    from e2e.uidom import dispatch_table
+
+    src = lockstep_files()["kfui.js"].read_text()
+    begin = src.index("kf._handlers = {")
+    end = src.index("};", begin)
+    keys = set(re.findall(r"^\s{4}([a-z_]+):", src[begin:end], re.M))
+    want = {e["handler"] for e in dispatch_table()}
+    assert want <= keys, f"kf._handlers missing {sorted(want - keys)}"
+
+
+def test_dispatch_selectors_use_registered_attributes():
+    """Every attribute a dispatch selector keys on is in the registry —
+    the table cannot smuggle vocabulary past lock #1."""
+    from e2e.uidom import dispatch_table
+
+    for entry in dispatch_table():
+        attrs = re.findall(r"data-kf-[a-z][a-z-]*[a-z]", entry["selector"])
+        assert attrs, f"selector without data-kf attribute: {entry}"
+        for a in attrs:
+            assert a in SPEC["attributes"], f"{a} not in the spec registry"
 
 
 @pytest.mark.parametrize("fixture", SPEC["fixtures"], ids=lambda f: f["name"][:60])
