@@ -1107,10 +1107,14 @@ def run_fixture(fix: Dict[str, Any]) -> Page:
 def sync_spec() -> None:
     """Refresh the lockstep hashes after a deliberate contract change —
     forces whoever edits kfui.js to re-visit uidom.py and the fixtures."""
+    global _SPEC_CACHE
     spec = load_spec()
     for key, path in lockstep_files().items():
         spec["lockstep"][key] = file_sha256(path)
     SPEC_PATH.write_text(json.dumps(spec, indent=2) + "\n")
+    # drop the cache: later load_spec() calls in this process must re-read
+    # the rewritten file, not serve the pre-rewrite (mutated) dict
+    _SPEC_CACHE = None
     print(f"lockstep hashes refreshed in {SPEC_PATH}")
 
 
@@ -1149,6 +1153,7 @@ def gen_dispatch_js() -> str:
 def gen_dispatch() -> bool:
     """Rewrite kfui.js's generated block from the spec; True if changed.
     (tests/test_kfui_spec.py fails when the on-disk block is stale.)"""
+    global _SPEC_CACHE
     path = lockstep_files()["kfui.js"]
     src = path.read_text()
     begin = src.index("  // BEGIN GENERATED")
@@ -1157,6 +1162,9 @@ def gen_dispatch() -> bool:
     if new == src:
         return False
     path.write_text(new)
+    # the cached spec's lockstep hash for kfui.js is now stale on disk;
+    # force a fresh read so the follow-up sync_spec() hashes the new file
+    _SPEC_CACHE = None
     return True
 
 

@@ -18,7 +18,8 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
 from ..runtime.manager import Reconciler, Request, Result
-from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+from ..scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION, requires_scheduling
+from ..tpu.topology import RESOURCE_TPU
 
 POD_OWNER_INDEX = "controller-owner-uid"
 
@@ -121,6 +122,12 @@ class StatefulSetReconciler(_OwnedPodsMixin, Reconciler):
             pod["metadata"].setdefault("labels", {})[
                 "statefulset.kubernetes.io/pod-name"
             ] = name
+            # Slice pods form a gang: the scheduler binds all `replicas`
+            # hosts of this StatefulSet all-or-nothing (scheduler/gang.py).
+            pod["metadata"]["labels"].setdefault(POD_GROUP_LABEL, req.name)
+            pod["metadata"]["annotations"].setdefault(
+                POD_GROUP_SIZE_ANNOTATION, str(replicas)
+            )
             self._create_pod_tolerant(client, pod)
             mutated = True
         for name in set(existing) - set(want_names):
@@ -224,16 +231,16 @@ class DeploymentReconciler(_OwnedPodsMixin, Reconciler):
 
 
 class PodletReconciler(Reconciler):
-    """Fake scheduler + kubelet: binds pods to nodes and runs containers.
+    """Pure kubelet: runs whatever is bound — placement lives elsewhere.
 
-    Scheduling honors nodeSelector and extended-resource capacity
-    (``google.com/tpu``), so tests exercise the same admission → selector →
-    capacity path a GKE TPU node pool enforces. With zero nodes in the store,
-    pods with no TPU request just run (keeps non-scheduling tests
-    lightweight), but a pod requesting ``google.com/tpu`` chips is
-    Unschedulable until a node with capacity exists — exactly like a GKE
-    cluster with zero TPU node pools, so tests cannot silently pass without
-    modeling capacity.
+    Binding (nodeSelector, gang all-or-nothing, chip capacity, quota,
+    priority) is the scheduler subsystem's job (``kubeflow_tpu/scheduler/``);
+    this reconciler only transitions bound pods to Running. Pods that need
+    scheduling (a node exists, or the pod requests ``google.com/tpu``
+    chips) are left alone until the scheduler's bind re-triggers this
+    reconciler through the pod watch. With zero nodes in the store and no
+    TPU ask, pods just run — keeps non-scheduling tests lightweight,
+    exactly as before the split.
     """
 
     FOR = ("v1", "Pod")
@@ -245,24 +252,12 @@ class PodletReconciler(Reconciler):
         # signal completion exactly this way).
         if pod is None or pod.get("status", {}).get("phase") in ("Running", "Succeeded", "Failed"):
             return Result()
-        nodes = client.list("v1", "Node")
-        node_name = None
-        if nodes or pod_tpu_chips(pod):
-            node_name = self._schedule(client, pod, nodes)
-            if node_name is None:
-                pod["status"] = {
-                    "phase": "Pending",
-                    "conditions": [
-                        {"type": "PodScheduled", "status": "False", "reason": "Unschedulable"}
-                    ],
-                }
-                client.update_status(pod)
-                # Retry scheduling: capacity may free when another slice stops
-                # (kube-scheduler's backoff-and-retry behavior).
-                return Result(requeue_after=0.25)
-            pod["spec"]["nodeName"] = node_name
-            client.update(pod)
-            pod = client.get("v1", "Pod", req.name, req.namespace)
+        if not pod.get("spec", {}).get("nodeName"):
+            if requires_scheduling(pod, have_nodes=bool(client.list("v1", "Node"))):
+                # Unbound and schedulable: the scheduler owns it; its bind
+                # update re-triggers this reconciler.
+                return Result()
+            # No nodes and no TPU request: run in place (unit-test mode).
         pod["status"] = {
             "phase": "Running",
             "podIP": "10.1.0.1",
@@ -282,31 +277,6 @@ class PodletReconciler(Reconciler):
         }
         client.update_status(pod)
         return Result()
-
-    def _schedule(self, client: Client, pod: Dict[str, Any], nodes: List[Dict[str, Any]]) -> Optional[str]:
-        selector = pod.get("spec", {}).get("nodeSelector") or {}
-        tpu_request = pod_tpu_chips(pod)
-        for node in nodes:
-            labels = apimeta.labels_of(node)
-            if any(labels.get(k) != v for k, v in selector.items()):
-                continue
-            capacity = int((node.get("status", {}).get("capacity") or {}).get(RESOURCE_TPU, 0))
-            if tpu_request:
-                if capacity < tpu_request:
-                    continue
-                used = self._tpu_in_use(client, apimeta.name_of(node), exclude=apimeta.uid_of(pod))
-                if used + tpu_request > capacity:
-                    continue
-            return apimeta.name_of(node)
-        return None
-
-    def _tpu_in_use(self, client: Client, node_name: str, exclude: str) -> int:
-        total = 0
-        for p in client.list("v1", "Pod"):
-            if p.get("spec", {}).get("nodeName") != node_name or apimeta.uid_of(p) == exclude:
-                continue
-            total += pod_tpu_chips(p)
-        return total
 
 
 def make_tpu_node(name: str, generation: str, topology_label: str, chips: int) -> Dict[str, Any]:
@@ -334,8 +304,15 @@ def make_tpu_node(name: str, generation: str, topology_label: str, chips: int) -
 
 def main() -> None:  # python -m kubeflow_tpu.controllers.builtin (substrate)
     from ..runtime.bootstrap import run_role
+    from ..scheduler.core import SchedulerReconciler
 
-    run_role("substrate", StatefulSetReconciler(), DeploymentReconciler(), PodletReconciler())
+    run_role(
+        "substrate",
+        StatefulSetReconciler(),
+        DeploymentReconciler(),
+        SchedulerReconciler(),
+        PodletReconciler(),
+    )
 
 
 if __name__ == "__main__":

@@ -199,6 +199,9 @@ class NotebookReconciler(Reconciler):
         pod_labels["statefulset"] = name  # must cover the selector below
 
         spec = template.setdefault("spec", {})
+        # Interactive slices outrank batch work: the gang scheduler may
+        # preempt lower classes (trials) to bind a notebook (scheduler/gang.py).
+        spec.setdefault("priorityClassName", "notebook")
         containers = spec.setdefault("containers", [{}])
         if not containers:
             containers.append({})

@@ -35,16 +35,16 @@ from ..apiserver.client import Client
 from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
 from ..runtime import reconcile as rh
-from ..tpu.topology import RESOURCE_TPU
+# Quota constants live with their enforcement point — the scheduler admits
+# gangs against this quota at bind time; this controller only writes it.
+from ..scheduler.gang import QUOTA_NAME, TPU_QUOTA_KEY  # noqa: F401 (re-export)
 
 log = logging.getLogger("kubeflow_tpu.profile")
 
 PROFILE_API = "kubeflow.org/v1"
 OWNER_ANNOTATION = "owner"
 FINALIZER = "profile-controller.kubeflow.org/finalizer"
-QUOTA_NAME = "kf-resource-quota"
 AUTH_POLICY_NAME = "ns-owner-access-istio"
-TPU_QUOTA_KEY = f"requests.{RESOURCE_TPU}"
 
 #: ClusterRole name ↔ workgroup role (reference kfam bindings.go:39-46).
 ROLE_MAP = {"admin": "kubeflow-admin", "edit": "kubeflow-edit", "view": "kubeflow-view"}

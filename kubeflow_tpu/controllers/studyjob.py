@@ -30,6 +30,7 @@ from ..hpo import earlystop
 from ..hpo.suggest import GridSuggester, ParamSpec, make_suggester
 from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
+from ..scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION
 
 log = logging.getLogger("kubeflow_tpu.studyjob")
 
@@ -295,8 +296,19 @@ class TrialPodRunner(Reconciler):
                 "Pod",
                 req.name,
                 req.namespace,
-                labels={**apimeta.labels_of(trial), "trial-name": req.name},
-                spec={"containers": [container], "restartPolicy": "Never"},
+                labels={
+                    **apimeta.labels_of(trial),
+                    "trial-name": req.name,
+                    # each trial is its own gang: preemptable as a unit, and
+                    # a notebook-class gang may evict it for chips
+                    POD_GROUP_LABEL: req.name,
+                },
+                annotations={POD_GROUP_SIZE_ANNOTATION: "1"},
+                spec={
+                    "containers": [container],
+                    "restartPolicy": "Never",
+                    "priorityClassName": "trial",
+                },
             )
             apimeta.set_owner_reference(pod, trial)
             client.create(pod)

@@ -44,9 +44,14 @@ def _interpret_default() -> bool:
 
 def _kernel(cur_ref, cache_ref, new_ref, out_ref, *, block_t: int, t: int):
     s = pl.program_id(0)
-    off = jnp.minimum(cur_ref[s], t - 1) % block_t
+    cur = cur_ref[s]
+    off = jnp.minimum(cur, t - 1) % block_t
     out_ref[...] = cache_ref[...]
-    out_ref[0, pl.dslice(off, 1)] = new_ref[0]
+    # Out-of-range cursors (retired/idle rows stepping past their end) must
+    # be a NO-OP, matching the where-select path where no position compares
+    # equal — not a write that corrupts the last KV position.
+    out_ref[0, pl.dslice(off, 1)] = jnp.where(
+        cur < t, new_ref[0], cache_ref[0, pl.dslice(off, 1)])
 
 
 @functools.partial(jax.jit, static_argnames=("block_t", "interpret"))
@@ -56,12 +61,13 @@ def kv_row_update(cache: jax.Array, new: jax.Array, cursors: jax.Array,
 
     cache: [S, T, H, D]; new: [S, H, D] (or [S, 1, H, D]); cursors: [S] int32.
     In place when the caller donates ``cache`` (the serving engine's step
-    donates the whole cache pytree). Cursors at or beyond T clamp to the
-    LAST position (T-1) instead of invoking out-of-bounds block indices:
-    the engine lets retired/idle rows keep stepping past their end (static
-    shapes — every row computes every chunk), and those rows are fully
-    overwritten at their next adoption, so the clamped write is harmless
-    by construction.
+    donates the whole cache pytree). Cursors at or beyond T are a NO-OP for
+    that row: the engine lets retired/idle rows keep stepping past their
+    end (static shapes — every row computes every chunk), and the
+    where-select path writes nothing there (no position compares equal), so
+    the kernel must agree rather than rewrite position T-1. The block index
+    still clamps to the last tile to avoid out-of-bounds tile selection;
+    the in-kernel predicate keeps the data untouched.
     """
     S, T, H, D = cache.shape
     if new.ndim == 3:

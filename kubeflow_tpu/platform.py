@@ -17,6 +17,7 @@ from .controllers.profile import ProfileConfig, ProfileReconciler
 from .controllers.studyjob import StudyJobReconciler, TrialPodRunner
 from .controllers.tensorboard import TensorboardConfig, TensorboardReconciler
 from .runtime.manager import Manager, Reconciler
+from .scheduler.core import SchedulerReconciler
 from .serving.controller import InferenceServiceReconciler, ServingConfig
 from .webhook.poddefault import admission_hook
 
@@ -29,6 +30,7 @@ def build_platform(
     serving_config: Optional[ServingConfig] = None,
     trial_runner: Optional[Reconciler] = None,
     with_substrate: bool = True,
+    scheduler: Optional[Reconciler] = None,
     extra_reconcilers=(),
 ) -> Manager:
     mgr = Manager(store)
@@ -37,6 +39,7 @@ def build_platform(
     if with_substrate:
         mgr.add(StatefulSetReconciler())
         mgr.add(DeploymentReconciler())
+        mgr.add(scheduler if scheduler is not None else SchedulerReconciler())
         mgr.add(PodletReconciler())
     mgr.add(NotebookReconciler(notebook_config))
     mgr.add(ProfileReconciler(profile_config))

@@ -56,6 +56,38 @@ class _Histogram:
         self.counts[-1] += 1
 
 
+class NamespacedRegistry:
+    """A registry view that prefixes every metric name with ``<prefix>_``.
+
+    Subsystems register a namespace once (e.g. ``METRICS.namespace("scheduler")``)
+    so all their series share a Prometheus-conventional prefix without each
+    call site repeating it. Reads (``value``/``total``) resolve against the
+    underlying registry, so tests can assert through either handle.
+    """
+
+    def __init__(self, registry: "MetricsRegistry", prefix: str) -> None:
+        self._registry = registry
+        self._prefix = prefix
+
+    def _name(self, name: str) -> str:
+        return f"{self._prefix}_{name}"
+
+    def counter(self, name: str, **labels: str) -> _Counter:
+        return self._registry.counter(self._name(name), **labels)
+
+    def gauge(self, name: str, **labels: str) -> _Gauge:
+        return self._registry.gauge(self._name(name), **labels)
+
+    def histogram(self, name: str, **labels: str) -> _Histogram:
+        return self._registry.histogram(self._name(name), **labels)
+
+    def total(self, name: str) -> float:
+        return self._registry.total(self._name(name))
+
+    def value(self, name: str, **labels: str) -> float:
+        return self._registry.value(self._name(name), **labels)
+
+
 class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -118,6 +150,9 @@ class MetricsRegistry:
                     else:
                         lines.append(f"{name}{suffix} {m.value}")
         return "\n".join(lines) + "\n"
+
+    def namespace(self, prefix: str) -> NamespacedRegistry:
+        return NamespacedRegistry(self, prefix)
 
     def reset(self) -> None:
         with self._lock:

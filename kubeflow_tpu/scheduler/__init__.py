@@ -1,0 +1,33 @@
+"""TPU-slice gang scheduler: the one component allowed to bind pods.
+
+See docs/SCHEDULER.md for the gang/priority/quota model and the split
+from PodletReconciler (now a pure kubelet).
+"""
+
+from .core import SCHED, BackoffQueue, SchedulerReconciler
+from .gang import (
+    DEFAULT_PRIORITY,
+    POD_GROUP_LABEL,
+    POD_GROUP_SIZE_ANNOTATION,
+    PRIORITY_CLASSES,
+    Gang,
+    gang_of,
+    priority_of,
+    requires_scheduling,
+)
+from .ledger import ChipLedger
+
+__all__ = [
+    "SCHED",
+    "BackoffQueue",
+    "SchedulerReconciler",
+    "ChipLedger",
+    "Gang",
+    "gang_of",
+    "priority_of",
+    "requires_scheduling",
+    "POD_GROUP_LABEL",
+    "POD_GROUP_SIZE_ANNOTATION",
+    "PRIORITY_CLASSES",
+    "DEFAULT_PRIORITY",
+]

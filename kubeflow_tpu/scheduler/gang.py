@@ -1,0 +1,102 @@
+"""Gang (pod-group) model: labels, sizes, priorities, and the predicate
+deciding which pods the scheduler owns.
+
+A TPU slice is useless until EVERY host of the slice is bound — a 2x4
+v5e notebook is two pods that must land together or not at all. Slice
+owners (the notebook StatefulSet, the StudyJob trial runner) stamp their
+pods with a pod-group label and an expected-size annotation; the
+scheduler places members all-or-nothing (kube-scheduler's coscheduling /
+Volcano gang semantics). Pods without the label form an implicit gang of
+one, so plain pods flow through the same path.
+
+Quota constants live here (not in controllers/profile.py) because the
+scheduler is the enforcement point: ProfileReconciler *writes* the
+ResourceQuota, the scheduler *admits against it* at bind time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+
+#: Label naming the gang a pod belongs to (value: gang name, unique per ns).
+POD_GROUP_LABEL = "scheduling.kubeflow.org/pod-group"
+#: Annotation carrying the expected member count of the gang.
+POD_GROUP_SIZE_ANNOTATION = "scheduling.kubeflow.org/pod-group-size"
+
+#: Name of the per-namespace ResourceQuota ProfileReconciler materializes.
+QUOTA_NAME = "kf-resource-quota"
+#: The hard-limit key for TPU chips inside that quota.
+TPU_QUOTA_KEY = f"requests.{RESOURCE_TPU}"
+
+#: priorityClassName → numeric priority. Notebooks outrank trials by
+#: default: an interactive user waiting on a slice preempts batch HPO.
+PRIORITY_CLASSES: Dict[str, int] = {
+    "system": 1000,
+    "notebook": 100,
+    "default": 50,
+    "trial": 10,
+    "batch": 0,
+}
+DEFAULT_PRIORITY = PRIORITY_CLASSES["default"]
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+@dataclass(frozen=True)
+class Gang:
+    """One co-scheduling unit: which pods, how many expected, what rank."""
+
+    namespace: Optional[str]
+    name: str
+    size: int
+    priority: int
+    labeled: bool  # explicit pod-group label vs implicit gang-of-one
+
+    @property
+    def key(self) -> Tuple[Optional[str], str]:
+        return (self.namespace, self.name)
+
+
+def priority_of(pod: Dict[str, Any]) -> int:
+    spec = pod.get("spec") or {}
+    explicit = spec.get("priority")
+    if isinstance(explicit, int):
+        return explicit
+    return PRIORITY_CLASSES.get(spec.get("priorityClassName", ""), DEFAULT_PRIORITY)
+
+
+def gang_of(pod: Dict[str, Any]) -> Gang:
+    ns = apimeta.namespace_of(pod)
+    group = apimeta.labels_of(pod).get(POD_GROUP_LABEL)
+    if not group:
+        # Implicit gang of one; "pod:" prefix keeps the key space disjoint
+        # from label values (which cannot contain ":").
+        return Gang(ns, f"pod:{apimeta.name_of(pod)}", 1, priority_of(pod), False)
+    try:
+        size = int(apimeta.annotations_of(pod).get(POD_GROUP_SIZE_ANNOTATION, "1"))
+    except ValueError:
+        size = 1
+    return Gang(ns, group, max(size, 1), priority_of(pod), True)
+
+
+def is_terminal(pod: Dict[str, Any]) -> bool:
+    return (pod.get("status") or {}).get("phase") in TERMINAL_PHASES
+
+
+def requires_scheduling(pod: Dict[str, Any], have_nodes: bool) -> bool:
+    """Does this pod need a node before the kubelet may run it?
+
+    Mirrors the capacity model the podlet enforced pre-split: with zero
+    nodes in the store, podless test pods just run — but a pod requesting
+    ``google.com/tpu`` chips must wait for a node with capacity, exactly
+    like a GKE cluster with no TPU node pools.
+    """
+    if is_terminal(pod):
+        return False
+    if (pod.get("spec") or {}).get("nodeName"):
+        return False
+    return have_nodes or pod_tpu_chips(pod) > 0

@@ -1,0 +1,266 @@
+"""Incremental chips-in-use ledger + gang reservations.
+
+The pre-split podlet recomputed node occupancy with an O(all-pods) scan
+per scheduling attempt (builtin.py round-5 profile: a top cost under
+churn). The ledger replaces that with event-driven accounting: informer
+pod/node events adjust per-node usage in O(1), and every placement query
+reads the cached totals under one lock.
+
+Two kinds of state:
+
+- **records** — one per bound, non-terminal pod: which node, how many
+  chips, which gang, what priority. Fed by informer events and by the
+  scheduler's own binds (the "assume" step — the informer echo of our
+  write arrives later and lands on the identical record, so replays are
+  harmless). Terminal phases and deletions free the chips; a MODIFIED
+  without a nodeName never erases a record, because binds are never
+  undone in this system and a stale pre-bind replay must not undercount.
+
+- **reservations** — per-gang, TTL-bounded holds on capacity that is not
+  yet (fully) bound: while a gang assembles, while its binds are written
+  one by one, and across a preemption (victim evicted, preemptor not yet
+  bound). Reservations are what make all-or-nothing placement composable
+  with first-come-first-served arrivals: without them, two 2-host gangs
+  interleave to one host each and deadlock.
+
+Placement itself (``place_and_reserve``) runs under the same lock so the
+feasibility check and the hold are atomic with respect to concurrent
+informer updates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..api import meta as apimeta
+from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
+from .gang import TERMINAL_PHASES, gang_of
+
+PodKey = Tuple[Optional[str], str]
+GangKey = Tuple[Optional[str], str]
+
+
+@dataclass
+class _PodRecord:
+    node: str
+    chips: int
+    namespace: Optional[str]
+    gang: GangKey
+    priority: int
+
+
+def node_tpu_capacity(node: Dict[str, Any]) -> int:
+    raw = ((node.get("status") or {}).get("capacity") or {}).get(RESOURCE_TPU, 0)
+    try:
+        return int(raw or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+class ChipLedger:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._capacity: Dict[str, int] = {}
+        self._labels: Dict[str, Dict[str, str]] = {}
+        self._records: Dict[PodKey, _PodRecord] = {}
+        self._used: Dict[str, int] = {}
+        self._reserved: Dict[GangKey, Tuple[float, Dict[str, int]]] = {}
+
+    # -- event feeds ---------------------------------------------------------
+
+    def on_node_event(self, event_type: str, node: Dict[str, Any]) -> None:
+        name = apimeta.name_of(node)
+        with self._lock:
+            if event_type == "DELETED":
+                self._capacity.pop(name, None)
+                self._labels.pop(name, None)
+            else:
+                self._capacity[name] = node_tpu_capacity(node)
+                labels = dict(apimeta.labels_of(node))
+                # kubelets stamp every node with its hostname; synthesize it so
+                # by-name nodeSelector pinning works against fixture nodes too
+                labels.setdefault("kubernetes.io/hostname", name)
+                self._labels[name] = labels
+
+    def on_pod_event(self, event_type: str, pod: Dict[str, Any]) -> None:
+        key = (apimeta.namespace_of(pod), apimeta.name_of(pod))
+        phase = (pod.get("status") or {}).get("phase")
+        node = (pod.get("spec") or {}).get("nodeName")
+        with self._lock:
+            if event_type == "DELETED" or phase in TERMINAL_PHASES:
+                self._drop(key)
+            elif node:
+                g = gang_of(pod)
+                self._put(key, _PodRecord(node, pod_tpu_chips(pod), key[0], g.key, g.priority))
+            # else: live unbound pod — keep any existing record (see module doc)
+
+    def record_bind(self, pod: Dict[str, Any]) -> None:
+        """Assume a bind this scheduler just wrote, ahead of the informer echo."""
+        self.on_pod_event("MODIFIED", pod)
+
+    def sync_from(self, nodes: Iterable[Dict[str, Any]], pods: Iterable[Dict[str, Any]]) -> None:
+        """Cacheless fallback (no Manager/informer): rebuild from a fresh list.
+        Reservations are kept — they are scheduler state, not cluster state."""
+        with self._lock:
+            self._capacity.clear()
+            self._labels.clear()
+            self._records.clear()
+            self._used.clear()
+        for n in nodes:
+            self.on_node_event("ADDED", n)
+        for p in pods:
+            self.on_pod_event("ADDED", p)
+
+    # -- reads ---------------------------------------------------------------
+
+    def has_nodes(self) -> bool:
+        with self._lock:
+            return bool(self._capacity)
+
+    def used_on(self, node: str) -> int:
+        with self._lock:
+            return self._used.get(node, 0)
+
+    def used_in_namespace(self, namespace: Optional[str]) -> int:
+        with self._lock:
+            return sum(r.chips for r in self._records.values() if r.namespace == namespace)
+
+    def running_gangs(self) -> Dict[GangKey, Dict[str, Any]]:
+        """Bound, non-terminal pods grouped by gang — the preemption candidates."""
+        with self._lock:
+            out: Dict[GangKey, Dict[str, Any]] = {}
+            for key, r in self._records.items():
+                g = out.setdefault(r.gang, {"priority": r.priority, "pods": [], "by_node": {}})
+                g["priority"] = max(g["priority"], r.priority)
+                g["pods"].append(key)
+                g["by_node"][r.node] = g["by_node"].get(r.node, 0) + r.chips
+            return out
+
+    def free_chips(self, exclude_gang: Optional[GangKey] = None,
+                   now: Optional[float] = None) -> Dict[str, int]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._free_locked(exclude_gang, now)
+
+    def reservations(self) -> Dict[GangKey, Dict[str, int]]:
+        now = time.monotonic()
+        with self._lock:
+            self._purge_expired(now)
+            return {k: dict(v[1]) for k, v in self._reserved.items()}
+
+    # -- placement + reservations -------------------------------------------
+
+    def place_and_reserve(
+        self,
+        gang_key: GangKey,
+        requirements: List[Tuple[int, Dict[str, str]]],
+        ttl: Optional[float] = None,
+        assume_freed: Optional[Dict[str, int]] = None,
+        now: Optional[float] = None,
+    ) -> Optional[List[str]]:
+        """All-or-nothing placement for ``requirements`` = [(chips, nodeSelector)].
+
+        Returns one node name per requirement, or None if the whole set
+        cannot fit. Free capacity excludes every other gang's reservation
+        but includes this gang's own (it is re-planning its own hold).
+        With ``ttl`` set, a feasible plan atomically replaces the gang's
+        reservation; ``ttl=None`` is a pure feasibility query.
+        ``assume_freed`` adds hypothetical capacity (a preemption victim's
+        chips) before planning.
+        """
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            free = self._free_locked(gang_key, now)
+            for node, chips in (assume_freed or {}).items():
+                free[node] = free.get(node, 0) + chips
+            placement: List[str] = []
+            for chips, selector in requirements:
+                best: Optional[str] = None
+                for node in self._capacity:
+                    labels = self._labels.get(node, {})
+                    if any(labels.get(k) != v for k, v in (selector or {}).items()):
+                        continue
+                    if chips:
+                        if free.get(node, 0) < chips:
+                            continue
+                        # best-fit: pack slices tightly so whole nodes stay
+                        # free for the next multi-chip gang
+                        if best is None or free[node] < free[best]:
+                            best = node
+                    elif best is None:
+                        best = node
+                if best is None:
+                    return None
+                placement.append(best)
+                if chips:
+                    free[best] -= chips
+            if ttl is not None:
+                hold: Dict[str, int] = {}
+                for node, (chips, _sel) in zip(placement, requirements):
+                    if chips:
+                        hold[node] = hold.get(node, 0) + chips
+                if hold:
+                    self._reserved[gang_key] = (now + ttl, hold)
+                else:
+                    self._reserved.pop(gang_key, None)
+            return placement
+
+    def reserve(self, gang_key: GangKey, by_node: Dict[str, int], ttl: float,
+                now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._reserved[gang_key] = (now + ttl, dict(by_node))
+
+    def release(self, gang_key: GangKey) -> None:
+        with self._lock:
+            self._reserved.pop(gang_key, None)
+
+    # -- internals (lock held) -----------------------------------------------
+
+    def _free_locked(self, exclude_gang: Optional[GangKey], now: float) -> Dict[str, int]:
+        self._purge_expired(now)
+        free = {n: cap - self._used.get(n, 0) for n, cap in self._capacity.items()}
+        for gkey, (_deadline, by_node) in self._reserved.items():
+            if gkey == exclude_gang:
+                continue
+            for node, chips in by_node.items():
+                free[node] = free.get(node, 0) - chips
+        return free
+
+    def _purge_expired(self, now: float) -> None:
+        expired = [k for k, (deadline, _hold) in self._reserved.items() if deadline <= now]
+        for k in expired:
+            del self._reserved[k]
+
+    def _put(self, key: PodKey, rec: _PodRecord) -> None:
+        old = self._records.get(key)
+        if old is not None:
+            self._adjust(old.node, -old.chips)
+        self._records[key] = rec
+        self._adjust(rec.node, rec.chips)
+
+    def _drop(self, key: PodKey) -> None:
+        old = self._records.pop(key, None)
+        if old is not None:
+            self._adjust(old.node, -old.chips)
+
+    def _adjust(self, node: str, delta: int) -> None:
+        n = self._used.get(node, 0) + delta
+        if n:
+            self._used[node] = n
+        else:
+            self._used.pop(node, None)
+
+    # -- test/debug ----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "capacity": dict(self._capacity),
+                "used": dict(self._used),
+                "records": {k: vars(v).copy() for k, v in self._records.items()},
+                "reserved": {k: dict(v[1]) for k, v in self._reserved.items()},
+            }

@@ -389,18 +389,29 @@ class ContinuousBatcher:
                 continue
             n = len(group)
             slots = [self._free.pop() for _ in range(n)]
-            # drop the scalar cursor — adopt() resets the row cursors itself
-            small = {nm: {"attention": {"k": l["attention"]["k"],
-                                        "v": l["attention"]["v"]}}
-                     for nm, l in small.items()}
-            first_n = first[:n]
-            self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
-                self.cache, small, jnp.asarray(slots, dtype=jnp.int32),
-                jnp.asarray([len(r.prompt) for r, _ in group], dtype=jnp.int32),
-                self.last_tok, self.temps, self.rngs, first_n,
-                jnp.asarray([r.temperature for r, _ in group],
-                            dtype=jnp.float32),
-                jnp.stack([jax.random.fold_in(k, 1) for _, k in group]))
+            try:
+                # drop the scalar cursor — adopt() resets the row cursors itself
+                small = {nm: {"attention": {"k": l["attention"]["k"],
+                                            "v": l["attention"]["v"]}}
+                         for nm, l in small.items()}
+                first_n = first[:n]
+                self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
+                    self.cache, small, jnp.asarray(slots, dtype=jnp.int32),
+                    jnp.asarray([len(r.prompt) for r, _ in group], dtype=jnp.int32),
+                    self.last_tok, self.temps, self.rngs, first_n,
+                    jnp.asarray([r.temperature for r, _ in group],
+                                dtype=jnp.float32),
+                    jnp.stack([jax.random.fold_in(k, 1) for _, k in group]))
+            except Exception as e:
+                # Adopt failed AFTER the slots were popped: these requests
+                # are in neither _active nor the pending queue, so _shutdown
+                # could never fail them — callers would block until their
+                # result() timeout. Restore the slots and fail the group now.
+                self._free.extend(slots)
+                for req, _ in group:
+                    req.error = e
+                    req.done.set()
+                continue
             try:
                 first_n.copy_to_host_async()
             except Exception:

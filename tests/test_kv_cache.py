@@ -13,7 +13,8 @@ def _reference(cache, new, cursors):
     out = np.array(cache, copy=True)
     T = out.shape[1]
     for s in range(out.shape[0]):
-        out[s, min(int(cursors[s]), T - 1)] = new[s]
+        if int(cursors[s]) < T:  # out-of-range rows are a no-op (retired slots)
+            out[s, int(cursors[s])] = new[s]
     return out
 
 
@@ -35,18 +36,23 @@ def test_row_update_matches_reference(shape, dtype):
     np.testing.assert_allclose(np.asarray(out, np.float32), want, rtol=0, atol=0)
 
 
-def test_out_of_range_cursor_clamps_to_last_position():
+def test_out_of_range_cursor_is_a_noop():
     """Idle/retired rows keep stepping past their end in the engine; the
-    kernel must clamp those writes to T-1 instead of faulting or wrapping
-    (the row is fully overwritten at its next adoption)."""
+    kernel must leave those rows untouched — the where-select path writes
+    nothing (no position compares equal to the cursor), and the kernel must
+    agree instead of corrupting the last KV position (T-1 may hold a live
+    token for a row at exactly full length)."""
     S, T, H, D = 4, 16, 2, 8
     cache = jnp.zeros((S, T, H, D), jnp.float32)
     new = jnp.ones((S, H, D), jnp.float32)
     cursors = jnp.asarray([0, T, T + 5, 3], jnp.int32)
     out = np.asarray(kv_row_update(cache, new, cursors))
     assert out[0, 0].all() and out[3, 3].all()
-    assert out[1, T - 1].all() and out[2, T - 1].all()  # clamped
-    assert out[1, :T - 1].sum() == 0 and out[2, :T - 1].sum() == 0
+    assert out[1].sum() == 0 and out[2].sum() == 0  # untouched rows
+    # agreement with the reference (which skips out-of-range rows)
+    np.testing.assert_array_equal(
+        out, _reference(np.zeros((S, T, H, D), np.float32),
+                        np.ones((S, H, D), np.float32), np.asarray(cursors)))
 
 
 def test_per_slot_decode_same_tokens_with_and_without_kernel(monkeypatch):

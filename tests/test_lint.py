@@ -98,3 +98,45 @@ def test_source_hygiene(path: Path):
             continue
         unused.append(f"{path}:{lineno}: unused import {name!r}")
     assert not unused, "\n".join(unused)
+
+
+def _node_name_writes(tree: ast.AST):
+    """AST sites that set ``nodeName``: subscript assigns
+    (``pod["spec"]["nodeName"] = ...``) and dict literals carrying a
+    ``"nodeName"`` key (``spec={"nodeName": ...}``)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.slice, ast.Constant)
+                    and t.slice.value == "nodeName"
+                ):
+                    yield node.lineno
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and k.value == "nodeName":
+                    yield node.lineno
+
+
+def test_binding_authority_stays_in_scheduler():
+    """Pod→node binding has exactly one writer: the scheduler subsystem.
+
+    Any other component mutating ``spec.nodeName`` (the pre-split podlet
+    did) reintroduces split-brain placement — capacity accounting, gang
+    all-or-nothing semantics, and preemption all assume the scheduler's
+    ledger sees every bind. Reads (``spec.get("nodeName")``) stay free.
+    """
+    scheduler_dir = ROOT / "kubeflow_tpu" / "scheduler"
+    offenders = []
+    for path in SOURCES:
+        if scheduler_dir in path.parents:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        offenders.extend(
+            f"{path.relative_to(ROOT)}:{lineno}: writes spec.nodeName"
+            for lineno in _node_name_writes(tree)
+        )
+    assert not offenders, (
+        "only kubeflow_tpu/scheduler/ may bind pods to nodes:\n" + "\n".join(offenders)
+    )

@@ -194,6 +194,66 @@ def _churn_and_assert(store, inf, index, index_lock):
     assert idx == want, (len(idx), len(want), idx ^ want)
 
 
+def test_ledger_consistent_under_concurrent_bind_unbind():
+    """The scheduler's chip ledger is fed from informer watch threads while
+    the scheduling worker reads free capacity and takes reservations. Hammer
+    bind/unbind/terminal event interleavings from many threads and require
+    the incremental per-node usage to equal a from-scratch recount of the
+    surviving records — a lost or double-counted delta is the bug."""
+    from kubeflow_tpu.api.meta import new_object as mk
+    from kubeflow_tpu.scheduler.ledger import ChipLedger
+    from kubeflow_tpu.controllers.builtin import make_tpu_node
+    from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+
+    led = ChipLedger()
+    n_nodes, n_threads, per_thread = 4, 8, 40
+    for i in range(n_nodes):
+        led.on_node_event("ADDED", make_tpu_node(f"n{i}", "v5e", "2x4", 64))
+
+    def pod(name, node, chips, phase=None):
+        p = mk("v1", "Pod", name, "default",
+               spec={"containers": [{"name": "c",
+                                     "resources": {"limits": {RESOURCE_TPU: str(chips)}}}],
+                     "nodeName": node})
+        if phase:
+            p["status"] = {"phase": phase}
+        return p
+
+    def worker(t):
+        for j in range(per_thread):
+            name = f"p{t}-{j}"
+            node = f"n{(t + j) % n_nodes}"
+            chips = 1 + (j % 4)
+            led.on_pod_event("ADDED", pod(name, node, chips))
+            led.reserve(("default", name), {node: chips}, ttl=30.0)
+            # re-deliveries and moves must stay idempotent/consistent
+            led.on_pod_event("MODIFIED", pod(name, node, chips))
+            led.on_pod_event("MODIFIED", pod(name, f"n{(t + j + 1) % n_nodes}", chips))
+            led.release(("default", name))
+            if j % 3 == 0:
+                led.on_pod_event("DELETED", pod(name, node, chips))
+            elif j % 3 == 1:
+                led.on_pod_event("MODIFIED", pod(name, node, chips, phase="Succeeded"))
+            # j % 3 == 2: stays bound on n{(t+j+1) % n_nodes}
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = led.snapshot()
+    assert not snap["reserved"], "all reservations released"
+    recount = {}
+    for rec in snap["records"].values():
+        recount[rec["node"]] = recount.get(rec["node"], 0) + rec["chips"]
+    assert snap["used"] == recount, (snap["used"], recount)
+    expected_pods = n_threads * sum(1 for j in range(per_thread) if j % 3 == 2)
+    assert len(snap["records"]) == expected_pods
+    free = led.free_chips()
+    assert all(free[f"n{i}"] == 64 - recount.get(f"n{i}", 0) for i in range(n_nodes))
+
+
 def test_churn_wave_converges_despite_informer_trigger_race():
     """Round-4 latent-race fix: the trigger watch and the informer mirror
     are independent streams, so a reconcile fired by the LAST pod event of
