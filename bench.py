@@ -88,29 +88,46 @@ def _timed_windows(fn, repeats: int):
 ANALYTIC_FWD_FLOPS_PER_IMAGE = 8.2e9
 
 
+def _step_breakdown(clock, timed_steps: int) -> dict:
+    """StepClock summary → the per-step dict bench rows carry. One clock
+    "step" is one timed WINDOW (timed_steps scan iterations), so window
+    phases are normalized back to per-step seconds; compile stays a
+    one-time total."""
+    s = clock.summary()
+    return {
+        "compile_s": round(s.get("compile_s", 0.0), 3),
+        "data_wait_s_per_step": round(s.get("data_wait", 0.0) / timed_steps, 6),
+        "device_compute_s_per_step": round(s.get("compute", 0.0) / timed_steps, 6),
+        "fetch_s_per_step": round(s.get("fetch", 0.0) / timed_steps, 6),
+        "host_other_s_per_step": round(s.get("other", 0.0) / timed_steps, 6),
+    }
+
+
 def _bench(batch: int):
     from kubeflow_tpu.models import ResNet50
-    from kubeflow_tpu.training import ClassifierTask, compiled_flops, mfu
-    from kubeflow_tpu.training.flops import detect_generation
+    from kubeflow_tpu.training import ClassifierTask, mfu
     from kubeflow_tpu.training.classifier import sgd_momentum
+    from kubeflow_tpu.training.flops import compiled_with_cost, detect_generation
+    from kubeflow_tpu.tpu.profiling import StepClock
 
     # s2d stem: measured +0.4 MFU on v5e (e2e/conv_experiments.py); opt-in
     # on the model (param-tree compat) but the bench always wants the fast path.
-    model = ResNet50(num_classes=1000, stem=os.environ.get("BENCH_STEM", "s2d"))
-    task = ClassifierTask(model=model, optimizer=sgd_momentum(lr=0.1, total_steps=1000))
+    stem = os.environ.get("BENCH_STEM", "s2d")
+    timed_steps = _timed_steps()
     rng = jax.random.PRNGKey(0)
     images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.float32)
     labels = jax.random.randint(rng, (batch,), 0, 1000)
-    state = task.init(rng, images)
-    step = task.make_train_step()
 
-    flops = None
-    try:
-        flops = compiled_flops(step, state, images, labels)
-    except Exception:
-        pass
-    if not flops:
-        flops = 3.0 * ANALYTIC_FWD_FLOPS_PER_IMAGE * batch
+    def make_step(fused: bool):
+        model = ResNet50(num_classes=1000, stem=stem, fused_blocks=fused)
+        task = ClassifierTask(
+            model=model, optimizer=sgd_momentum(lr=0.1, total_steps=1000))
+        return task, task.make_train_step()
+
+    # Both paths declare the SAME variable tree (resnet._ConvKernel /
+    # _FoldedNorm), so one init serves fused and unfused executables.
+    task0, _ = make_step(False)
+    state = task0.init(rng, images)
 
     # All timed steps run inside ONE executable (lax.scan): a single
     # dispatch covers the whole window, so per-dispatch/tunnel latency and
@@ -119,31 +136,95 @@ def _bench(batch: int):
     # so no step can be dead-code-eliminated. Images/labels are ARGUMENTS —
     # a closure-captured batch is serialized into the remote-compile request
     # on this backend (413 past ~256 MiB; hung batch 512 in round 1).
-    timed_steps = _timed_steps()
+    def make_window(fused: bool, steps: int):
+        _, step = make_step(fused)
 
-    @jax.jit
-    def run_steps(state, images, labels):
-        def body(s, _):
-            s2, metrics = step(s, images, labels)
-            return s2, metrics["loss"]
-        final, losses = jax.lax.scan(body, state, None, length=timed_steps)
-        checksum = sum(jnp.sum(p.astype(jnp.float32)) for p in jax.tree_util.tree_leaves(final.params))
-        return losses[-1], checksum
+        @jax.jit
+        def run_steps(state, images, labels):
+            def body(s, _):
+                s2, metrics = step(s, images, labels)
+                return s2, metrics["loss"]
+            final, losses = jax.lax.scan(body, state, None, length=steps)
+            checksum = sum(jnp.sum(p.astype(jnp.float32))
+                           for p in jax.tree_util.tree_leaves(final.params))
+            return losses[-1], checksum
 
-    # Warmup: compile + one full execution, forced to completion by the
-    # host fetch (block_until_ready alone can be a no-op on proxied
-    # backends).
+        return run_steps
+
+    # BENCH_FUSED: 1 = Pallas fused bottlenecks, 0 = XLA composite,
+    # auto (default) = short head-to-head, keep the winner. Auto because
+    # the acceptance bar is "never slower than the composite" and BASELINE
+    # round 5 measured the kernel BEHIND XLA on the tunneled dev backend —
+    # the bench measures instead of assuming.
+    fused_mode = os.environ.get("BENCH_FUSED", "auto")
+    calibration = None
+    if fused_mode in ("0", "1"):
+        use_fused = fused_mode == "1"
+    else:
+        calib_steps = max(4, min(10, timed_steps))
+        calibration = {}
+        for fused in (False, True):
+            key = "fused" if fused else "unfused"
+            try:
+                run = make_window(fused, calib_steps)
+                loss, cs = run(state, images, labels)  # compile + warmup
+                _ = (float(loss), float(cs))
+                t0 = time.perf_counter()
+                loss, cs = run(state, images, labels)
+                _ = (float(loss), float(cs))
+                calibration[key] = round(
+                    (time.perf_counter() - t0) / calib_steps, 6)
+            except Exception as e:  # kernel path broken ≠ bench broken
+                calibration[key] = None
+                calibration[f"{key}_error"] = str(e)[:120]
+        f, u = calibration.get("fused"), calibration.get("unfused")
+        use_fused = f is not None and (u is None or f <= u)
+
+    clock = StepClock()
+    run_steps = make_window(use_fused, timed_steps)
+
+    # Per-step FLOPs always from the UNFUSED step: XLA credits ZERO flops
+    # inside a Pallas custom call (same blindness as flash attention), so
+    # probing the fused executable would drop most of the conv work from
+    # the numerator and fake an MFU collapse. Same model math either way.
+    # (And never the whole window: cost analysis counts a while-loop body
+    # once, not × trip count.) compiled_with_cost times this compile; the
+    # window compile below is also charged to the clock so compile_s never
+    # pollutes a timed window.
+    flops = None
+    try:
+        _, step_ref = make_step(False)
+        with clock.compile():
+            _, flops, _ = compiled_with_cost(step_ref, state, images, labels)
+    except Exception:
+        pass
+    if not flops:
+        flops = 3.0 * ANALYTIC_FWD_FLOPS_PER_IMAGE * batch
+
+    # AOT-compile the window under the compile clock, then one warmup
+    # execution OUTSIDE it, forced to completion by the host fetch
+    # (block_until_ready alone can be a no-op on proxied backends).
+    try:
+        with clock.compile():
+            run_steps, _, _ = compiled_with_cost(run_steps, state, images, labels)
+    except Exception:
+        pass  # jit dispatch compiles lazily; first window absorbs it
     loss, checksum = run_steps(state, images, labels)
     _ = (float(loss), float(checksum))
+    clock.mark()  # warmup execution is untimed — keep it out of "other"
 
     import math
 
     results = {}
 
     def window():
-        loss, checksum = run_steps(state, images, labels)
-        # host fetch = real barrier; finiteness checked outside the timer
-        results["loss"], results["checksum"] = float(loss), float(checksum)
+        with clock.compute():
+            loss, checksum = run_steps(state, images, labels)
+            jax.block_until_ready((loss, checksum))
+        with clock.fetch():
+            # host fetch = real barrier; finiteness checked outside the timer
+            results["loss"], results["checksum"] = float(loss), float(checksum)
+        clock.end_step()
 
     def check():
         if not all(math.isfinite(v) for v in results.values()):
@@ -163,6 +244,9 @@ def _bench(batch: int):
         "generation": gen,
         "batch": batch,
         "flops_per_step": flops,
+        "fused_blocks": use_fused,
+        "fused_calibration": calibration,
+        "step_breakdown": _step_breakdown(clock, timed_steps),
     }
 
 
@@ -174,13 +258,22 @@ def _bench_gpt(batch: int, seq: int):
     model shape suits the 128x128 MXU — ResNet's 64-wide convs cannot."""
     import optax as _optax
 
-    from kubeflow_tpu.models.gpt import GptConfig, GptLM, causal_lm_loss
-    from kubeflow_tpu.training import compiled_flops, mfu
-    from kubeflow_tpu.training.flops import detect_generation
+    from kubeflow_tpu.models.gpt import (
+        GptConfig, GptLM, blockwise_causal_lm_loss, causal_lm_loss)
+    from kubeflow_tpu.training import mfu
+    from kubeflow_tpu.training.flops import compiled_with_cost, detect_generation
+    from kubeflow_tpu.tpu.profiling import StepClock
 
+    # Fast paths default ON (BENCH_GPT_SCAN=0 / BENCH_FUSED_LOSS=0 to
+    # compare): scan_blocks compiles one block instead of 24 unrolled;
+    # the blockwise loss never materializes the [b, L, 32000] f32 logits
+    # (1 GiB at b8/L1024 — THE cap on benchable batch before this).
+    scan_blocks = os.environ.get("BENCH_GPT_SCAN", "1") == "1"
+    fused_loss = os.environ.get("BENCH_FUSED_LOSS", "1") == "1"
     cfg = GptConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
                     max_seq=seq, vocab_size=32000,
-                    remat=os.environ.get("BENCH_REMAT", "0") == "1")
+                    remat=os.environ.get("BENCH_REMAT", "0") == "1",
+                    scan_blocks=scan_blocks)
     model = GptLM(cfg)
     rng = jax.random.PRNGKey(0)
     ids = jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)
@@ -189,10 +282,15 @@ def _bench_gpt(batch: int, seq: int):
     opt_state = opt.init(params)
     timed_steps = _timed_steps()
 
+    def loss_fn(p, ids):
+        if fused_loss:
+            hidden = model.apply({"params": p}, ids, return_hidden=True)
+            return blockwise_causal_lm_loss(
+                hidden, p["embedding"]["embedding"], ids)
+        return causal_lm_loss(model.apply({"params": p}, ids), ids)
+
     def train_step(params, opt_state, ids):
-        loss, grads = jax.value_and_grad(
-            lambda p: causal_lm_loss(model.apply({"params": p}, ids), ids)
-        )(params)
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
         updates, opt_state = opt.update(grads, opt_state, params)
         return _optax.apply_updates(params, updates), opt_state, loss
 
@@ -206,9 +304,30 @@ def _bench_gpt(batch: int, seq: int):
         checksum = sum(jnp.sum(x.astype(jnp.float32)) for x in jax.tree_util.tree_leaves(p))
         return losses[-1], checksum
 
+    clock = StepClock()
+    # FLOPs numerator from the REFERENCE path (unrolled blocks, plain
+    # loss): XLA cost analysis counts a while-loop body ONCE, so probing
+    # the scanned / vocab-chunked executables would undercount the blocks
+    # 24x and the LM head ~8x — the fast paths would fake an MFU drop.
+    # Lowering from eval_shape structs keeps the probe allocation-free.
     flops = None
     try:
-        flops = compiled_flops(jax.jit(train_step), params, opt_state, ids)
+        import dataclasses as _dc
+
+        ref_model = GptLM(_dc.replace(cfg, scan_blocks=False))
+
+        def ref_step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(
+                lambda p: causal_lm_loss(ref_model.apply({"params": p}, ids), ids)
+            )(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return _optax.apply_updates(params, updates), opt_state, loss
+
+        ref_params = jax.eval_shape(ref_model.init, rng, ids)["params"]
+        ref_opt_state = jax.eval_shape(opt.init, ref_params)
+        with clock.compile():
+            _, flops, _ = compiled_with_cost(
+                jax.jit(ref_step), ref_params, ref_opt_state, ids)
     except Exception:
         pass
     if not flops:
@@ -224,15 +343,25 @@ def _bench_gpt(batch: int, seq: int):
     causal_dot = 2.0 * batch * cfg.n_heads * seq * seq * cfg.head_dim / 2
     flops += 3.5 * (2 * causal_dot) * cfg.n_layers
 
+    try:
+        with clock.compile():
+            run_steps, _, _ = compiled_with_cost(run_steps, params, opt_state, ids)
+    except Exception:
+        pass
     loss, checksum = run_steps(params, opt_state, ids)
     _ = (float(loss), float(checksum))
+    clock.mark()  # warmup execution is untimed — keep it out of "other"
     import math
 
     results = {}
 
     def window():
-        loss, checksum = run_steps(params, opt_state, ids)
-        results["loss"], results["checksum"] = float(loss), float(checksum)
+        with clock.compute():
+            loss, checksum = run_steps(params, opt_state, ids)
+            jax.block_until_ready((loss, checksum))
+        with clock.fetch():
+            results["loss"], results["checksum"] = float(loss), float(checksum)
+        clock.end_step()
 
     def check():
         if not all(math.isfinite(v) for v in results.values()):
@@ -251,6 +380,9 @@ def _bench_gpt(batch: int, seq: int):
         "generation": gen,
         "batch": batch,
         "seq": seq,
+        "scan_blocks": scan_blocks,
+        "fused_loss": fused_loss,
+        "step_breakdown": _step_breakdown(clock, timed_steps),
     }
 
 
@@ -272,6 +404,9 @@ def _run_resnet(platform: str) -> dict:
                 "images_per_sec_per_chip": round(r["images_per_sec_per_chip"], 1),
                 "batch": r["batch"],
                 "window_mfus": r.get("window_mfus"),
+                "fused_blocks": r.get("fused_blocks"),
+                "fused_calibration": r.get("fused_calibration"),
+                "step_breakdown": r.get("step_breakdown"),
                 "platform": platform,
             })
         except Exception as e:  # OOM at this batch -> try smaller
@@ -295,7 +430,11 @@ def _run_gpt(platform: str, allow_legacy_batch: bool = False) -> dict:
             "vs_baseline": round(r["mfu"] / TARGET_MFU, 4),
             "tokens_per_sec_per_chip": round(r["tokens_per_sec_per_chip"], 1),
             "batch": r["batch"], "seq": r["seq"],
-            "window_mfus": r.get("window_mfus"), "platform": platform,
+            "window_mfus": r.get("window_mfus"),
+            "scan_blocks": r.get("scan_blocks"),
+            "fused_loss": r.get("fused_loss"),
+            "step_breakdown": r.get("step_breakdown"),
+            "platform": platform,
         })
     except Exception as e:
         return _emit({"metric": "gpt2_medium_train_mfu", "value": 0.0,

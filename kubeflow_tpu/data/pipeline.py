@@ -38,12 +38,17 @@ def device_prefetch(
     source: Iterable[Any],
     sharding: Optional[Any] = None,
     buffer_size: int = 2,
+    clock: Optional[Any] = None,
 ) -> Iterator[Any]:
     """Iterate ``source`` with async device placement, ``buffer_size`` deep.
 
     Each item is a pytree of numpy arrays; it is ``device_put`` (with
     ``sharding`` if given) on a background thread, so the returned device
     buffers are usually already resident when the consumer asks.
+
+    ``clock`` (a ``tpu.profiling.StepClock``) charges the consumer-side
+    queue wait to its ``data_wait`` phase — zero when prefetch is keeping
+    up, the input-bound signal when it isn't.
     """
     q: "queue.Queue[Any]" = queue.Queue(maxsize=max(1, buffer_size))
     _END = object()
@@ -79,7 +84,11 @@ def device_prefetch(
     t.start()
     try:
         while True:
-            item = q.get()
+            if clock is not None:
+                with clock.data_wait():
+                    item = q.get()
+            else:
+                item = q.get()
             if item is _END:
                 if error:
                     raise error[0]
@@ -109,18 +118,21 @@ class DataPipeline:
         sharding: Optional[Any] = None,
         transform: Optional[Callable[[Any], Any]] = None,
         buffer_size: int = 2,
+        clock: Optional[Any] = None,
     ):
         self.source_fn = source_fn
         self.sharding = sharding
         self.transform = transform
         self.buffer_size = buffer_size
+        self.clock = clock
 
     def epoch(self, epoch: int = 0) -> Iterator[Any]:
         source: Iterable[Any] = self.source_fn(epoch)
         if self.transform is not None:
             transform = self.transform
             source = (transform(item) for item in source)
-        return device_prefetch(source, self.sharding, self.buffer_size)
+        return device_prefetch(source, self.sharding, self.buffer_size,
+                               clock=self.clock)
 
     def __iter__(self) -> Iterator[Any]:
         return self.epoch(0)

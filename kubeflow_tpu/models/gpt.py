@@ -45,6 +45,14 @@ class GptConfig:
     # MoE: num_experts=0 = dense FFN; >0 replaces the MLP every block.
     num_experts: int = 0
     moe_k: int = 2
+    # scan_blocks: stack the transformer blocks as ONE ``nn.scan`` over
+    # layer-stacked params instead of n_layers unrolled calls — compile
+    # time and program size stop growing with depth (the 24-layer bench
+    # config traces one block). Param tree changes from ``block_{i}/...``
+    # to ``blocks/...`` with a leading layer axis; ``stack_block_params``
+    # converts. Training/forward only — the decode path keeps the unrolled
+    # layout its per-layer cache naming depends on.
+    scan_blocks: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -118,6 +126,11 @@ class GptAttention(nn.Module):
     attention_fn: Callable = causal_flash_attention
     decode: bool = False
     per_slot: bool = False  # per-row cache cursors (continuous batching)
+    # kv_kernel: route per-slot decode KV writes through the Pallas
+    # row-update kernel (ops/kv_cache.py). None defers to the
+    # KUBEFLOW_TPU_KV_KERNEL env flag (deployment-wide default); True/False
+    # pin it per model instance so the fast path is testable in-process.
+    kv_kernel: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -174,8 +187,11 @@ class GptAttention(nn.Module):
             q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
             k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
             v = dense(name="value")(x)
+            use_kernel = (
+                _kv_kernel_enabled() if self.kv_kernel is None else self.kv_kernel
+            )
             if seg_len == 1:
-                if _kv_kernel_enabled():
+                if use_kernel:
                     # Pallas row-update kernel: touches ONE [1,8,h,d] tile
                     # per row instead of a full-cache pass per layer
                     # (ops/kv_cache.py; the where-select below reads+writes
@@ -258,13 +274,14 @@ class GptBlock(nn.Module):
     mesh: Optional[Any] = None
     decode: bool = False
     per_slot: bool = False
+    kv_kernel: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
         x = x + GptAttention(cfg, self.attention_fn, self.decode, self.per_slot,
-                             name="attention")(
+                             self.kv_kernel, name="attention")(
             ln(name="ln_attn")(x).astype(cfg.dtype), positions
         )
         normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
@@ -283,6 +300,10 @@ class GptBlock(nn.Module):
             ffn = GptMlp(cfg, name="mlp")(normed)
         return x + ffn
 
+    def scan_body(self, x: jax.Array, positions: jax.Array):
+        """(carry, ys) form of ``__call__`` for ``nn.scan`` (cfg.scan_blocks)."""
+        return self(x, positions), None
+
 
 class GptLM(nn.Module):
     """Decoder-only LM. input_ids [b, L] -> logits [b, L, vocab] (f32).
@@ -296,9 +317,10 @@ class GptLM(nn.Module):
     mesh: Optional[Any] = None
     decode: bool = False
     per_slot: bool = False
+    kv_kernel: Optional[bool] = None
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array) -> jax.Array:
+    def __call__(self, input_ids: jax.Array, *, return_hidden: bool = False) -> jax.Array:
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size,
@@ -309,13 +331,42 @@ class GptLM(nn.Module):
         )
         x = embed(input_ids)
         positions = jnp.arange(input_ids.shape[1])  # decode path derives its own
-        block = GptBlock
-        if cfg.remat:
-            block = nn.remat(GptBlock, static_argnums=())
-        for i in range(cfg.n_layers):
-            x = block(cfg, self.attention_fn, self.mesh, self.decode, self.per_slot,
-                      name=f"block_{i}")(x, positions)
+        if cfg.scan_blocks and not self.decode:
+            # One traced block, n_layers iterations: params stack on a
+            # leading layer axis under ``blocks/``; remat wraps the body so
+            # each layer's activations rematerialize in backward.
+            body = GptBlock
+            if cfg.remat:
+                body = nn.remat(body, prevent_cse=False, methods=["scan_body"])
+            stack = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=nn.broadcast,
+                length=cfg.n_layers,
+                methods=["scan_body"],
+            )
+            x, _ = stack(cfg, self.attention_fn, self.mesh,
+                         name="blocks").scan_body(x, positions)
+        else:
+            if cfg.scan_blocks and self.decode:
+                raise ValueError(
+                    "scan_blocks is a training/forward layout; the decode path "
+                    "needs per-layer cache naming — unstack the params "
+                    "(inverse of stack_block_params) and decode with "
+                    "scan_blocks=False"
+                )
+            block = GptBlock
+            if cfg.remat:
+                block = nn.remat(GptBlock, static_argnums=())
+            for i in range(cfg.n_layers):
+                x = block(cfg, self.attention_fn, self.mesh, self.decode,
+                          self.per_slot, self.kv_kernel, name=f"block_{i}")(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
+        if return_hidden:
+            # final hidden states for a fused loss (blockwise_causal_lm_loss)
+            # — the [b, L, vocab] logits never materialize
+            return x.astype(jnp.float32)
         # tied LM head in f32 (embed.attend would compute in the module's
         # bf16 dtype; the final softmax wants full precision)
         logits = x.astype(jnp.float32) @ embed.embedding.T.astype(jnp.float32)
@@ -328,6 +379,75 @@ def causal_lm_loss(logits: jax.Array, input_ids: jax.Array) -> jax.Array:
     targets = input_ids[:, 1:]
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(picked)
+
+
+def blockwise_causal_lm_loss(
+    hidden: jax.Array,
+    embedding: jax.Array,
+    input_ids: jax.Array,
+    block_size: int = 4096,
+) -> jax.Array:
+    """Fused next-token cross entropy over a tied LM head that never
+    materializes the ``[b, L, vocab]`` f32 logits.
+
+    Same math as ``causal_lm_loss(hidden @ embedding.T, ids)``:
+    ``loss = mean(logsumexp(x·W^T) - x·W[target])``, with the logsumexp
+    accumulated ONLINE over vocab chunks (running max + rescaled sum — the
+    ``causal_flash_attention`` trick applied to the vocab axis). Peak
+    residency is one ``[tokens, block_size]`` chunk instead of the full
+    ``[b, L, vocab]`` f32 logits (1 GiB at the bench's b8/L1024/V32000,
+    ~3x that through log_softmax), which is what caps the benchable batch.
+    The scan body is ``jax.checkpoint``ed so backward recomputes each
+    chunk's logits instead of saving them.
+
+    ``hidden``: [b, L, d] final hidden states (``GptLM(...)(ids,
+    return_hidden=True)``); ``embedding``: the [vocab, d] tied embedding
+    (``params["embedding"]["embedding"]``) — gradients flow to both.
+    """
+    b, seq_len, d = hidden.shape
+    vocab = embedding.shape[0]
+    x = hidden[:, :-1].reshape(b * (seq_len - 1), d).astype(jnp.float32)
+    targets = input_ids[:, 1:].reshape(-1)
+
+    n_blocks = -(-vocab // block_size)
+    padded = n_blocks * block_size
+    w = embedding.astype(jnp.float32)
+    if padded != vocab:
+        w = jnp.pad(w, ((0, padded - vocab), (0, 0)))
+    w = w.reshape(n_blocks, block_size, d)
+    valid = (jnp.arange(padded) < vocab).reshape(n_blocks, block_size)
+
+    def body(carry, wv):
+        wb, valid_b = wv
+        m, s = carry
+        logits = jax.lax.dot_general(
+            x, wb, (((1,), (1,)), ((), ())))          # [tokens, block_size]
+        logits = jnp.where(valid_b[None, :], logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        return (m_new, s), None
+
+    init = (
+        jnp.full((x.shape[0],), -1e30, jnp.float32),
+        jnp.zeros((x.shape[0],), jnp.float32),
+    )
+    (m, s), _ = jax.lax.scan(jax.checkpoint(body), init, (w, valid))
+    lse = m + jnp.log(s)
+    # target logit via a [tokens, d] gather — never the full logits row
+    target_logit = jnp.sum(x * embedding[targets].astype(jnp.float32), axis=-1)
+    return jnp.mean(lse - target_logit)
+
+
+def stack_block_params(params: Any, n_layers: int) -> Any:
+    """Convert an unrolled-layout param tree (``block_0..block_{n-1}``) to
+    the ``scan_blocks=True`` layout (``blocks`` with a leading layer axis).
+    Lets loop-trained checkpoints load into the scanned model (the decode
+    path keeps the unrolled layout, so serving checkpoints stay as-is)."""
+    layers = [params[f"block_{i}"] for i in range(n_layers)]
+    out = {k: v for k, v in params.items() if not k.startswith("block_")}
+    out["blocks"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    return out
 
 
 @functools.lru_cache(maxsize=64)

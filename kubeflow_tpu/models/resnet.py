@@ -19,22 +19,95 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 ModuleDef = Any
 
 
+class _ConvKernel(nn.Module):
+    """Parameter holder with ``nn.Conv``'s exact tree ({kernel}) — the fused
+    block reads the weight directly instead of applying the conv, while the
+    checkpoint layout stays interchangeable with the unfused path."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", nn.initializers.lecun_normal(), self.shape,
+                          jnp.float32)
+
+
+class _FoldedNorm(nn.Module):
+    """Parameter/stat holder with ``nn.BatchNorm``'s exact tree (params
+    {scale, bias}, batch_stats {mean, var}); returns the inference-form norm
+    folded to a single (scale, bias) affine: y*s + b == (y - mean)/sqrt(var
+    + eps) * gamma + beta."""
+
+    features: int
+    epsilon: float = 1e-5
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self):
+        scale = self.param("scale", self.scale_init, (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((self.features,), jnp.float32)
+        )
+        var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((self.features,), jnp.float32)
+        )
+        inv = scale * jax.lax.rsqrt(var.value + self.epsilon)
+        return inv, bias - mean.value * inv
+
+
 class BottleneckBlock(nn.Module):
-    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut when needed."""
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut when needed.
+
+    ``fused=True`` routes eligible applications (stride 1, identity
+    shortcut, spatial size a multiple of 8) through the Pallas
+    ``fused_bottleneck`` kernel: the whole block runs as MXU matmuls with
+    activations resident in VMEM, norms folded from the running statistics
+    ("frozen norm" — matches the unfused path exactly in eval mode; in
+    train mode fused blocks normalize by running stats instead of batch
+    stats and do not update them). Backward stays XLA
+    (ops.fused_bottleneck_block). Ineligible applications (downsampling
+    head blocks) silently keep the unfused path; both paths declare an
+    identical variable tree.
+    """
 
     filters: int
     strides: Tuple[int, int]
     conv: ModuleDef
     norm: ModuleDef
     act: Callable
+    fused: bool = False
+
+    def _fusable(self, x) -> bool:
+        return (
+            self.strides == (1, 1)
+            and x.ndim == 4
+            and x.shape[-1] == self.filters * 4
+            and x.shape[1] == x.shape[2]
+            and x.shape[1] % 8 == 0
+        )
 
     @nn.compact
     def __call__(self, x):
+        if self.fused and self._fusable(x):
+            from kubeflow_tpu.ops.fused_bottleneck import fused_bottleneck_block
+
+            cin, cmid = self.filters * 4, self.filters
+            w1 = _ConvKernel((1, 1, cin, cmid), name="conv1")()
+            s1, b1 = _FoldedNorm(cmid, name="bn1")()
+            w2 = _ConvKernel((3, 3, cmid, cmid), name="conv2")()
+            s2, b2 = _FoldedNorm(cmid, name="bn2")()
+            w3 = _ConvKernel((1, 1, cmid, cin), name="conv3")()
+            s3, b3 = _FoldedNorm(cin, scale_init=nn.initializers.zeros, name="bn3")()
+            return fused_bottleneck_block(
+                x, w1[0, 0], s1, b1, w2, s2, b2, w3[0, 0], s3, b3
+            )
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
@@ -103,6 +176,12 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv7x7"  # "s2d" | "conv7x7"
+    # fused_blocks: route eligible bottlenecks (stride-1, identity shortcut
+    # — 13 of ResNet-50's 16) through the Pallas fused kernel
+    # (ops/fused_bottleneck.py). Same variable tree as the unfused model;
+    # frozen-norm semantics in those blocks (see BottleneckBlock). Opt-in
+    # like the s2d stem; bench.py decides per backend.
+    fused_blocks: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -129,6 +208,12 @@ class ResNet(nn.Module):
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # BasicBlock has no fused kernel; the flag only reaches bottlenecks.
+        fused_kw = (
+            {"fused": True}
+            if self.fused_blocks and self.block_cls is BottleneckBlock
+            else {}
+        )
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -139,6 +224,7 @@ class ResNet(nn.Module):
                     norm=norm,
                     act=act,
                     name=f"stage{i + 1}_block{j + 1}",
+                    **fused_kw,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
         # Final classifier in f32: logits feed a softmax cross-entropy that is

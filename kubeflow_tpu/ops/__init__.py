@@ -8,5 +8,16 @@ build: fused attention for the notebook/serving/training recipes, used by
 """
 
 from kubeflow_tpu.ops.flash_attention import auto_attention, flash_attention
+from kubeflow_tpu.ops.fused_bottleneck import (
+    fused_bottleneck,
+    fused_bottleneck_block,
+    reference_bottleneck,
+)
 
-__all__ = ["auto_attention", "flash_attention"]
+__all__ = [
+    "auto_attention",
+    "flash_attention",
+    "fused_bottleneck",
+    "fused_bottleneck_block",
+    "reference_bottleneck",
+]

@@ -122,6 +122,52 @@ def fused_bottleneck(
     )(x, w1, s1, w2r, s2, w3, s3)
 
 
+@jax.custom_vjp
+def fused_bottleneck_block(x, w1, scale1, bias1, w2, scale2, bias2,
+                           w3, scale3, bias3):
+    """Differentiable fused bottleneck: Pallas forward, XLA backward.
+
+    The kernel has no Pallas backward; the VJP recomputes the block through
+    ``reference_bottleneck`` (same math, compiler-scheduled) and uses ITS
+    cotangents — forward-only fusion, rematerialized backward. Residuals are
+    the primal inputs, so the fused path holds no extra activations between
+    fwd and bwd (the remat trade the models already make per-block).
+    """
+    return fused_bottleneck(x, w1, scale1, bias1, w2, scale2, bias2,
+                            w3, scale3, bias3)
+
+
+def _fused_block_fwd(x, w1, scale1, bias1, w2, scale2, bias2, w3, scale3, bias3):
+    out = fused_bottleneck(x, w1, scale1, bias1, w2, scale2, bias2,
+                           w3, scale3, bias3)
+    return out, (x, w1, scale1, bias1, w2, scale2, bias2, w3, scale3, bias3)
+
+
+def _composite_f32(x, w1, scale1, bias1, w2, scale2, bias2, w3, scale3, bias3):
+    """All-f32 twin of ``reference_bottleneck`` for the VJP: the mixed
+    bf16-input/f32-accumulate convs the reference uses hit a conv-transpose
+    dtype mismatch under ``jax.vjp``; a uniform-dtype composite transposes
+    cleanly and gives f32-accurate cotangents."""
+    conv = functools.partial(
+        jax.lax.conv_general_dilated,
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    h1 = jnp.maximum(conv(x, w1[None, None]) * scale1 + bias1, 0.0)
+    h2 = jnp.maximum(conv(h1, w2) * scale2 + bias2, 0.0)
+    y = conv(h2, w3[None, None]) * scale3 + bias3
+    return jnp.maximum(y + x, 0.0)
+
+
+def _fused_block_bwd(residuals, g):
+    primals_f32 = tuple(r.astype(jnp.float32) for r in residuals)
+    _, vjp = jax.vjp(_composite_f32, *primals_f32)
+    grads = vjp(g.astype(jnp.float32))
+    return tuple(dr.astype(r.dtype) for dr, r in zip(grads, residuals))
+
+
+fused_bottleneck_block.defvjp(_fused_block_fwd, _fused_block_bwd)
+
+
 def reference_bottleneck(x, w1, scale1, bias1, w2, scale2, bias2,
                          w3, scale3, bias3):
     """The XLA composite the kernel must match (and beat): same math,

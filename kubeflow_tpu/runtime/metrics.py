@@ -9,6 +9,8 @@ gauges, histograms, label sets, ``/metrics`` text format — stdlib-only.
 from __future__ import annotations
 
 import threading
+import time
+from contextlib import contextmanager
 from typing import Dict, List, Tuple
 
 
@@ -55,6 +57,10 @@ class _Histogram:
                 return
         self.counts[-1] += 1
 
+    @property
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
 
 class NamespacedRegistry:
     """A registry view that prefixes every metric name with ``<prefix>_``.
@@ -80,6 +86,9 @@ class NamespacedRegistry:
 
     def histogram(self, name: str, **labels: str) -> _Histogram:
         return self._registry.histogram(self._name(name), **labels)
+
+    def timer(self, name: str, **labels: str):
+        return self._registry.timer(self._name(name), **labels)
 
     def total(self, name: str) -> float:
         return self._registry.total(self._name(name))
@@ -113,6 +122,18 @@ class MetricsRegistry:
 
     def histogram(self, name: str, **labels: str) -> _Histogram:
         return self._get(name, "histogram", _Histogram, labels)
+
+    @contextmanager
+    def timer(self, name: str, **labels: str):
+        """Observe the wall time of a ``with`` body into histogram ``name``
+        (the Prometheus *_seconds convention — StepClock and the serving
+        paths time phases through this)."""
+        hist = self.histogram(name, **labels)
+        start = time.perf_counter()
+        try:
+            yield hist
+        finally:
+            hist.observe(time.perf_counter() - start)
 
     def total(self, name: str) -> float:
         """Sum of a counter/gauge across every label combination."""

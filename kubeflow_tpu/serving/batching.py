@@ -114,8 +114,21 @@ class DynamicBatcher:
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            self._lock.notify()
+            # Wake EVERY condition waiter, not just one: with notify() the
+            # single wakeup can land on a thread that re-waits (a future
+            # multi-waiter worker, or a straggler mid-window) and the rest
+            # sleep through shutdown.
+            self._lock.notify_all()
         self._worker.join(timeout=5)
+        # The worker drains the queue before exiting; if it died or the
+        # join timed out (predict_fn wedged), fail the leftovers instead
+        # of leaving their callers blocked on done.wait() forever.
+        with self._lock:
+            leftover, self._queue = self._queue, []
+        for p in leftover:
+            if not p.done.is_set():
+                p.error = BatcherClosed("batcher closed before serving request")
+                p.done.set()
 
     # -- worker side ---------------------------------------------------------
     def _take_batch(self) -> List[_Pending]:

@@ -119,7 +119,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, cfg: GptConfig, params: Any, slots: int = 8,
-                 chunk: int = 16, pipeline: int = 3):
+                 chunk: int = 16, pipeline: int = 3,
+                 kv_kernel: Optional[bool] = None):
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -128,7 +129,10 @@ class ContinuousBatcher:
         # fixed admission-group pad: one prefill program + one zero
         # template per prompt bucket; waves larger than this are chunked
         self._group_pad = min(slots, MAX_GROUP)
-        self.model = GptLM(cfg, decode=True, per_slot=True)
+        # kv_kernel: per-slot KV-write strategy (None = the
+        # KUBEFLOW_TPU_KV_KERNEL env default; see models.gpt)
+        self.model = GptLM(cfg, decode=True, per_slot=True,
+                           kv_kernel=kv_kernel)
         self._prefill_model = GptLM(cfg, decode=True)  # [1, P], scalar cursor
         self.cache = self._fresh_cache()
         self.last_tok = jnp.zeros((slots,), jnp.int32)

@@ -13,8 +13,9 @@ from __future__ import annotations
 import glob
 import os
 import threading
+import time
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 PROFILE_PORT = 9999
 
@@ -59,6 +60,102 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+class StepClock:
+    """Wall-clock step breakdown for training/bench loops.
+
+    The profiler trace (above) answers "where did the time go" offline; the
+    clock answers it live, per step, with host-side timers cheap enough to
+    leave on: wrap each phase of the loop body and ``end_step()`` at the
+    bottom. The canonical phases:
+
+        with clock.compile(): compiled = step_fn.lower(...).compile()
+        for batch in data:                # via device_prefetch(clock=clock)
+            with clock.compute(): out = compiled(state, batch)
+            with clock.fetch():   loss = float(out["loss"])   # D2H sync
+            clock.end_step()
+
+    Each record holds the measured phases plus ``total`` (wall since the
+    previous ``end_step``) and ``other`` (total minus measured — dispatch
+    overhead, Python, logging). Compile time accumulates separately and is
+    never charged to a step, so the first-step XLA compile can't masquerade
+    as slow data loading (the classic misread this exists to kill). With a
+    ``metrics`` namespace (``METRICS.namespace("train")``) every phase also
+    lands in ``<ns>_step_<phase>_seconds`` histograms for ``/metrics``.
+    """
+
+    def __init__(self, metrics: Optional[Any] = None) -> None:
+        self._metrics = metrics
+        self.compile_s = 0.0
+        self.steps: List[Dict[str, float]] = []
+        self._current: Dict[str, float] = {}
+        self._anchor = time.perf_counter()
+
+    @contextmanager
+    def phase(self, name: str):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - start
+            self._current[name] = self._current.get(name, 0.0) + dt
+            if self._metrics is not None:
+                self._metrics.histogram(f"step_{name}_seconds").observe(dt)
+
+    # The canonical phases as methods so call sites stay greppable.
+    def data_wait(self):
+        """Host blocked waiting on the input pipeline (H2D not yet hidden)."""
+        return self.phase("data_wait")
+
+    def compute(self):
+        """Dispatch + device execution (through ``block_until_ready``)."""
+        return self.phase("compute")
+
+    def fetch(self):
+        """D2H readback of step outputs (loss/metrics scalars)."""
+        return self.phase("fetch")
+
+    @contextmanager
+    def compile(self):
+        """XLA compile — accumulated separately, never charged to a step."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.compile_s += time.perf_counter() - start
+            if self._metrics is not None:
+                self._metrics.gauge("compile_seconds").set(self.compile_s)
+            self._anchor = time.perf_counter()
+
+    def mark(self) -> None:
+        """Reset the wall anchor without recording — call after untimed
+        work between steps (warmup executions, logging) so the next step's
+        ``total``/``other`` doesn't absorb it."""
+        self._anchor = time.perf_counter()
+
+    def end_step(self) -> Dict[str, float]:
+        now = time.perf_counter()
+        rec = dict(self._current)
+        rec["total"] = now - self._anchor
+        rec["other"] = max(0.0, rec["total"] - sum(self._current.values()))
+        self.steps.append(rec)
+        self._current = {}
+        self._anchor = now
+        return rec
+
+    def summary(self) -> Dict[str, float]:
+        """Per-phase mean seconds across recorded steps, plus ``compile_s``
+        and the step count — the dict bench.py emits as ``step_breakdown``."""
+        out: Dict[str, float] = {}
+        if self.steps:
+            keys = sorted(set().union(*self.steps))
+            n = len(self.steps)
+            for k in keys:
+                out[k] = sum(s.get(k, 0.0) for s in self.steps) / n
+        out["compile_s"] = self.compile_s
+        out["steps"] = float(len(self.steps))
+        return out
 
 
 def profile_step(

@@ -12,4 +12,4 @@ from kubeflow_tpu.training.classifier import (  # noqa: F401
     TrainState,
     cross_entropy_loss,
 )
-from kubeflow_tpu.training.flops import compiled_flops, mfu  # noqa: F401
+from kubeflow_tpu.training.flops import compiled_flops, compiled_with_cost, mfu  # noqa: F401

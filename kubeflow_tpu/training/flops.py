@@ -9,17 +9,15 @@ benchmark agree on the denominator.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import time
+from typing import Any, Optional, Tuple
 
 import jax
 
 from kubeflow_tpu.tpu.topology import ACCELERATORS
 
 
-def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> Optional[float]:
-    """Total FLOPs of one invocation, from XLA cost analysis (None if the
-    backend doesn't report)."""
-    compiled = jitted_fn.lower(*args, **kwargs).compile()
+def _flops_of(compiled: Any) -> Optional[float]:
     analysis = compiled.cost_analysis()
     if isinstance(analysis, (list, tuple)):
         analysis = analysis[0] if analysis else {}
@@ -27,6 +25,30 @@ def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> Optional[float]
         return None
     flops = analysis.get("flops")
     return float(flops) if flops and flops > 0 else None
+
+
+def compiled_flops(jitted_fn: Any, *args: Any, **kwargs: Any) -> Optional[float]:
+    """Total FLOPs of one invocation, from XLA cost analysis (None if the
+    backend doesn't report)."""
+    return _flops_of(jitted_fn.lower(*args, **kwargs).compile())
+
+
+def compiled_with_cost(
+    jitted_fn: Any, *args: Any, **kwargs: Any
+) -> Tuple[Any, Optional[float], float]:
+    """Lower + compile once, returning ``(compiled, flops, compile_seconds)``.
+
+    One AOT compile serves both the callable the bench loop runs and the
+    cost analysis — the old ``compiled_flops`` + warmup-call pattern paid
+    the (minutes-scale on big configs) XLA compile twice and folded it into
+    the first timed window. The compile wall time comes back separately so
+    telemetry (StepClock.compile / bench ``step_breakdown``) reports it
+    instead of charging it to steps.
+    """
+    start = time.perf_counter()
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    compile_s = time.perf_counter() - start
+    return compiled, _flops_of(compiled), compile_s
 
 
 def peak_flops_per_chip(generation: str = "v5e") -> float:

@@ -160,6 +160,90 @@ class TestDynamicBatcher:
         with pytest.raises(RuntimeError, match="closed"):
             b.predict([1])
 
+    def test_closed_batcher_rejects_immediately(self):
+        """The rejection must not wait out a coalescing window: with a huge
+        max_wait_ms, a post-close predict still fails instantly."""
+        b = DynamicBatcher(lambda x: x, max_batch=8, max_wait_ms=10_000.0)
+        b.close()
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="closed"):
+            b.predict([1])
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_interleaved_shapes_served_within_two_rounds(self):
+        """Queue A, B, A, B (two shapes): round 1 serves one shape, the
+        leftover shape is marked waited and round 2 serves it IMMEDIATELY
+        (no second coalescing window). Nothing is dropped, and no batch
+        mixes shapes."""
+        calls = []
+        lock = threading.Lock()
+
+        def predict(instances):
+            arr = np.asarray(instances)  # raises if shapes were mixed
+            with lock:
+                calls.append(arr.shape)
+            return [row.tolist() for row in arr]
+
+        b = DynamicBatcher(predict, max_batch=16, max_wait_ms=100.0)
+        results = {}
+
+        def run(key, payload):
+            results[key] = b.predict(payload)
+
+        payloads = {"a1": [[1.0]], "b1": [[1.0, 2.0]],
+                    "a2": [[3.0]], "b2": [[3.0, 4.0]]}
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=run, args=(k, v))
+                   for k, v in payloads.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        elapsed = time.perf_counter() - t0
+        assert results == payloads, results  # every pending served, routed right
+        # each shape co-batched homogeneously (asarray would have raised)
+        assert all(shape[1] in (1, 2) for shape in calls), calls
+        # leftover shape served without a second full window: well under
+        # 2x the 100 ms window even on a loaded CI box
+        assert elapsed < 1.0, f"{elapsed}s for two rounds ({calls})"
+
+    def test_close_wakes_every_waiter_and_fails_leftovers(self):
+        """close() against a wedged predict_fn: the join times out, and the
+        still-queued pending must be failed (BatcherClosed) rather than left
+        blocked on done.wait() forever; the in-flight batch still completes
+        once the model unwedges."""
+        from kubeflow_tpu.serving.batching import BatcherClosed
+
+        release = threading.Event()
+
+        def predict(instances):
+            if np.asarray(instances).shape[1:] == (1,):  # only shape-A wedges
+                release.wait(timeout=30)
+            return [i for i in instances]
+
+        b = DynamicBatcher(predict, max_batch=4, max_wait_ms=5.0)
+        outcome = {}
+
+        def run(key, payload):
+            try:
+                outcome[key] = b.predict(payload)
+            except BaseException as e:  # noqa: BLE001
+                outcome[key] = e
+
+        t_a = threading.Thread(target=run, args=("a", [[1.0]]))
+        t_a.start()
+        time.sleep(0.2)  # worker takes A and wedges inside predict
+        t_b = threading.Thread(target=run, args=("b", [[1.0, 2.0]]))
+        t_b.start()
+        time.sleep(0.2)  # B queued behind the wedged round
+        b.close()  # join times out (worker wedged) -> B must be failed
+        t_b.join(timeout=5)
+        assert not t_b.is_alive(), "queued waiter left hanging after close()"
+        assert isinstance(outcome["b"], BatcherClosed), outcome.get("b")
+        release.set()
+        t_a.join(timeout=10)
+        assert outcome["a"] == [[1.0]]
+
 
 class TestServerIntegration:
     def test_http_concurrent_predicts_share_forwards(self):

@@ -120,3 +120,29 @@ def test_pipeline_feeds_sharded_train_step():
     c = next(iter(pipe.epoch(1)))["images"]
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_prefetch_charges_consumer_wait_to_step_clock():
+    """A slow source must show up as StepClock data_wait; a fast source with
+    a slow consumer must not."""
+    import time as _time
+
+    from kubeflow_tpu.tpu.profiling import StepClock
+
+    def slow_source():
+        for i in range(3):
+            _time.sleep(0.05)
+            yield np.full((2,), i, np.float32)
+
+    clock = StepClock()
+    out = list(device_prefetch(slow_source(), buffer_size=1, clock=clock))
+    assert len(out) == 3
+    assert clock._current["data_wait"] >= 0.05, clock._current
+
+    # fast source, slow consumer: prefetch keeps the queue full, wait ~0
+    clock2 = StepClock()
+    for item in device_prefetch((np.zeros(2) for _ in range(3)),
+                                buffer_size=2, clock=clock2):
+        _time.sleep(0.02)
+    # first get can include producer startup; steady-state waits are tiny
+    assert clock2._current["data_wait"] < 0.5

@@ -93,3 +93,57 @@ def test_per_slot_decode_same_tokens_with_and_without_kernel(monkeypatch):
         return np.asarray(toks)
 
     np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_kv_kernel_constructor_arg_decode_parity(monkeypatch):
+    """kv_kernel as a constructor arg must (a) produce identical decode
+    tokens either way and (b) OVERRIDE the env flag — serving configs pin
+    the strategy explicitly instead of inheriting process env."""
+    import functools
+
+    from kubeflow_tpu.models.gpt import GptConfig, GptLM
+
+    cfg = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64,
+                    max_seq=24, vocab_size=128)
+    rng = jax.random.PRNGKey(0)
+    params = GptLM(cfg).init(rng, jnp.zeros((1, 4), jnp.int32))["params"]
+
+    def run(kv_kernel):
+        # env set OPPOSITE to the arg: if the arg didn't take precedence,
+        # both runs would silently take the same path and the test would
+        # prove nothing
+        monkeypatch.setenv("KUBEFLOW_TPU_KV_KERNEL",
+                           "0" if kv_kernel else "1")
+        model = GptLM(cfg, decode=True, per_slot=True, kv_kernel=kv_kernel)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, cache, tok):
+            def one(carry, _):
+                cache, tok = carry
+                logits, upd = model.apply({"params": params, "cache": cache},
+                                          tok[:, None], mutable=["cache"])
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (upd["cache"], nxt), nxt
+            (cache, tok), toks = jax.lax.scan(one, (cache, tok), None, length=6)
+            return cache, tok, jnp.moveaxis(toks, 0, 1)
+
+        S = 3
+        kv = (S, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+        cache = {f"block_{i}": {"attention": {
+            "k": jnp.zeros(kv, cfg.dtype), "v": jnp.zeros(kv, cfg.dtype),
+            "cursors": jnp.asarray([1, 5, 9], jnp.int32)}}
+            for i in range(cfg.n_layers)}
+        tok = jnp.asarray([3, 7, 11], jnp.int32)
+        _, _, toks = step(params, cache, tok)
+        return np.asarray(toks)
+
+    np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_continuous_batcher_accepts_kv_kernel():
+    """The serving engine must expose the same pin-it-explicitly knob."""
+    import inspect
+
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    assert "kv_kernel" in inspect.signature(ContinuousBatcher.__init__).parameters

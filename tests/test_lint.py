@@ -140,3 +140,81 @@ def test_binding_authority_stays_in_scheduler():
     assert not offenders, (
         "only kubeflow_tpu/scheduler/ may bind pods to nodes:\n" + "\n".join(offenders)
     )
+
+
+# -- dtype gate: bf16 matmuls in model forward passes -------------------------
+#
+# The MFU work (BASELINE rounds 4-5) hinges on every matmul/conv feeding the
+# MXU bf16 inputs; one stray f32 contraction halves throughput silently. The
+# sanctioned fp32 islands are numerics-critical and stay: losses, attention
+# softmax, and the final logits/classifier head.
+F32_MATMUL_ALLOWLIST = {
+    ("gpt.py", "GptAttention._decode_attention"),  # decode softmax island
+    ("gpt.py", "GptLM.__call__"),                  # f32 logits head
+    ("gpt.py", "causal_lm_loss"),
+    ("gpt.py", "blockwise_causal_lm_loss"),
+}
+
+_MATMUL_CALLEES = {"einsum", "matmul", "dot", "tensordot", "dot_general"}
+
+
+def _mentions_f32(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "float32":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "float32":
+            return True
+    return False
+
+
+class _F32MatmulFinder(ast.NodeVisitor):
+    """(qualname, lineno) of every matmul-family op (einsum/matmul/dot/
+    dot_general/``@``) whose expression mentions float32."""
+
+    def __init__(self) -> None:
+        self.stack: list[str] = []
+        self.hits: list[tuple[str, int]] = []
+
+    def _scoped(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _scoped
+
+    def _check(self, node: ast.AST) -> None:
+        if _mentions_f32(node):
+            self.hits.append((".".join(self.stack) or "<module>", node.lineno))
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if isinstance(node.op, ast.MatMult):
+            self._check(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+        if name in _MATMUL_CALLEES:
+            self._check(node)
+        self.generic_visit(node)
+
+
+def test_no_f32_matmuls_outside_sanctioned_islands():
+    """Model forward passes keep matmul/einsum inputs bf16; fp32 appears
+    only in the allowlisted islands above. A new f32 contraction must either
+    become bf16 or be explicitly added here with a numerics justification."""
+    models_dir = ROOT / "kubeflow_tpu" / "models"
+    offenders = []
+    for path in sorted(models_dir.glob("*.py")):
+        finder = _F32MatmulFinder()
+        finder.visit(ast.parse(path.read_text(), filename=str(path)))
+        allowed = {q for f, q in F32_MATMUL_ALLOWLIST if f == path.name}
+        for qual, lineno in finder.hits:
+            if any(qual == a or qual.startswith(a + ".") for a in allowed):
+                continue
+            offenders.append(
+                f"{path.relative_to(ROOT)}:{lineno}: f32 matmul in {qual}")
+    assert not offenders, (
+        "f32 matmul outside the sanctioned fp32 islands (make it bf16 or "
+        "extend F32_MATMUL_ALLOWLIST with justification):\n" + "\n".join(offenders)
+    )

@@ -145,3 +145,58 @@ def test_metrics_registry_render():
     assert 'notebook_running{namespace="a"} 3.0' in text
     assert "latency_seconds_count 1" in text
     assert reg.value("requests_total", code="500") == 2.0
+
+
+def test_histogram_mean_and_timer():
+    from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_seconds", model="m")
+    assert h.mean == 0.0  # no observations yet — no ZeroDivisionError
+    h.observe(0.2)
+    h.observe(0.4)
+    assert abs(h.mean - 0.3) < 1e-9
+
+    with reg.timer("op_seconds"):
+        pass
+    timed = reg.histogram("op_seconds")
+    assert timed.total == 1 and 0.0 <= timed.mean < 1.0
+
+    # namespaced handle resolves to the same series
+    ns = reg.namespace("train")
+    with ns.timer("step_seconds"):
+        pass
+    assert reg.histogram("train_step_seconds").total == 1
+
+
+def test_step_clock_breakdown_and_compile_separation():
+    import time as _time
+
+    from kubeflow_tpu.runtime.metrics import MetricsRegistry
+    from kubeflow_tpu.tpu.profiling import StepClock
+
+    reg = MetricsRegistry()
+    clock = StepClock(metrics=reg.namespace("train"))
+    with clock.compile():
+        _time.sleep(0.02)
+    for _ in range(2):
+        with clock.data_wait():
+            _time.sleep(0.01)
+        with clock.compute():
+            _time.sleep(0.02)
+        with clock.fetch():
+            pass
+        rec = clock.end_step()
+        assert set(rec) >= {"data_wait", "compute", "fetch", "total", "other"}
+        assert rec["total"] >= rec["data_wait"] + rec["compute"] + rec["fetch"] - 1e-6
+        assert rec["other"] >= 0.0
+
+    s = clock.summary()
+    assert s["steps"] == 2.0
+    assert s["compile_s"] >= 0.02
+    # compile never charged to a step
+    assert all(rec.get("total", 0.0) < 0.5 for rec in clock.steps)
+    assert s["data_wait"] >= 0.01 and s["compute"] >= 0.02
+    # phases surfaced as histograms too
+    assert reg.histogram("train_step_compute_seconds").total == 2
+    assert reg.value("train_compile_seconds") >= 0.02
