@@ -386,6 +386,187 @@ def _bench_gpt(batch: int, seq: int):
     }
 
 
+def _multichip_mesh_sizes(n_devices: int) -> dict:
+    """Default dp x fsdp x tp x pp factorization for ``n_devices``: peel
+    off pipe, model, fsdp as factors of 2 (innermost axes smallest), data
+    absorbs the rest. Overridable per axis via BENCH_MC_{PP,TP,FSDP}."""
+    def _env(name, default):
+        try:
+            return int(os.environ.get(name) or default)
+        except ValueError:
+            return default
+
+    rest = n_devices
+    pp = _env("BENCH_MC_PP", 2 if rest % 2 == 0 else 1)
+    rest //= pp
+    tp = _env("BENCH_MC_TP", 2 if rest % 2 == 0 else 1)
+    rest //= tp
+    fs = _env("BENCH_MC_FSDP", 2 if rest % 2 == 0 else 1)
+    return {"pipe": pp, "model": tp, "fsdp": fs, "data": n_devices // (pp * tp * fs)}
+
+
+def _bench_multichip():
+    """Composed 4D (dp x fsdp x tp x pp) GPT train-step throughput across
+    ALL local devices — the multi-chip half of the bench story. Emits
+    tokens/sec/chip, weak-scaling efficiency vs a 1-chip run of the same
+    per-chip token load, the schedule's bubble fraction, and the analytic
+    per-axis comm bytes (parallel/comm.py), all surfaced through
+    StepClock/MetricsRegistry."""
+    from kubeflow_tpu.parallel import composite as composite_mod
+    from kubeflow_tpu.parallel.comm import composite_comm_bytes, composite_step_flops
+    from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
+    from kubeflow_tpu.parallel.pipeline import schedule_stats
+    from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.tpu.profiling import StepClock
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    sizes = _multichip_mesh_sizes(n_dev)
+    d_model = int(os.environ.get("BENCH_MC_DMODEL", "128"))
+    cfg = composite_mod.CompositeConfig(
+        vocab_size=int(os.environ.get("BENCH_MC_VOCAB", "512")),
+        d_model=d_model,
+        n_heads=int(os.environ.get("BENCH_MC_HEADS", "4")),
+        d_ff=int(os.environ.get("BENCH_MC_FF", str(4 * d_model))),
+        n_layers=int(os.environ.get("BENCH_MC_LAYERS", "8")),
+        seq=int(os.environ.get("BENCH_MC_SEQ", "128")),
+    )
+    num_micro = int(os.environ.get("BENCH_MC_MICRO", "8"))
+    mb = int(os.environ.get("BENCH_MC_MB", "8"))  # global microbatch size
+    virtual_stages = int(os.environ.get("BENCH_PP_VIRTUAL", "2"))
+    gather_mode = os.environ.get("BENCH_GATHER_MODE", "overlap")
+    timed_steps = int(os.environ.get("BENCH_MC_STEPS", "5"))
+    if cfg.n_layers % (sizes["pipe"] * virtual_stages):
+        virtual_stages = 1  # odd factorization: fall back to GPipe
+
+    mesh = make_mesh(MeshConfig(**sizes))
+    rng = jax.random.PRNGKey(0)
+    ids = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (num_micro, mb, cfg.seq),
+                           0, cfg.vocab_size),
+        composite_mod.batch_sharding(mesh))
+
+    clock = StepClock(metrics=METRICS.namespace("multichip"))
+
+    def timed_run(use_mesh, use_v, use_gather, use_ids, label):
+        """Compile + warm one train step on ``use_mesh``, then time
+        ``timed_steps`` chained steps per window (param updates chain, so
+        no step is dead code; windows restart from the same init)."""
+        params0 = composite_mod.init_params(rng, cfg, use_mesh,
+                                            virtual_stages=use_v)
+        with clock.compile():
+            step = composite_mod.make_train_step(
+                cfg, use_mesh, virtual_stages=use_v, gather_mode=use_gather)
+            p, loss = step(params0, use_ids)  # first call compiles
+            jax.block_until_ready(loss)
+        clock.mark()
+        results = {}
+
+        def window():
+            with clock.compute():
+                p, loss = params0, None
+                for _ in range(timed_steps):
+                    p, loss = step(p, use_ids)
+                jax.block_until_ready(loss)
+            with clock.fetch():
+                results["loss"] = float(loss)
+            clock.end_step()
+
+        def check():
+            import math
+            if not math.isfinite(results.get("loss", float("nan"))):
+                raise RuntimeError(f"non-finite {label} bench loss: {results}")
+
+        window.check = check
+        total, _times = _timed_windows(window, _repeats())
+        return total / timed_steps, results["loss"]
+
+    dt, loss = timed_run(mesh, virtual_stages, gather_mode, ids, "multichip")
+    tokens_per_step = num_micro * mb * cfg.seq
+    tok_per_chip = tokens_per_step / dt / n_dev
+
+    # Weak-scaling reference: ONE device, same per-chip token load
+    # (mb/n_dev), full model, no pipeline — what this chip would do alone.
+    scaling_efficiency = tok_1chip = None
+    mb1 = max(1, mb // n_dev)
+    if os.environ.get("BENCH_MC_1CHIP", "1") == "1":
+        mesh1 = make_mesh(MeshConfig(), devices=[devices[0]])
+        ids1 = jax.device_put(
+            jax.random.randint(jax.random.PRNGKey(1),
+                               (num_micro, mb1, cfg.seq), 0, cfg.vocab_size),
+            composite_mod.batch_sharding(mesh1))
+        dt1, _ = timed_run(mesh1, 1, "eager", ids1, "1chip")
+        tok_1chip = num_micro * mb1 * cfg.seq / dt1
+        scaling_efficiency = tok_per_chip / tok_1chip
+
+    stats = schedule_stats(num_micro, sizes["pipe"], virtual_stages)
+    stats_gpipe = schedule_stats(num_micro, sizes["pipe"], 1)
+    comm = composite_comm_bytes(cfg, mesh, num_micro, mb,
+                                virtual_stages=virtual_stages,
+                                gather_mode=gather_mode)
+    clock.note("tokens_per_sec_per_chip", tok_per_chip)
+    clock.note("bubble_fraction", stats["bubble_fraction"])
+    if scaling_efficiency is not None:
+        clock.note("scaling_efficiency", scaling_efficiency)
+    for axis, b in comm.items():
+        clock.note(f"comm_bytes_{axis}", b)
+
+    flops = composite_step_flops(cfg, tokens_per_step)
+    return {
+        "tokens_per_sec_per_chip": tok_per_chip,
+        "tokens_per_sec_1chip": tok_1chip,
+        "scaling_efficiency": scaling_efficiency,
+        "n_devices": n_dev,
+        "mesh": sizes,
+        "virtual_stages": virtual_stages,
+        "gather_mode": gather_mode,
+        "num_micro": num_micro,
+        "microbatch": mb,
+        "microbatch_1chip": mb1,
+        "seq": cfg.seq,
+        "n_layers": cfg.n_layers,
+        "d_model": cfg.d_model,
+        "bubble_fraction": stats["bubble_fraction"],
+        "bubble_fraction_gpipe": stats_gpipe["bubble_fraction"],
+        "comm_bytes_per_step": {k: round(v) for k, v in comm.items()},
+        "flops_per_step": flops,
+        "step_seconds": dt,
+        "loss": loss,
+        "step_breakdown": _step_breakdown(clock, timed_steps),
+    }
+
+
+def _run_multichip(platform: str) -> dict:
+    try:
+        r = _bench_multichip()
+        return _emit({
+            "metric": f"multichip_composite_tokens_per_sec_per_chip_{r['n_devices']}dev",
+            "value": round(r["tokens_per_sec_per_chip"], 1),
+            "unit": "tokens_per_sec_per_chip",
+            "vs_baseline": None,  # reference publishes no multichip numbers
+            "scaling_efficiency": (round(r["scaling_efficiency"], 4)
+                                   if r["scaling_efficiency"] is not None else None),
+            "tokens_per_sec_1chip": (round(r["tokens_per_sec_1chip"], 1)
+                                     if r["tokens_per_sec_1chip"] is not None else None),
+            "n_devices": r["n_devices"],
+            "mesh": r["mesh"],
+            "virtual_stages": r["virtual_stages"],
+            "gather_mode": r["gather_mode"],
+            "num_micro": r["num_micro"],
+            "microbatch": r["microbatch"],
+            "bubble_fraction": round(r["bubble_fraction"], 4),
+            "bubble_fraction_gpipe": round(r["bubble_fraction_gpipe"], 4),
+            "comm_bytes_per_step": r["comm_bytes_per_step"],
+            "loss": round(r["loss"], 4),
+            "step_breakdown": r["step_breakdown"],
+            "platform": platform,
+        })
+    except Exception as e:
+        return _emit({"metric": "multichip_composite_tokens_per_sec_per_chip",
+                      "value": 0.0, "unit": "tokens_per_sec_per_chip",
+                      "vs_baseline": None, "error": str(e)[:200]})
+
+
 def _emit(row: dict) -> dict:
     print(json.dumps(row), flush=True)
     return row
@@ -503,7 +684,8 @@ def main() -> int:
     """Default: run EVERY flagship bench, one JSON line each, then a final
     summary line holding all of them (VERDICT r3 #2: the driver keeps the
     last line — it must carry the build's actual best numbers, not just the
-    ResNet row). ``BENCH_MODEL=resnet|gpt|serving|hpo`` runs one bench only."""
+    ResNet row). ``BENCH_MODEL=resnet|gpt|serving|hpo|multichip`` runs one
+    bench only; the multichip row joins the suite when >1 device is up."""
     platform = jax.devices()[0].platform
     mode = os.environ.get("BENCH_MODEL", "all")
     if mode == "serving":
@@ -519,11 +701,17 @@ def main() -> int:
     if mode == "resnet":
         r = _run_resnet(platform)
         return 0 if not r.get("error") else 1
+    if mode == "multichip":
+        r = _run_multichip(platform)
+        return 0 if not r.get("error") else 1
 
     skip = set(filter(None, os.environ.get("BENCH_SKIP", "").split(",")))
+    benches = [("resnet", _run_resnet), ("gpt", _run_gpt),
+               ("serving", _run_serving), ("hpo", _run_hpo)]
+    if len(jax.devices()) > 1:  # multichip row only means something on >1 chip
+        benches.append(("multichip", _run_multichip))
     rows = {}
-    for name, fn in (("resnet", _run_resnet), ("gpt", _run_gpt),
-                     ("serving", _run_serving), ("hpo", _run_hpo)):
+    for name, fn in benches:
         if name in skip:
             continue
         rows[name] = fn(platform)
@@ -543,6 +731,8 @@ def main() -> int:
         "serving_decode_tokens_per_sec_b8": rows.get("serving", {}).get("value"),
         "serving_bert_p50_ms_b8": rows.get("serving", {}).get("bert_http_p50_ms_b8"),
         "hpo_trials_per_hour": rows.get("hpo", {}).get("value"),
+        "multichip_tokens_per_sec_per_chip": rows.get("multichip", {}).get("value"),
+        "multichip_scaling_efficiency": rows.get("multichip", {}).get("scaling_efficiency"),
         "platform": platform,
         "errors": {k: v["error"] for k, v in rows.items() if v.get("error")} or None,
     }

@@ -67,12 +67,36 @@ class WorkflowBuilder:
         )
         return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
 
-    def pytest(self, name: str, target: str, deps: Optional[List[str]] = None) -> DagTask:
+    def pytest(
+        self,
+        name: str,
+        target: str,
+        deps: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        extra_args: Optional[List[str]] = None,
+    ) -> DagTask:
+        """``extra_args`` go to pytest (marker filters etc.); ``env`` lands on
+        the container (virtual-device XLA flags etc.)."""
         self.workflow.add_container_template(
             name,
             TEST_IMAGE,
-            ["python", "-m", "pytest", target, "-q", "--junitxml", f"/mnt/{RESULTS_VOLUME}/{name}.xml"],
+            ["python", "-m", "pytest", target, "-q", *(extra_args or []),
+             "--junitxml", f"/mnt/{RESULTS_VOLUME}/{name}.xml"],
             working_dir=REPO_DIR,
+            env=env,
+        )
+        return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
+
+    def run(
+        self,
+        name: str,
+        command: List[str],
+        deps: Optional[List[str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> DagTask:
+        """Arbitrary in-repo command task (dryrun drivers and the like)."""
+        self.workflow.add_container_template(
+            name, TEST_IMAGE, command, working_dir=REPO_DIR, env=env
         )
         return self.workflow.add_task("e2e", DagTask(name, name, deps or ["checkout"]))
 

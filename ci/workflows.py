@@ -92,10 +92,35 @@ def platform_e2e() -> Dict:
     return b.build()
 
 
+#: env that gives the CPU-only CI worker an 8-virtual-device mesh — the same
+#: trick tests/conftest.py plays, spelled out for the container spec.
+EIGHT_DEVICE_ENV: Dict[str, str] = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def multichip_e2e() -> Dict:
+    """The multi-chip fast-path job: the composed-4D dryrun (its phase 6
+    asserts interleaved-schedule and gather-mode parity and emits the
+    multichip throughput row) plus the slow parity tests that the tier-1
+    ``-m 'not slow'`` filter excludes everywhere else."""
+    b = WorkflowBuilder("multichip-e2e")
+    b.run("dryrun-8dev", ["python", "__graft_entry__.py", "8"], env=EIGHT_DEVICE_ENV)
+    b.pytest(
+        "multichip-parity",
+        "tests/test_multichip.py",
+        env=EIGHT_DEVICE_ENV,
+        extra_args=["-m", "slow"],
+    )
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
     "platform-e2e": platform_e2e,
+    "multichip-e2e": multichip_e2e,
 }
 
 

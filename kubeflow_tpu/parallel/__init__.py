@@ -28,7 +28,10 @@ from kubeflow_tpu.parallel.sharding import (  # noqa: F401
 )
 from kubeflow_tpu.parallel.moe import MoEMlp, top_k_routing  # noqa: F401
 from kubeflow_tpu.parallel.pipeline import (  # noqa: F401
+    deinterleave_stage_params,
+    interleave_stage_params,
     pipeline_apply,
+    schedule_stats,
     stack_stage_params,
     stage_param_spec,
 )

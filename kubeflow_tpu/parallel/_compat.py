@@ -1,0 +1,48 @@
+"""Version tolerance for the manual-SPMD surface (jax 0.4 <-> 0.8).
+
+The parallelism modules are written against the modern ``jax.shard_map``
+API (``check_vma`` + ``lax.pcast`` varying-type annotations). Older
+installs (0.4.x, the floor the container images carry) expose the same
+machinery as ``jax.experimental.shard_map.shard_map`` with ``check_rep``
+and no varying-type system at all. Everything here resolves that drift in
+one place so the callers stay on the modern spelling.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+from jax import lax
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+if "check_vma" in _PARAMS:
+    _UNCHECKED = {"check_vma": False}
+elif "check_rep" in _PARAMS:  # jax 0.4.x spelling of the same escape hatch
+    _UNCHECKED = {"check_rep": False}
+else:  # pragma: no cover - future jax that dropped the knob entirely
+    _UNCHECKED = {}
+
+
+def shard_map_unchecked(
+    f: Callable[..., Any], *, mesh: Any, in_specs: Any, out_specs: Any
+) -> Callable[..., Any]:
+    """``shard_map`` with replication/varying checking off, any jax."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_UNCHECKED)
+
+
+def pcast_varying(x: Any, axes: Any) -> Any:
+    """Mark ``x`` device-varying over ``axes`` where the type system exists.
+
+    Pre-vma jax (no ``lax.pcast``) has no varying types to satisfy; the
+    value itself is already correct per-device, so pass it through.
+    """
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")

@@ -4,14 +4,25 @@ VERDICT r3 #6: the per-axis dryrun phases proved each parallelism axis as an
 island; this module composes them in ONE program on ONE mesh — the way a
 real large-model job runs (Megatron/GSPMD-style):
 
-- ``pipe``  — transformer layers split into GPipe stages
-  (parallel/pipeline.py: shard_map + ppermute microbatch streaming),
+- ``pipe``  — transformer layers split into pipeline stage chunks
+  (parallel/pipeline.py: shard_map + ppermute microbatch streaming; GPipe
+  or, with ``virtual_stages>1``, the interleaved schedule),
 - ``model`` — Megatron tensor parallelism INSIDE each stage, written as
   manual SPMD: column-split QKV/W1 (no comm), row-split WO/W2 followed by
   one ``psum`` over the ``model`` axis per sublayer,
-- ``fsdp``  — ZeRO-3: weight shards live split over ``fsdp``; each stage
-  ``all_gather``s a weight right before use, and autodiff transposes that
-  gather into the gradient ``reduce_scatter``,
+- ``fsdp``  — ZeRO-3: weight shards live split over ``fsdp``; gathers run
+  in one of three modes (``gather_mode``):
+    * ``"eager"``     — gather each weight right before use, once per layer
+      per microbatch (the baseline; autodiff transposes each gather into a
+      per-microbatch gradient ``reduce_scatter``),
+    * ``"overlap"``   — the per-stage layer loop is a ``lax.scan`` with a
+      double-buffered carry that prefetches layer i+1's ``all_gather``
+      while layer i computes, hiding gather latency behind the matmuls,
+    * ``"amortized"`` — all chunk weights gather ONCE per train step via
+      the pipeline's ``stage_prepare`` hook; the gathered tree is a scan
+      constant, so cotangents accumulate across microbatches and each
+      weight sees ONE transposed reduce-scatter per step (no_sync-style,
+      ~M x less fsdp traffic at peak-memory cost of the gathered chunk).
 - ``data``/``fsdp`` — the microbatch dim of the input stream is sharded
   over both batch axes (mesh.BATCH_AXES); gradient all-reduce over them is
   placed by autodiff through the shard_map.
@@ -19,6 +30,9 @@ real large-model job runs (Megatron/GSPMD-style):
 Embedding/unembedding run OUTSIDE the pipeline under ordinary GSPMD jit
 (vocab sharded over ``model``), so the program also exercises the
 shard_map <-> GSPMD boundary in both directions.
+
+All gather modes and both schedules are numerically equivalent (same math,
+different comm placement); tests/test_multichip.py asserts the parities.
 
 The reference has no in-tree parallelism at all (SURVEY.md §2.10); this is
 the in-workload half of the TPU-native build. Checkpoint/resume across a
@@ -37,7 +51,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import AXIS_FSDP, AXIS_MODEL, AXIS_PIPE, BATCH_AXES
-from .pipeline import pipeline_apply
+from .pipeline import interleave_stage_params, pipeline_apply
+
+GATHER_MODES = ("eager", "overlap", "amortized")
 
 
 @dataclass(frozen=True)
@@ -46,7 +62,7 @@ class CompositeConfig:
     d_model: int = 32
     n_heads: int = 4
     d_ff: int = 64
-    n_layers: int = 4  # must divide by mesh pipe size
+    n_layers: int = 4  # must divide by mesh pipe size * virtual_stages
     seq: int = 16
 
 
@@ -57,33 +73,53 @@ def _param_specs(cfg: CompositeConfig) -> Dict[str, Any]:
     return {
         "ln1_scale": P(AXIS_PIPE, None, None),
         "ln2_scale": P(AXIS_PIPE, None, None),
-        # [S, L, d, 3, d]: the qkv role dim is explicit and UNsharded — a
+        # [S*V, L, d, 3, d]: the qkv role dim is explicit and UNsharded — a
         # flat [d, 3d] column-shard would hand device 0 "all of q plus half
         # of k" and silently change the math between factorizations.
         "wqkv": P(AXIS_PIPE, None, AXIS_FSDP, None, AXIS_MODEL),
-        "wo": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S, L, d/tp, d]
-        "w1": P(AXIS_PIPE, None, AXIS_FSDP, AXIS_MODEL),    # [S, L, d, ff/tp]
-        "w2": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S, L, ff/tp, d]
+        "wo": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S*V, L, d/tp, d]
+        "w1": P(AXIS_PIPE, None, AXIS_FSDP, AXIS_MODEL),    # [S*V, L, d, ff/tp]
+        "w2": P(AXIS_PIPE, None, AXIS_MODEL, AXIS_FSDP),    # [S*V, L, ff/tp, d]
     }
 
 
-def init_params(rng: jax.Array, cfg: CompositeConfig, mesh: Mesh) -> Dict[str, Any]:
-    """Global (sharded) param pytree: embed + stacked per-stage blocks."""
+def init_params(
+    rng: jax.Array, cfg: CompositeConfig, mesh: Mesh, *, virtual_stages: int = 1
+) -> Dict[str, Any]:
+    """Global (sharded) param pytree: embed + stacked per-chunk blocks.
+
+    Weights are drawn in canonical per-layer shape [n_layers, ...] and then
+    reshaped into pp*V chunks, so the logical model is IDENTICAL across
+    every (pp, virtual_stages) factorization — the parity tests and
+    cross-factorization checkpoint resume depend on that. For V > 1 the
+    chunk rows are permuted to the device-major round-robin layout
+    :func:`kubeflow_tpu.parallel.pipeline.pipeline_apply` expects.
+    """
     pp = mesh.shape[AXIS_PIPE]
-    if cfg.n_layers % pp:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pipe={pp}")
-    lps = cfg.n_layers // pp
-    d, ff = cfg.d_model, cfg.d_ff
+    chunks = pp * virtual_stages
+    if cfg.n_layers % chunks:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by "
+            f"pipe={pp} * virtual_stages={virtual_stages}"
+        )
+    lpc = cfg.n_layers // chunks  # layers per stage chunk
+    d, ff, nl = cfg.d_model, cfg.d_ff, cfg.n_layers
     ks = jax.random.split(rng, 5)
     scale = d ** -0.5
+
+    def chunked(w):
+        return w.reshape((chunks, lpc) + w.shape[1:])
+
     stages = {
-        "ln1_scale": jnp.ones((pp, lps, d), jnp.float32),
-        "ln2_scale": jnp.ones((pp, lps, d), jnp.float32),
-        "wqkv": jax.random.normal(ks[0], (pp, lps, d, 3, d), jnp.float32) * scale,
-        "wo": jax.random.normal(ks[1], (pp, lps, d, d), jnp.float32) * scale,
-        "w1": jax.random.normal(ks[2], (pp, lps, d, ff), jnp.float32) * scale,
-        "w2": jax.random.normal(ks[3], (pp, lps, ff, d), jnp.float32) * (ff ** -0.5),
+        "ln1_scale": jnp.ones((chunks, lpc, d), jnp.float32),
+        "ln2_scale": jnp.ones((chunks, lpc, d), jnp.float32),
+        "wqkv": chunked(jax.random.normal(ks[0], (nl, d, 3, d), jnp.float32) * scale),
+        "wo": chunked(jax.random.normal(ks[1], (nl, d, d), jnp.float32) * scale),
+        "w1": chunked(jax.random.normal(ks[2], (nl, d, ff), jnp.float32) * scale),
+        "w2": chunked(jax.random.normal(ks[3], (nl, ff, d), jnp.float32) * (ff ** -0.5)),
     }
+    if virtual_stages > 1:
+        stages = interleave_stage_params(stages, pp, virtual_stages)
     specs = _param_specs(cfg)
     stages = {
         k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in stages.items()
@@ -105,72 +141,165 @@ def param_shardings(cfg: CompositeConfig, mesh: Mesh) -> Dict[str, Any]:
     }
 
 
-def _stage_fn(cfg: CompositeConfig, p: Dict[str, jax.Array], h: jax.Array) -> jax.Array:
-    """One pipeline stage = lps transformer blocks, manual SPMD.
+def _gather_layer(wqkv_l, wo_l, w1_l, w2_l):
+    """all_gather one layer's fsdp weight shards to full (tp-local) size.
 
-    ``p`` leaves are LOCAL shards [1, lps, ...] (stage dim stripped by the
-    pipeline body caller); ``h`` is the local microbatch [mb_local, seq, d].
-    """
-    def block(h, layer):
-        ln1, ln2, wqkv_l, wo_l, w1_l, w2_l = layer
-        # fsdp: gather the weight shard right before use; grad transposes to
-        # reduce_scatter (ZeRO-3). tiled=True concatenates along the dim.
-        wqkv = lax.all_gather(wqkv_l, AXIS_FSDP, axis=0, tiled=True)   # [d, 3, d/tp]
-        wo = lax.all_gather(wo_l, AXIS_FSDP, axis=1, tiled=True)       # [d/tp, d]
-        w1 = lax.all_gather(w1_l, AXIS_FSDP, axis=0, tiled=True)       # [d, ff/tp]
-        w2 = lax.all_gather(w2_l, AXIS_FSDP, axis=1, tiled=True)       # [ff/tp, d]
+    Autodiff transposes each tiled gather into a gradient reduce_scatter —
+    the ZeRO-3 contract."""
+    return (
+        lax.all_gather(wqkv_l, AXIS_FSDP, axis=0, tiled=True),  # [d, 3, d/tp]
+        lax.all_gather(wo_l, AXIS_FSDP, axis=1, tiled=True),    # [d/tp, d]
+        lax.all_gather(w1_l, AXIS_FSDP, axis=0, tiled=True),    # [d, ff/tp]
+        lax.all_gather(w2_l, AXIS_FSDP, axis=1, tiled=True),    # [ff/tp, d]
+    )
 
-        def ln(x, scale):
-            mu = x.mean(-1, keepdims=True)
-            var = ((x - mu) ** 2).mean(-1, keepdims=True)
-            return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
 
-        # attention: column-split QKV -> local heads; causal; row-split WO
-        x = ln(h, ln1)
-        qkv = jnp.einsum("bsd,drh->bsrh", x, wqkv)       # [mb, s, 3, d/tp]
-        dl = qkv.shape[-1]                               # d/tp local width
-        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        hd = cfg.d_model // cfg.n_heads
-        nh = dl // hd                                    # local heads
-        mb, s, _ = q.shape
-        q = q.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
-        k = k.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
-        v = v.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
-        scores = (q @ k.transpose(0, 1, 3, 2)) * (hd ** -0.5)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask, scores, -1e30)
-        attn = jax.nn.softmax(scores, axis=-1) @ v       # [mb, nh, s, hd]
-        attn = attn.transpose(0, 2, 1, 3).reshape(mb, s, dl)
-        # row-split output proj: partial sums reduced over the model axis
-        h = h + lax.psum(attn @ wo, AXIS_MODEL)
-        # mlp: column-split W1 (no comm), row-split W2 (+psum)
-        x = ln(h, ln2)
-        h = h + lax.psum(jax.nn.gelu(x @ w1) @ w2, AXIS_MODEL)
-        return h, None
+def _block(cfg: CompositeConfig, h, ln1, ln2, wqkv, wo, w1, w2):
+    """One transformer block, weights fully gathered over fsdp (still
+    tp-local): Megatron column/row splits with one psum per sublayer."""
 
-    layers = (p["ln1_scale"], p["ln2_scale"], p["wqkv"], p["wo"], p["w1"], p["w2"])
-    h, _ = lax.scan(block, h, layers)
+    def ln(x, scale):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale
+
+    # attention: column-split QKV -> local heads; causal; row-split WO
+    x = ln(h, ln1)
+    qkv = jnp.einsum("bsd,drh->bsrh", x, wqkv)       # [mb, s, 3, d/tp]
+    dl = qkv.shape[-1]                               # d/tp local width
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    hd = cfg.d_model // cfg.n_heads
+    nh = dl // hd                                    # local heads
+    mb, s, _ = q.shape
+    q = q.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(mb, s, nh, hd).transpose(0, 2, 1, 3)
+    scores = (q @ k.transpose(0, 1, 3, 2)) * (hd ** -0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    attn = jax.nn.softmax(scores, axis=-1) @ v       # [mb, nh, s, hd]
+    attn = attn.transpose(0, 2, 1, 3).reshape(mb, s, dl)
+    # row-split output proj: partial sums reduced over the model axis
+    h = h + lax.psum(attn @ wo, AXIS_MODEL)
+    # mlp: column-split W1 (no comm), row-split W2 (+psum)
+    x = ln(h, ln2)
+    h = h + lax.psum(jax.nn.gelu(x @ w1) @ w2, AXIS_MODEL)
     return h
 
 
-def make_train_step(cfg: CompositeConfig, mesh: Mesh, lr: float = 0.1):
+def _stage_fn(
+    cfg: CompositeConfig,
+    p: Dict[str, jax.Array],
+    h: jax.Array,
+    *,
+    gather_mode: str = "eager",
+) -> jax.Array:
+    """One pipeline stage chunk = lpc transformer blocks, manual SPMD.
+
+    ``p`` leaves are LOCAL shards [lpc, ...] (chunk dim already selected by
+    the pipeline body); ``h`` is the local microbatch [mb_local, seq, d].
+    ``gather_mode`` picks where the fsdp all_gathers run: per-layer at use
+    ("eager"), prefetched one layer ahead in a double-buffered scan carry
+    ("overlap"), or not at all because the caller pre-gathered via
+    ``stage_prepare`` ("pregathered", the amortized path).
+    """
+    lns = (p["ln1_scale"], p["ln2_scale"])
+    ws = (p["wqkv"], p["wo"], p["w1"], p["w2"])
+
+    if gather_mode == "overlap":
+        lpc = p["ln1_scale"].shape[0]
+
+        def gather_at(i):
+            return _gather_layer(
+                *(lax.dynamic_index_in_dim(w, i, keepdims=False) for w in ws)
+            )
+
+        def body(carry, i):
+            h, g = carry
+            # Issue layer i+1's gathers BEFORE touching layer i's weights:
+            # the collectives have no data dependence on the block compute,
+            # so the compiler can run them concurrently (async collectives
+            # on TPU), hiding gather latency behind the matmuls. The final
+            # iteration prefetches a clamped duplicate that is discarded.
+            g_next = gather_at(jnp.minimum(i + 1, lpc - 1))
+            ln1, ln2 = (
+                lax.dynamic_index_in_dim(s, i, keepdims=False) for s in lns
+            )
+            h = _block(cfg, h, ln1, ln2, *g)
+            return (h, g_next), None
+
+        (h, _), _ = lax.scan(body, (h, gather_at(0)), jnp.arange(lpc))
+        return h
+
+    def block(h, layer):
+        ln1, ln2, wqkv_l, wo_l, w1_l, w2_l = layer
+        if gather_mode == "pregathered":
+            wqkv, wo, w1, w2 = wqkv_l, wo_l, w1_l, w2_l
+        else:  # eager: gather the weight shard right before use (ZeRO-3)
+            wqkv, wo, w1, w2 = _gather_layer(wqkv_l, wo_l, w1_l, w2_l)
+        return _block(cfg, h, ln1, ln2, wqkv, wo, w1, w2), None
+
+    h, _ = lax.scan(block, h, lns + ws)
+    return h
+
+
+def _stage_prepare_fn(p: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Amortized-mode hook: gather ALL chunk weights once per train step.
+
+    Runs inside the pipeline's shard_map before the time scan, on local
+    leaves [V, lpc, ...] — the fsdp-sharded axes sit one dim further right
+    than in the per-layer gathers. The prepared tree is a scan constant:
+    each weight's gradient reduce-scatter runs once per step instead of
+    once per microbatch."""
+    return {
+        "ln1_scale": p["ln1_scale"],
+        "ln2_scale": p["ln2_scale"],
+        "wqkv": lax.all_gather(p["wqkv"], AXIS_FSDP, axis=2, tiled=True),
+        "wo": lax.all_gather(p["wo"], AXIS_FSDP, axis=3, tiled=True),
+        "w1": lax.all_gather(p["w1"], AXIS_FSDP, axis=2, tiled=True),
+        "w2": lax.all_gather(p["w2"], AXIS_FSDP, axis=3, tiled=True),
+    }
+
+
+def make_train_step(
+    cfg: CompositeConfig,
+    mesh: Mesh,
+    lr: float = 0.1,
+    *,
+    virtual_stages: int = 1,
+    gather_mode: str = "eager",
+    mask_bubbles: bool = True,
+):
     """jit-able (params, ids[M, mb, seq]) -> (params, loss): one SGD step of
-    next-token CE under the full dp x fsdp x tp x pp composition."""
+    next-token CE under the full dp x fsdp x tp x pp composition.
+
+    ``virtual_stages``/``gather_mode``/``mask_bubbles`` pick the schedule
+    and comm placement (see module docstring); every combination computes
+    the same math. ``params`` must come from :func:`init_params` with the
+    same ``virtual_stages``.
+    """
+    if gather_mode not in GATHER_MODES:
+        raise ValueError(f"gather_mode must be one of {GATHER_MODES}, got {gather_mode!r}")
     batch_spec = P(None, BATCH_AXES, None)  # [M, mb, seq]
     h_spec = P(None, BATCH_AXES, None, None)  # [M, mb, seq, d]
     specs = _param_specs(cfg)
+    inner_mode = "pregathered" if gather_mode == "amortized" else gather_mode
+    stage_prepare = _stage_prepare_fn if gather_mode == "amortized" else None
 
     def loss_fn(params, ids):
         # GSPMD region: embedding lookup, vocab sharded over `model`
         h = jnp.take(params["embed"], ids, axis=0)  # [M, mb, s, d]
         h = pipeline_apply(
-            lambda p, hh: _stage_fn(cfg, p, hh),
+            lambda p, hh: _stage_fn(cfg, p, hh, gather_mode=inner_mode),
             params["stages"],
             h,
             mesh,
             param_specs={k: specs[k] for k in params["stages"]},
             x_spec=h_spec,
             out_spec=h_spec,
+            virtual_stages=virtual_stages,
+            mask_bubbles=mask_bubbles,
+            stage_prepare=stage_prepare,
         )
         logits = h @ params["embed"].T  # [M, mb, s, vocab]
         targets = jnp.roll(ids, -1, axis=-1)

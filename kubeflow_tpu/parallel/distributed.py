@@ -52,15 +52,29 @@ def ordinal_from_hostname(hostname: Optional[str] = None) -> int:
     return int(m.group(1)) if m else 0
 
 
+def _int_env(var: str, raw: str) -> int:
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{var}={raw!r} is not an integer; fix the env injected on this "
+            "pod (TPU PodDefault webhook output)"
+        ) from None
+
+
 def identity_from_env(environ: Optional[dict] = None, hostname: Optional[str] = None) -> WorkerIdentity:
     env = os.environ if environ is None else environ
-    num = int(env.get(ENV_NUM_PROCESSES, "1"))
+    num = _int_env(ENV_NUM_PROCESSES, env.get(ENV_NUM_PROCESSES, "1"))
     if num <= 1:
         # Single-process: hostname ordinals are meaningless ('tpu-vm-1' is not
         # worker 1 of anything) — always process 0.
         return WorkerIdentity(process_id=0, num_processes=1, coordinator_address=None)
     explicit = env.get(ENV_PROCESS_ID)
-    pid = int(explicit) if explicit is not None else ordinal_from_hostname(hostname)
+    pid = (
+        _int_env(ENV_PROCESS_ID, explicit)
+        if explicit is not None
+        else ordinal_from_hostname(hostname)
+    )
     coord = env.get(ENV_COORDINATOR_ADDRESS)
     if pid >= num:
         raise ValueError(f"worker ordinal {pid} >= num_processes {num}")
@@ -68,6 +82,15 @@ def identity_from_env(environ: Optional[dict] = None, hostname: Optional[str] = 
 
 
 _initialized = False
+
+
+def reset_initialized_for_testing() -> None:
+    """Forget that :func:`initialize` ran, so tests can exercise the
+    bootstrap path more than once per process (with a stubbed
+    ``jax.distributed.initialize``). Never call this in production — the
+    underlying JAX cluster cannot actually be re-initialized."""
+    global _initialized
+    _initialized = False
 
 
 def initialize(environ: Optional[dict] = None, hostname: Optional[str] = None) -> WorkerIdentity:

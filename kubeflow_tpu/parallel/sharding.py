@@ -102,6 +102,11 @@ def _infer_logical_axes(path: Tuple[Any, ...], leaf: jax.Array) -> Tuple[Optiona
     if "expert" in parent and rank >= 3:
         # MoE stacked expert kernels [num_experts, in, out].
         return ("expert",) + (None,) * (rank - 1)
+    if parent in ("router", "gate", "gating") or "router" in parent:
+        # MoE router kernel [embed, num_experts]: tiny, and its output
+        # feeds a per-token argmax/top-k — shard nothing. (The substring
+        # "gate" alone must NOT land here: "gate_proj" is an MLP kernel.)
+        return (None,) * rank
     if any(k in parent for k in ("mlp", "intermediate", "wi", "up_proj", "gate")):
         return (None,) * (rank - 1) + ("mlp",)
     if rank == 2:

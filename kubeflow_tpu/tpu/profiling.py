@@ -89,8 +89,17 @@ class StepClock:
         self._metrics = metrics
         self.compile_s = 0.0
         self.steps: List[Dict[str, float]] = []
+        self.notes: Dict[str, float] = {}
         self._current: Dict[str, float] = {}
         self._anchor = time.perf_counter()
+
+    def note(self, key: str, value: float) -> None:
+        """Attach a derived scalar (analytic comm bytes, bubble fraction —
+        things computed about the step rather than timed in it) so it rides
+        along in ``summary()``/metrics next to the measured phases."""
+        self.notes[key] = float(value)
+        if self._metrics is not None:
+            self._metrics.gauge(key).set(float(value))
 
     @contextmanager
     def phase(self, name: str):
@@ -153,6 +162,7 @@ class StepClock:
             n = len(self.steps)
             for k in keys:
                 out[k] = sum(s.get(k, 0.0) for s in self.steps) / n
+        out.update(self.notes)
         out["compile_s"] = self.compile_s
         out["steps"] = float(len(self.steps))
         return out

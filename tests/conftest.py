@@ -27,6 +27,14 @@ from kubeflow_tpu.runtime.manager import Manager  # noqa: E402
 from kubeflow_tpu.runtime.metrics import METRICS  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute 8-device end-to-end tests; tier-1 excludes them "
+        "with -m 'not slow', the multichip CI job runs them",
+    )
+
+
 @pytest.fixture()
 def store():
     return Store()

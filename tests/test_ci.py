@@ -93,6 +93,22 @@ def test_platform_e2e_orders_builds_before_drivers():
         assert "build-controlplane" in deps, f"{driver} must wait for the image build"
 
 
+def test_multichip_job_forces_eight_virtual_devices():
+    """The multichip job is only meaningful on an 8-device mesh; both its
+    tasks must carry the virtual-device env and the slow-marker filter that
+    tier-1 excludes."""
+    spec = WORKFLOWS["multichip-e2e"]()
+    templates = {t["name"]: t for t in spec["spec"]["templates"]}
+    for task in ("dryrun-8dev", "multichip-parity"):
+        env = {e["name"]: e["value"] for e in templates[task]["container"]["env"]}
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    parity_cmd = templates["multichip-parity"]["container"]["command"]
+    assert "tests/test_multichip.py" in parity_cmd
+    assert parity_cmd[parity_cmd.index("slow") - 1] == "-m"
+    assert "__graft_entry__.py" in templates["dryrun-8dev"]["container"]["command"]
+
+
 def test_prow_config_resolves():
     cfg = yaml.safe_load((REPO / "ci" / "prow_config.yaml").read_text())
     for section in ("presubmits", "postsubmits", "periodics"):

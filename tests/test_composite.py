@@ -12,7 +12,15 @@ import numpy as np
 import pytest
 
 from kubeflow_tpu.parallel import MeshConfig, make_mesh
+from kubeflow_tpu.parallel.comm import (
+    composite_comm_bytes,
+    composite_param_count,
+    composite_step_flops,
+    ring_allgather_bytes,
+    ring_allreduce_bytes,
+)
 from kubeflow_tpu.parallel.composite import (
+    GATHER_MODES,
     CompositeConfig,
     batch_sharding,
     init_params,
@@ -87,3 +95,83 @@ def test_rejects_indivisible_layers():
     mesh = make_mesh(MeshConfig(data=2, pipe=4))
     with pytest.raises(ValueError, match="not divisible"):
         init_params(jax.random.PRNGKey(0), CompositeConfig(n_layers=3), mesh)
+
+
+def test_rejects_indivisible_virtual_stages():
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    with pytest.raises(ValueError, match="virtual_stages=3"):
+        init_params(jax.random.PRNGKey(0), CFG, mesh, virtual_stages=3)
+
+
+def test_rejects_unknown_gather_mode():
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    with pytest.raises(ValueError, match="gather_mode"):
+        make_train_step(CFG, mesh, gather_mode="lazy")
+
+
+def test_interleaved_schedule_matches_gpipe():
+    """virtual_stages=2 must reproduce the V=1 loss trajectory: same logical
+    model by construction (init draws canonical [n_layers, ...] weights),
+    same arithmetic by the interleaved-schedule correctness argument."""
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+    ids = jax.device_put(
+        jax.random.randint(jax.random.PRNGKey(1), (4, 8, CFG.seq), 0, CFG.vocab_size),
+        batch_sharding(mesh),
+    )
+    losses = {}
+    for v in (1, 2):
+        params = init_params(jax.random.PRNGKey(0), CFG, mesh, virtual_stages=v)
+        step = make_train_step(CFG, mesh, virtual_stages=v)
+        ls = []
+        for _ in range(2):
+            params, loss = step(params, ids)
+            ls.append(float(loss))
+        losses[v] = ls
+    np.testing.assert_allclose(losses[2], losses[1], rtol=1e-5, atol=1e-5)
+
+
+class TestCommModel:
+    """parallel/comm.py — the analytic bytes the multichip bench reports."""
+
+    def test_ring_primitives(self):
+        assert ring_allgather_bytes(100.0, 1) == 0.0
+        assert ring_allgather_bytes(100.0, 4) == pytest.approx(75.0)
+        assert ring_allreduce_bytes(100.0, 4) == pytest.approx(150.0)
+
+    def test_param_count_matches_init(self):
+        mesh = make_mesh(MeshConfig(data=8))
+        params = init_params(jax.random.PRNGKey(0), CFG, mesh)
+        got = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+        assert composite_param_count(CFG) == got
+
+    def test_flops_positive_and_scale_with_tokens(self):
+        assert composite_step_flops(CFG, 2048) == pytest.approx(
+            2 * composite_step_flops(CFG, 1024)
+        )
+
+    def test_gather_mode_ordering(self):
+        """amortized gathers each weight once per step; eager once per
+        microbatch; overlap pays one extra clamped prefetch on top of eager."""
+        mesh = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+        by_mode = {
+            m: composite_comm_bytes(CFG, mesh, 8, 8, gather_mode=m)
+            for m in GATHER_MODES
+        }
+        assert by_mode["amortized"]["fsdp"] < by_mode["eager"]["fsdp"] < by_mode["overlap"]["fsdp"]
+        # the gather mode only moves fsdp traffic
+        for axis in ("pipe", "model", "data"):
+            assert by_mode["eager"][axis] == by_mode["overlap"][axis] == by_mode["amortized"][axis]
+        for row in by_mode.values():
+            assert row["total"] == pytest.approx(sum(row[a] for a in ("pipe", "fsdp", "model", "data")))
+
+    def test_trivial_axes_cost_nothing(self):
+        mesh = make_mesh(MeshConfig(data=8))
+        row = composite_comm_bytes(CFG, mesh, 8, 8)
+        assert row["pipe"] == row["fsdp"] == row["model"] == 0.0
+        assert row["data"] > 0.0
+
+    def test_interleaving_trades_pipe_bytes_for_bubble(self):
+        mesh = make_mesh(MeshConfig(data=1, fsdp=2, model=2, pipe=2))
+        v1 = composite_comm_bytes(CFG, mesh, 8, 8, virtual_stages=1)
+        v2 = composite_comm_bytes(CFG, mesh, 8, 8, virtual_stages=2)
+        assert v2["pipe"] > v1["pipe"]  # V-1 extra ring traversals

@@ -19,7 +19,9 @@ from kubeflow_tpu.parallel import (
 )
 from kubeflow_tpu.parallel.distributed import (
     identity_from_env,
+    initialize,
     ordinal_from_hostname,
+    reset_initialized_for_testing,
 )
 from kubeflow_tpu.parallel.mesh import global_batch_divisor
 from kubeflow_tpu.parallel.ring_attention import full_attention, ring_attention
@@ -27,6 +29,7 @@ from kubeflow_tpu.parallel.sharding import (
     FSDP_RULES,
     TENSOR_PARALLEL_RULES,
     LogicalRules,
+    shard_pytree,
 )
 from kubeflow_tpu.tpu.env import jax_worker_env, env_list_to_dict
 from kubeflow_tpu.tpu.topology import parse_topology
@@ -94,6 +97,70 @@ class TestDistributedBootstrap:
     def test_ordinal_out_of_range(self):
         with pytest.raises(ValueError):
             identity_from_env({"JAX_NUM_PROCESSES": "2"}, hostname="nb-5")
+
+    def test_non_integer_num_processes_names_the_var(self):
+        """A mangled webhook env must say WHICH var is broken, not just
+        'invalid literal for int()'."""
+        with pytest.raises(ValueError, match="JAX_NUM_PROCESSES='two'"):
+            identity_from_env({"JAX_NUM_PROCESSES": "two"}, hostname="nb-0")
+
+    def test_non_integer_worker_id_names_the_var(self):
+        env = {
+            "JAX_NUM_PROCESSES": "4",
+            "TPU_WORKER_ID": "one",
+            "JAX_COORDINATOR_ADDRESS": "nb-0.nb.ns.svc:8476",
+        }
+        with pytest.raises(ValueError, match="TPU_WORKER_ID='one'"):
+            identity_from_env(env, hostname="nb-1")
+
+    def test_initialize_idempotent_until_reset(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize", lambda **kw: calls.append(kw)
+        )
+        env = {
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_COORDINATOR_ADDRESS": "nb-0.nb.ns.svc:8476",
+        }
+        reset_initialized_for_testing()
+        try:
+            ident = initialize(env, hostname="nb-1")
+            assert ident.process_id == 1 and len(calls) == 1
+            assert calls[0]["coordinator_address"] == "nb-0.nb.ns.svc:8476"
+            initialize(env, hostname="nb-1")  # second call is a no-op
+            assert len(calls) == 1
+            reset_initialized_for_testing()  # ... until the test hook resets
+            initialize(env, hostname="nb-1")
+            assert len(calls) == 2
+        finally:
+            reset_initialized_for_testing()
+
+
+class TestRouterReplication:
+    """MoE router/gate kernels must REPLICATE under tensor parallelism: their
+    output feeds a per-token top-k and sharding the tiny [embed, n_experts]
+    kernel over `mlp` would split the expert dim across chips for nothing."""
+
+    def test_router_and_gate_kernels_replicate(self):
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+        params = {
+            "router": {"kernel": jnp.zeros((16, 8))},
+            "gate": {"kernel": jnp.zeros((16, 8))},
+            "gating": {"kernel": jnp.zeros((16, 8))},
+            "moe_router": {"kernel": jnp.zeros((16, 8))},
+        }
+        sh = shard_pytree(params, mesh, TENSOR_PARALLEL_RULES)
+        for name in params:
+            assert sh[name]["kernel"].spec == jax.sharding.PartitionSpec(None, None), name
+
+    def test_gate_proj_is_still_an_mlp_kernel(self):
+        """The regression's other half: 'gate_proj' (LLaMA naming) contains
+        'gate' but is a real MLP kernel and must keep its tensor split."""
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, model=2))
+        sh = shard_pytree(
+            {"gate_proj": {"kernel": jnp.zeros((16, 32))}}, mesh, TENSOR_PARALLEL_RULES
+        )
+        assert sh["gate_proj"]["kernel"].spec == jax.sharding.PartitionSpec(None, "model")
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -14,8 +14,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kubeflow_tpu.parallel import (
     MeshConfig,
     MoEMlp,
+    deinterleave_stage_params,
+    interleave_stage_params,
     make_mesh,
     pipeline_apply,
+    schedule_stats,
     stack_stage_params,
     top_k_routing,
 )
@@ -75,6 +78,106 @@ class TestPipeline:
         x = jnp.zeros((4, 2, 8))  # 4 microbatches < 8 stages
         with pytest.raises(ValueError):
             pipeline_apply(_mlp_stage(), stages, x, mesh)
+
+
+class TestInterleavedPipeline:
+    """virtual_stages > 1: Megatron-style interleaved schedule."""
+
+    def _sequential(self, stages, x):
+        fn = _mlp_stage()
+        h = x
+        for p in stages:
+            h = fn(p, h)
+        return h
+
+    def test_forward_matches_sequential(self):
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = _stages(8, 16, jax.random.PRNGKey(5))  # S=4 devices x V=2 chunks
+        stacked = interleave_stage_params(stack_stage_params(stages), 4, 2)
+        x = jax.random.normal(jax.random.PRNGKey(6), (8, 4, 16))
+        out = pipeline_apply(_mlp_stage(), stacked, x, mesh, virtual_stages=2)
+        np.testing.assert_allclose(out, self._sequential(stages, x), atol=1e-5, rtol=1e-5)
+
+    def test_gradients_match_sequential_at_m_equals_s(self):
+        """M == S is the circular-buffer boundary case; grads must survive it."""
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = _stages(8, 8, jax.random.PRNGKey(7))
+        natural = stack_stage_params(stages)
+        x = jax.random.normal(jax.random.PRNGKey(8), (4, 2, 8))  # 4 microbatches == 4 stages
+
+        def loss_pipe(s):
+            inter = interleave_stage_params(s, 4, 2)
+            return jnp.sum(pipeline_apply(_mlp_stage(), inter, x, mesh, virtual_stages=2) ** 2)
+
+        def loss_ref(s):
+            h = x
+            for i in range(8):
+                h = _mlp_stage()(jax.tree_util.tree_map(lambda l: l[i], s), h)
+            return jnp.sum(h**2)
+
+        g1 = jax.grad(loss_pipe)(natural)
+        g2 = jax.grad(loss_ref)(natural)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4), g1, g2
+        )
+
+    def test_fewer_microbatches_than_stages_rejected(self):
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = interleave_stage_params(
+            stack_stage_params(_stages(8, 8, jax.random.PRNGKey(9))), 4, 2
+        )
+        x = jnp.zeros((3, 2, 8))  # 3 microbatches < 4 stages
+        with pytest.raises(ValueError, match="at least as many microbatches"):
+            pipeline_apply(_mlp_stage(), stages, x, mesh, virtual_stages=2)
+
+    def test_wrong_leading_dim_names_the_requirement(self):
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = stack_stage_params(_stages(4, 8, jax.random.PRNGKey(10)))  # 4 != 4*2
+        x = jnp.zeros((8, 2, 8))
+        with pytest.raises(ValueError, match=r"n_stages\*virtual_stages"):
+            pipeline_apply(_mlp_stage(), stages, x, mesh, virtual_stages=2)
+
+    def test_virtual_stages_must_be_positive(self):
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = stack_stage_params(_stages(4, 8, jax.random.PRNGKey(11)))
+        with pytest.raises(ValueError, match="virtual_stages"):
+            pipeline_apply(_mlp_stage(), stages, jnp.zeros((8, 2, 8)), mesh, virtual_stages=0)
+
+    def test_interleave_roundtrip(self):
+        stacked = stack_stage_params(_stages(8, 4, jax.random.PRNGKey(12)))
+        inter = interleave_stage_params(stacked, 4, 2)
+        # the layout really is permuted (row 1 holds chunk 4, not chunk 1) ...
+        assert not np.allclose(np.asarray(inter["w"][1]), np.asarray(stacked["w"][1]))
+        np.testing.assert_array_equal(np.asarray(inter["w"][1]), np.asarray(stacked["w"][4]))
+        # ... and deinterleave inverts it exactly
+        back = deinterleave_stage_params(inter, 4, 2)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            back,
+            stacked,
+        )
+
+    def test_mask_bubbles_is_bit_exact(self):
+        mesh = make_mesh(MeshConfig(data=2, pipe=4))
+        stages = interleave_stage_params(
+            stack_stage_params(_stages(8, 8, jax.random.PRNGKey(13))), 4, 2
+        )
+        x = jax.random.normal(jax.random.PRNGKey(14), (8, 2, 8))
+        masked = pipeline_apply(
+            _mlp_stage(), stages, x, mesh, virtual_stages=2, mask_bubbles=True
+        )
+        unmasked = pipeline_apply(
+            _mlp_stage(), stages, x, mesh, virtual_stages=2, mask_bubbles=False
+        )
+        np.testing.assert_array_equal(np.asarray(masked), np.asarray(unmasked))
+
+    def test_schedule_stats_bubble_shrinks_with_virtual_stages(self):
+        v1 = schedule_stats(8, 4, 1)
+        v2 = schedule_stats(8, 4, 2)
+        assert v1["total_steps"] == 11 and v2["total_steps"] == 19
+        assert v1["bubble_fraction"] == pytest.approx(3 / 11)
+        assert v2["bubble_fraction"] == pytest.approx(3 / 19)
+        assert v2["bubble_fraction"] < v1["bubble_fraction"]
 
 
 class TestRouting:
