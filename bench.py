@@ -108,6 +108,7 @@ def _bench(batch: int):
     from kubeflow_tpu.training import ClassifierTask, mfu
     from kubeflow_tpu.training.classifier import sgd_momentum
     from kubeflow_tpu.training.flops import compiled_with_cost, detect_generation
+    from kubeflow_tpu.runtime.tracing import TRACER
     from kubeflow_tpu.tpu.profiling import StepClock
 
     # s2d stem: measured +0.4 MFU on v5e (e2e/conv_experiments.py); opt-in
@@ -180,7 +181,7 @@ def _bench(batch: int):
         f, u = calibration.get("fused"), calibration.get("unfused")
         use_fused = f is not None and (u is None or f <= u)
 
-    clock = StepClock()
+    clock = StepClock(tracer=TRACER)
     run_steps = make_window(use_fused, timed_steps)
 
     # Per-step FLOPs always from the UNFUSED step: XLA credits ZERO flops
@@ -262,6 +263,7 @@ def _bench_gpt(batch: int, seq: int):
         GptConfig, GptLM, blockwise_causal_lm_loss, causal_lm_loss)
     from kubeflow_tpu.training import mfu
     from kubeflow_tpu.training.flops import compiled_with_cost, detect_generation
+    from kubeflow_tpu.runtime.tracing import TRACER
     from kubeflow_tpu.tpu.profiling import StepClock
 
     # Fast paths default ON (BENCH_GPT_SCAN=0 / BENCH_FUSED_LOSS=0 to
@@ -304,7 +306,7 @@ def _bench_gpt(batch: int, seq: int):
         checksum = sum(jnp.sum(x.astype(jnp.float32)) for x in jax.tree_util.tree_leaves(p))
         return losses[-1], checksum
 
-    clock = StepClock()
+    clock = StepClock(tracer=TRACER)
     # FLOPs numerator from the REFERENCE path (unrolled blocks, plain
     # loss): XLA cost analysis counts a while-loop body ONCE, so probing
     # the scanned / vocab-chunked executables would undercount the blocks
@@ -417,6 +419,7 @@ def _bench_multichip():
     from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
     from kubeflow_tpu.parallel.pipeline import schedule_stats
     from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.runtime.tracing import TRACER
     from kubeflow_tpu.tpu.profiling import StepClock
 
     devices = jax.devices()
@@ -446,7 +449,7 @@ def _bench_multichip():
                            0, cfg.vocab_size),
         composite_mod.batch_sharding(mesh))
 
-    clock = StepClock(metrics=METRICS.namespace("multichip"))
+    clock = StepClock(metrics=METRICS.namespace("multichip"), tracer=TRACER)
 
     def timed_run(use_mesh, use_v, use_gather, use_ids, label):
         """Compile + warm one train step on ``use_mesh``, then time
@@ -644,6 +647,11 @@ def _run_serving(platform: str) -> dict:
             "bert_http_rows": bert,
             "decode_rows": decode,
             "continuous_batching": cont,
+            # SLO quantiles from the engine run's histograms (registry
+            # bucket interpolation — the serving row's latency headline)
+            "ttft_p50": cont.get("ttft_p50") if cont else None,
+            "ttft_p99": cont.get("ttft_p99") if cont else None,
+            "queue_wait_p99": cont.get("queue_wait_p99") if cont else None,
             "platform": platform,
         })
     except Exception as e:

@@ -116,11 +116,26 @@ def multichip_e2e() -> Dict:
     return b.build()
 
 
+def observability_e2e() -> Dict:
+    """The observability-plane job: a dryrun serving request through the
+    real HTTP path that must yield a nonzero serving_ttft_seconds scrape
+    and a complete submit→retire trace in /debug/traces
+    (e2e/observability_driver.py asserts both), plus the plane's unit
+    suite (exposition parse, traceparent propagation, quantiles)."""
+    b = WorkflowBuilder("observability-e2e")
+    b.run("obs-serving-dryrun", ["python", "-m", "e2e.observability_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("obs-unit", "tests/test_observability.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
     "platform-e2e": platform_e2e,
     "multichip-e2e": multichip_e2e,
+    "observability-e2e": observability_e2e,
 }
 
 

@@ -191,7 +191,16 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     finally:
         eng.close()
 
+    # SLO quantiles out of the engine's histograms (registry bucket
+    # interpolation — the same numbers a /metrics scrape would show).
+    # prewarm() runs uninstrumented, so only the timed requests count.
+    from kubeflow_tpu.runtime.metrics import METRICS
+
     return {
+        "ttft_p50": round(METRICS.quantile("serving_ttft_seconds", 0.5), 4),
+        "ttft_p99": round(METRICS.quantile("serving_ttft_seconds", 0.99), 4),
+        "queue_wait_p99": round(
+            METRICS.quantile("serving_queue_wait_seconds", 0.99), 4),
         "slots": slots, "requests": n_requests, "budgets": "32/64/128/224",
         "useful_tokens": total_tokens,
         "static_wall_s": round(static_s, 2),

@@ -307,6 +307,13 @@ def make_apiserver_app(
             groups.setdefault(res.group or "core", []).append(f"{res.plural}.{res.version}")
         return {"groups": {g: sorted(v) for g, v in groups.items()}}
 
+    # /metrics + /debug/* on the API port (kube-apiserver serves /metrics
+    # and /debug/pprof the same way); the auth middleware above still gates
+    # them when the server runs authenticated
+    from ..runtime.obs import mount_observability
+
+    mount_observability(app)
+
     return app
 
 

@@ -18,7 +18,6 @@ from typing import Optional
 from ..apiserver.remote import RemoteStore
 from ..web.http import App, Request
 from .manager import Manager, Reconciler
-from .metrics import METRICS
 
 DEFAULT_APISERVER = "http://apiserver:8001"
 
@@ -36,21 +35,18 @@ def connect(url: Optional[str] = None, timeout: float = 60.0) -> RemoteStore:
 
 
 def serve_ops_endpoints(name: str, port: Optional[int] = None):
-    """/healthz + /metrics server every role exposes (reference: promhttp on
-    each Go binary — e.g. kfam routers.go:85-89)."""
+    """/healthz + observability server every role exposes (reference:
+    promhttp on each Go binary — e.g. kfam routers.go:85-89; here the
+    mount also brings /debug/traces + /debug/vars)."""
+    from .obs import mount_observability
+
     app = App(f"{name}-ops")
 
     @app.route("/healthz")
     def healthz(req: Request):
         return {"status": "ok", "role": name}
 
-    @app.route("/metrics")
-    def metrics(req: Request):
-        from ..web.http import JsonResponse
-
-        return JsonResponse(
-            METRICS.render(), headers={"Content-Type": "text/plain; version=0.0.4"}
-        )
+    mount_observability(app)
 
     if port is None:
         port = int(os.environ.get("METRICS_PORT", "8080"))

@@ -4,18 +4,49 @@ Every reference Go service exposes Prometheus counters/gauges (e.g.
 notebook-controller/pkg/metrics/metrics.go:13-60, access-management
 kfam/monitoring.go). This registry provides the same surface — counters,
 gauges, histograms, label sets, ``/metrics`` text format — stdlib-only.
+
+Observability-plane extensions (docs/OBSERVABILITY.md):
+
+- per-metric custom buckets: ``histogram(name, buckets=(...))`` — the fixed
+  1ms–30s default ladder cannot resolve ms-scale inter-token latency,
+- bucket-based quantile estimation: ``quantile(name, q)`` aggregates every
+  label series of a histogram and linearly interpolates inside the bucket
+  that holds the rank (the histogram_quantile() recipe, done in-process),
+- OpenMetrics exemplars: each observation records the current span's trace
+  id (or an explicitly passed one) against the bucket it landed in, and
+  ``render()`` appends ``# {trace_id="..."} value ts`` to bucket lines,
+- collectors: callbacks run at scrape time; ``install_process_collector``
+  registers the stdlib process collector (RSS, threads, GC, CPU, uptime).
 """
 
 from __future__ import annotations
 
+import gc
+import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
+
+
+_tracing = None
+
+
+def _current_trace_id() -> Optional[str]:
+    """Trace id of the calling thread's current span (exemplar source).
+    Lazy module lookup: metrics must stay importable before tracing and
+    add ~one getattr per observation when tracing is idle."""
+    global _tracing
+    if _tracing is None:
+        from . import tracing as _t  # no cycle: tracing imports stdlib only
+
+        _tracing = _t
+    span = getattr(_tracing._local, "span", None)
+    return span.trace_id if span is not None else None
 
 
 class _Counter:
@@ -41,21 +72,40 @@ class _Gauge:
 
 
 class _Histogram:
+    #: default ladder — serving SLO series override per metric
     BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0)
 
-    def __init__(self) -> None:
-        self.counts = [0] * (len(self.BUCKETS) + 1)
+    def __init__(self, buckets: Optional[Sequence[float]] = None) -> None:
+        self.buckets: Tuple[float, ...] = tuple(buckets) if buckets else self.BUCKETS
+        self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.total = 0
+        #: per-bucket exemplar: (observed value, trace_id, unix seconds)
+        self.exemplars: List[Optional[Tuple[float, str, float]]] = [None] * (
+            len(self.buckets) + 1
+        )
 
-    def observe(self, value: float) -> None:
-        self.sum += value
-        self.total += 1
-        for i, b in enumerate(self.BUCKETS):
+    def _index(self, value: float) -> int:
+        for i, b in enumerate(self.buckets):
             if value <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+                return i
+        return len(self.buckets)
+
+    def observe(self, value: float, count: int = 1,
+                trace_id: Optional[str] = None) -> None:
+        """Record ``count`` observations of ``value`` (count>1 amortizes a
+        block of identical observations — the chunked decode path records
+        per-token inter-token latency this way without per-token calls).
+        The exemplar trace id defaults to the calling thread's current span
+        so every histogram observation made under a span is correlatable."""
+        self.sum += value * count
+        self.total += count
+        i = self._index(value)
+        self.counts[i] += count
+        if trace_id is None:
+            trace_id = _current_trace_id()
+        if trace_id is not None:
+            self.exemplars[i] = (float(value), trace_id, time.time())
 
     @property
     def mean(self) -> float:
@@ -67,8 +117,8 @@ class NamespacedRegistry:
 
     Subsystems register a namespace once (e.g. ``METRICS.namespace("scheduler")``)
     so all their series share a Prometheus-conventional prefix without each
-    call site repeating it. Reads (``value``/``total``) resolve against the
-    underlying registry, so tests can assert through either handle.
+    call site repeating it. Reads (``value``/``total``/``quantile``) resolve
+    against the underlying registry, so tests can assert through either handle.
     """
 
     def __init__(self, registry: "MetricsRegistry", prefix: str) -> None:
@@ -84,8 +134,9 @@ class NamespacedRegistry:
     def gauge(self, name: str, **labels: str) -> _Gauge:
         return self._registry.gauge(self._name(name), **labels)
 
-    def histogram(self, name: str, **labels: str) -> _Histogram:
-        return self._registry.histogram(self._name(name), **labels)
+    def histogram(self, name: str, buckets: Optional[Sequence[float]] = None,
+                  **labels: str) -> _Histogram:
+        return self._registry.histogram(self._name(name), buckets=buckets, **labels)
 
     def timer(self, name: str, **labels: str):
         return self._registry.timer(self._name(name), **labels)
@@ -96,12 +147,21 @@ class NamespacedRegistry:
     def value(self, name: str, **labels: str) -> float:
         return self._registry.value(self._name(name), **labels)
 
+    def quantile(self, name: str, q: float) -> float:
+        return self._registry.quantile(self._name(name), q)
+
 
 class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: Dict[str, Dict[Tuple[Tuple[str, str], ...], object]] = {}
         self._types: Dict[str, str] = {}
+        #: first-registration bucket ladder per histogram name — every label
+        #: series of a name shares one ladder or the exposition is corrupt
+        self._hist_buckets: Dict[str, Tuple[float, ...]] = {}
+        #: scrape-time callbacks (process collector etc.), keyed for idempotence;
+        #: collectors survive reset() — they repopulate on the next render
+        self._collectors: Dict[str, Callable[[], None]] = {}
 
     def _get(self, name: str, kind: str, factory, labels: Dict[str, str]):
         with self._lock:
@@ -120,8 +180,30 @@ class MetricsRegistry:
     def gauge(self, name: str, **labels: str) -> _Gauge:
         return self._get(name, "gauge", _Gauge, labels)
 
-    def histogram(self, name: str, **labels: str) -> _Histogram:
-        return self._get(name, "histogram", _Histogram, labels)
+    def histogram(self, name: str, buckets: Optional[Sequence[float]] = None,
+                  **labels: str) -> _Histogram:
+        """``buckets`` fixes the name's ladder at first registration; a later
+        call may omit them (reuses the registered ladder) but re-registering
+        with a DIFFERENT ladder raises — same-name/different-shape series
+        would silently share the first ladder and render corrupt buckets."""
+        with self._lock:
+            if name in self._types and self._types[name] != "histogram":
+                raise ValueError(f"metric {name} already registered as {self._types[name]}")
+            requested = tuple(sorted(float(b) for b in buckets)) if buckets else None
+            registered = self._hist_buckets.get(name)
+            if registered is not None and requested is not None and requested != registered:
+                raise ValueError(
+                    f"histogram {name} already registered with buckets {registered}; "
+                    f"cannot re-register with {requested}"
+                )
+            effective = registered or requested or _Histogram.BUCKETS
+            self._hist_buckets[name] = effective
+            self._types[name] = "histogram"
+            series = self._metrics.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = _Histogram(effective)
+            return series[key]
 
     @contextmanager
     def timer(self, name: str, **labels: str):
@@ -148,8 +230,56 @@ class MetricsRegistry:
             m = series.get(_label_key(labels))
             return getattr(m, "value", 0.0) if m else 0.0
 
+    def quantile(self, name: str, q: float) -> float:
+        """Estimate the q-quantile (0..1) of histogram ``name`` across every
+        label series: find the bucket holding rank q*total and interpolate
+        linearly inside it (exactly what PromQL's histogram_quantile does
+        server-side). Observations above the largest finite bucket clamp to
+        that bound. Returns 0.0 with no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q={q} outside [0, 1]")
+        with self._lock:
+            series = self._metrics.get(name, {})
+            hists = [m for m in series.values() if isinstance(m, _Histogram)]
+            if not hists:
+                return 0.0
+            buckets = hists[0].buckets
+            counts = [0] * (len(buckets) + 1)
+            total = 0
+            for h in hists:
+                for i, c in enumerate(h.counts):
+                    counts[i] += c
+                total += h.total
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, bound in enumerate(buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                lo = buckets[i - 1] if i > 0 else 0.0
+                if counts[i] == 0:
+                    return bound
+                return lo + (bound - lo) * ((rank - prev) / counts[i])
+        return buckets[-1]  # rank fell in the +Inf bucket: clamp
+
+    # -- collectors ----------------------------------------------------------
+    def register_collector(self, key: str, fn: Callable[[], None]) -> None:
+        """Run ``fn`` at every render() before the exposition is built (the
+        Go client's Collector pattern). Keyed: re-registering a key replaces
+        it, so mounts stay idempotent. Collectors survive reset()."""
+        with self._lock:
+            self._collectors[key] = fn
+
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4, with OpenMetrics-style
+        exemplars on histogram bucket lines when a trace was active."""
+        for fn in list(self._collectors.values()):
+            try:
+                fn()  # outside self._lock — collectors call gauge()/counter()
+            except Exception:
+                pass  # a broken collector must not take /metrics down
         lines: List[str] = []
         with self._lock:
             for name in sorted(self._metrics):
@@ -160,12 +290,18 @@ class MetricsRegistry:
                     suffix = f"{{{label_str}}}" if label_str else ""
                     if isinstance(m, _Histogram):
                         cum = 0
-                        for i, b in enumerate(m.BUCKETS):
+                        for i, b in enumerate(m.buckets):
                             cum += m.counts[i]
                             le = ("," if label_str else "") + f'le="{b}"'
-                            lines.append(f"{name}_bucket{{{label_str}{le}}} {cum}")
+                            lines.append(
+                                f"{name}_bucket{{{label_str}{le}}} {cum}"
+                                + _exemplar_suffix(m.exemplars[i])
+                            )
                         le = ("," if label_str else "") + 'le="+Inf"'
-                        lines.append(f"{name}_bucket{{{label_str}{le}}} {m.total}")
+                        lines.append(
+                            f"{name}_bucket{{{label_str}{le}}} {m.total}"
+                            + _exemplar_suffix(m.exemplars[-1])
+                        )
                         lines.append(f"{name}_sum{suffix} {m.sum}")
                         lines.append(f"{name}_count{suffix} {m.total}")
                     else:
@@ -179,6 +315,56 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
             self._types.clear()
+            self._hist_buckets.clear()
+
+
+def _exemplar_suffix(ex: Optional[Tuple[float, str, float]]) -> str:
+    if ex is None:
+        return ""
+    value, trace_id, ts = ex
+    return f' # {{trace_id="{trace_id}"}} {value} {round(ts, 3)}'
+
+
+# -- stdlib process collector -------------------------------------------------
+
+_PROCESS_START = time.time()
+
+
+def _rss_bytes() -> Optional[float]:
+    try:  # Linux: authoritative current RSS
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:  # portable fallback: peak RSS (close enough for a dashboard)
+        import resource
+
+        return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024)
+    except Exception:
+        return None
+
+
+def install_process_collector(registry: Optional[MetricsRegistry] = None) -> None:
+    """Register the ``process_*`` series (RSS, thread count, GC collections,
+    CPU seconds, uptime) on ``registry`` — refreshed at every scrape, the
+    promhttp default collector re-built on stdlib."""
+    reg = registry if registry is not None else METRICS
+
+    def collect() -> None:
+        reg.gauge("process_uptime_seconds").set(time.time() - _PROCESS_START)
+        reg.gauge("process_threads").set(float(threading.active_count()))
+        t = os.times()
+        reg.counter("process_cpu_seconds_total").value = float(t.user + t.system)
+        rss = _rss_bytes()
+        if rss is not None:
+            reg.gauge("process_resident_memory_bytes").set(rss)
+        for gen, stats in enumerate(gc.get_stats()):
+            reg.counter(
+                "process_gc_collections_total", generation=str(gen)
+            ).value = float(stats.get("collections", 0))
+
+    reg.register_collector("process", collect)
 
 
 METRICS = MetricsRegistry()

@@ -1,0 +1,122 @@
+"""Mountable observability surface: ``/metrics`` + ``/debug/*`` routes.
+
+Before this module nothing in the process actually *served* the
+``MetricsRegistry.render()`` text or the Tracer's ring buffer; every role
+re-implemented (or skipped) the plumbing. ``mount_observability(app)``
+adds, idempotently, to any ``web.http.App``:
+
+- ``GET /metrics``        — Prometheus/OpenMetrics text exposition (with
+  trace-id exemplars on histogram buckets and the stdlib process collector),
+- ``GET /debug/traces``   — recent spans as OTLP-shaped JSON, filterable by
+  ``?trace_id=`` / ``?name=`` / ``?limit=`` (most recent last),
+- ``GET /debug/vars``     — expvar-style process snapshot (pid, uptime,
+  RSS, threads, GC, trace-buffer depth, metric families).
+
+Mounted by the per-role ops server (runtime/bootstrap.py), the REST
+apiserver, and the ModelServer, so the serving SLO histograms
+(``serving_ttft_seconds`` and friends) and per-request traces are
+scrapeable wherever the work runs.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..web.http import App, HttpError, JsonResponse, Request
+from .metrics import (
+    METRICS,
+    MetricsRegistry,
+    _PROCESS_START,
+    _rss_bytes,
+    install_process_collector,
+)
+from .tracing import TRACER, Tracer
+
+#: exposition content type (Prometheus text 0.0.4; exemplar suffixes are
+#: OpenMetrics-style and ignored by 0.0.4-only parsers of our own make)
+EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: hard ceiling on one /debug/traces response (the ring holds 4096 spans)
+MAX_TRACE_SPANS = 4096
+
+
+def otlp_traces(tracer: Tracer, trace_id: Optional[str] = None,
+                name: Optional[str] = None, limit: int = 256) -> dict:
+    """The ring buffer's tail as one OTLP-shaped resourceSpans document —
+    loadable by OTLP-adjacent tooling and by the e2e assertions."""
+    spans = tracer.finished_spans(name=name, trace_id=trace_id)
+    spans = spans[-max(0, min(limit, MAX_TRACE_SPANS)):]
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": tracer.service}}
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "kubeflow_tpu.runtime.tracing"},
+                        "spans": [s.to_dict() for s in spans],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def mount_observability(
+    app: App,
+    registry: Optional[MetricsRegistry] = None,
+    tracer: Optional[Tracer] = None,
+) -> App:
+    """Add the observability routes to ``app`` (no-op if already mounted)."""
+    reg = registry if registry is not None else METRICS
+    trc = tracer if tracer is not None else TRACER
+    if any(pattern == "/metrics" for _m, pattern, _fn in app.iter_routes()):
+        return app
+    install_process_collector(reg)
+
+    @app.route("/metrics")
+    def metrics(req: Request) -> JsonResponse:
+        return JsonResponse(
+            reg.render(), headers={"Content-Type": EXPOSITION_CONTENT_TYPE}
+        )
+
+    @app.route("/debug/traces")
+    def debug_traces(req: Request) -> dict:
+        try:
+            limit = int(req.query1("limit", "256"))
+        except ValueError:
+            raise HttpError(400, "limit must be an integer") from None
+        return otlp_traces(
+            trc,
+            trace_id=req.query1("trace_id") or None,
+            name=req.query1("name") or None,
+            limit=limit,
+        )
+
+    @app.route("/debug/vars")
+    def debug_vars(req: Request) -> dict:
+        with reg._lock:
+            families = len(reg._metrics)
+        return {
+            "pid": os.getpid(),
+            "argv": sys.argv,
+            "python_version": sys.version.split()[0],
+            "uptime_seconds": round(time.time() - _PROCESS_START, 3),
+            "resident_memory_bytes": _rss_bytes(),
+            "threads": threading.active_count(),
+            "gc": {str(i): s for i, s in enumerate(gc.get_stats())},
+            "trace_buffer_spans": len(trc.finished_spans()),
+            "metric_families": families,
+            "app": app.name,
+        }
+
+    return app

@@ -21,7 +21,6 @@ from __future__ import annotations
 import collections
 import json
 import os
-import random
 import threading
 import time
 from contextlib import contextmanager
@@ -32,7 +31,9 @@ _local = threading.local()
 
 
 def _rand_hex(nbytes: int) -> str:
-    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+    # os.urandom, NOT the random module: seeded tests (random.seed(0) in a
+    # fixture) and forked workers would otherwise mint colliding ids.
+    return os.urandom(nbytes).hex()
 
 
 @dataclass
@@ -92,11 +93,80 @@ class Tracer:
         self._lock = threading.Lock()
         self._export_path = export_path or os.environ.get("KUBEFLOW_TPU_TRACE_FILE")
         self._export_file = None  # opened lazily, kept for the tracer's life
+        # export serializes on its OWN lock: a slow disk must stall at most
+        # the exporting threads, never every traced thread (the ring lock
+        # is held only for the O(1) append)
+        self._export_lock = threading.Lock()
 
     # -- context -------------------------------------------------------------
     @staticmethod
     def current_span() -> Optional[Span]:
         return getattr(_local, "span", None)
+
+    def start_span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        traceparent: Optional[str] = None,
+        **attributes: Any,
+    ) -> Span:
+        """Manual span lifecycle: parents to (in order) the explicit parent,
+        a ``traceparent`` header, or the thread-local current span, but does
+        NOT become the current span — the shape for work that starts on one
+        thread and finishes on another (a serving request lives from the
+        HTTP handler thread's submit() to the engine worker's retire()).
+        Pair with ``end_span()`` to record it."""
+        if parent is None and traceparent:
+            parsed = parse_traceparent(traceparent)
+            if parsed:
+                trace_id, parent_span_id = parsed
+                parent = Span("remote", trace_id, parent_span_id)
+        if parent is None:
+            parent = self.current_span()
+        return Span(
+            name=name,
+            trace_id=parent.trace_id if parent else _rand_hex(16),
+            span_id=_rand_hex(8),
+            parent_span_id=parent.span_id if parent else None,
+            start_ns=time.time_ns(),
+            attributes={"service.name": self.service, **attributes},
+        )
+
+    def end_span(self, span: Span, error: Optional[BaseException] = None) -> Span:
+        """Close and record a ``start_span()`` span (idempotence is the
+        caller's business)."""
+        if error is not None:
+            span.record_error(error)
+        span.end_ns = time.time_ns()
+        self._record(span)
+        return span
+
+    def emit_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        events: Optional[List[Dict[str, Any]]] = None,
+        parent: Optional[Span] = None,
+        **attributes: Any,
+    ) -> Span:
+        """Record an already-elapsed interval as a span (StepClock's per-step
+        hook: the step is only known to be a span at ``end_step()``)."""
+        if parent is None:
+            parent = self.current_span()
+        span = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else _rand_hex(16),
+            span_id=_rand_hex(8),
+            parent_span_id=parent.span_id if parent else None,
+            start_ns=start_ns,
+            end_ns=end_ns,
+            attributes={"service.name": self.service, **attributes},
+        )
+        if events:
+            span.events = list(events)
+        self._record(span)
+        return span
 
     @contextmanager
     def span(
@@ -108,21 +178,8 @@ class Tracer:
     ) -> Iterator[Span]:
         """Open a span; parents to (in order) the explicit parent, a
         ``traceparent`` header, or the thread-local current span."""
-        if parent is None and traceparent:
-            parsed = parse_traceparent(traceparent)
-            if parsed:
-                trace_id, parent_span_id = parsed
-                parent = Span("remote", trace_id, parent_span_id)
-        if parent is None:
-            parent = self.current_span()
-        span = Span(
-            name=name,
-            trace_id=parent.trace_id if parent else _rand_hex(16),
-            span_id=_rand_hex(8),
-            parent_span_id=parent.span_id if parent else None,
-            start_ns=time.time_ns(),
-            attributes={"service.name": self.service, **attributes},
-        )
+        span = self.start_span(name, parent=parent, traceparent=traceparent,
+                               **attributes)
         prev = self.current_span()
         _local.span = span
         try:
@@ -131,16 +188,18 @@ class Tracer:
             span.record_error(e)
             raise
         finally:
-            span.end_ns = time.time_ns()
             _local.span = prev
-            self._record(span)
+            self.end_span(span)
 
     # -- storage / export ----------------------------------------------------
     def _record(self, span: Span) -> None:
-        line = json.dumps(span.to_dict()) + "\n" if self._export_path else None
         with self._lock:
             self._spans.append(span)
-            if line is not None:
+        if self._export_path:
+            # serialize + write OUTSIDE the ring lock: readers and other
+            # recording threads must never wait on a slow disk
+            line = json.dumps(span.to_dict()) + "\n"
+            with self._export_lock:
                 try:
                     if self._export_file is None:
                         self._export_file = open(self._export_path, "a")

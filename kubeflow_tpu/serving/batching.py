@@ -36,6 +36,12 @@ class BatcherClosed(RuntimeError):
     """The batcher was shut down (model reload/unload) — retry unbatched."""
 
 
+#: coalescing-window waits are ms-scale (max_wait_ms default 5) but a
+#: busy queue can push them to seconds — same ladder as the engine's
+QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                      60.0)
+
+
 @dataclass
 class _Pending:
     instances: Sequence[Any]
@@ -44,6 +50,7 @@ class _Pending:
     result: Optional[List[Any]] = None
     error: Optional[BaseException] = None
     waited: bool = False  # sat through a full coalescing window already
+    enqueued_at: float = field(default_factory=time.perf_counter)
 
 
 class DynamicBatcher:
@@ -173,8 +180,15 @@ class DynamicBatcher:
             if not batch:
                 return
             combined: List[Any] = []
+            started = time.perf_counter()
             for p in batch:
                 combined.extend(p.instances)
+                # enqueue→forward-start wait: the coalescing window plus any
+                # time spent queued behind other shapes
+                METRICS.histogram(
+                    "serving_batch_queue_wait_seconds",
+                    buckets=QUEUE_WAIT_BUCKETS, model=self.name,
+                ).observe(started - p.enqueued_at)
             try:
                 results = self.predict_fn(combined)
                 if len(results) != len(combined):

@@ -37,6 +37,7 @@ import functools
 import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -46,9 +47,24 @@ import numpy as np
 
 from ..models.gpt import GptConfig, GptLM
 from ..runtime.metrics import METRICS
+from ..runtime.tracing import TRACER, Span
 
 #: prompt-length buckets — one prefill compilation each (static shapes)
 PREFILL_BUCKETS = (16, 32, 64, 128, 256)
+
+#: SLO histogram ladders (docs/OBSERVABILITY.md). The registry default
+#: (1ms–30s) cannot resolve ms-scale inter-token latency, and TTFT needs
+#: headroom past 30s for cold-compile admissions.
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0)
+ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+               0.5, 1.0)
+QUEUE_WAIT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0,
+                      60.0)
+PREFILL_BUCKETS_S = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                     10.0)
+DECODE_CHUNK_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                        0.5, 1.0, 2.5)
 
 #: ceiling on one batched prefill's rows: every admission group is padded
 #: to ``min(slots, MAX_GROUP)`` (ONE prefill program + ONE reusable zero
@@ -76,6 +92,13 @@ class _Request:
     eos_id: Optional[int] = None
     temperature: float = 0.0  # 0 = greedy; >0 samples with a per-slot key
     done_at: Optional[float] = None  # perf_counter at retirement (latency acct)
+    # observability (None on internal requests, e.g. prewarm's dummies):
+    # one span covers submit()→_retire(), crossing the caller thread into
+    # the engine worker — hence start_span/end_span, not the contextmanager
+    span: Optional[Span] = None
+    submit_at: Optional[float] = None       # perf_counter at enqueue
+    first_token_at: Optional[float] = None  # perf_counter at first token
+    last_token_at: Optional[float] = None   # perf_counter at latest token
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -83,6 +106,26 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.tokens
+
+
+def _ev(req: _Request, name: str, **attrs: Any) -> None:
+    if req.span is not None:
+        req.span.add_event(name, **attrs)
+
+
+def _trace_id(req: _Request) -> Optional[str]:
+    return req.span.trace_id if req.span is not None else None
+
+
+def _fail(req: _Request, error: BaseException) -> None:
+    """Single failure path: error the future AND close the span — every
+    branch that drops a request (bad bucket, prefill/adopt failure,
+    shutdown) must leave its trace ERROR-terminated, not dangling."""
+    req.error = error
+    if req.span is not None:
+        TRACER.end_span(req.span, error=error)
+        req.span = None
+    req.done.set()
 
 
 class ContinuousBatcher:
@@ -304,17 +347,29 @@ class ContinuousBatcher:
     # -- public API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_id: Optional[int] = None,
-               temperature: float = 0.0) -> _Request:
+               temperature: float = 0.0,
+               traceparent: Optional[str] = None) -> _Request:
+        """``traceparent`` (W3C header value) parents the request's span to
+        the caller's trace — the HTTP predict handler passes its own so a
+        scraped trace shows the handler as root over submit→retire."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + budget exceeds max_seq")
         req = _Request(prompt, max_new_tokens, eos_id=eos_id,
                        temperature=float(temperature))
+        req.span = TRACER.start_span(
+            "serving.request", traceparent=traceparent,
+            **{"prompt_tokens": int(len(prompt)),
+               "max_new_tokens": int(max_new_tokens)})
+        req.submit_at = time.perf_counter()
+        _ev(req, "enqueued")
+        METRICS.counter("serving_tokens_in_total").inc(len(prompt))
         # closed-check and enqueue under one lock: a put racing close()
         # could otherwise land AFTER the shutdown sentinel and hang its
         # caller forever (the worker stops at the sentinel)
         with self._lock:
             if self._closed:
+                _fail(req, RuntimeError("batcher closed"))
                 raise RuntimeError("batcher closed")
             self._queue.put([req])
         return req
@@ -373,8 +428,7 @@ class ContinuousBatcher:
             try:
                 bucket = _bucket_for(len(req.prompt))
             except Exception as e:  # bad request fails alone, takes no slot
-                req.error = e
-                req.done.set()
+                _fail(req, e)
                 continue
             by_bucket.setdefault(bucket, []).append((req, key))
         groups = [chunk[i:i + self._group_pad]
@@ -383,14 +437,20 @@ class ContinuousBatcher:
         for group in groups:
             try:
                 keys = jnp.stack([k for _, k in group])
+                t0 = time.perf_counter()
                 small, first = self._prefill_group(
                     [r.prompt for r, _ in group],
                     [r.temperature for r, _ in group], keys)
             except Exception as e:  # whole-group failure takes no slots
                 for req, _ in group:
-                    req.error = e
-                    req.done.set()
+                    _fail(req, e)
                 continue
+            # dispatch wall time of ONE batched group prefill (the tokens
+            # surface later via the pipelined 'first' event)
+            METRICS.histogram(
+                "serving_prefill_seconds", buckets=PREFILL_BUCKETS_S
+            ).observe(time.perf_counter() - t0,
+                      trace_id=_trace_id(group[0][0]))
             n = len(group)
             slots = [self._free.pop() for _ in range(n)]
             try:
@@ -413,8 +473,7 @@ class ContinuousBatcher:
                 # result() timeout. Restore the slots and fail the group now.
                 self._free.extend(slots)
                 for req, _ in group:
-                    req.error = e
-                    req.done.set()
+                    _fail(req, e)
                 continue
             try:
                 first_n.copy_to_host_async()
@@ -422,35 +481,53 @@ class ContinuousBatcher:
                 pass
             # activate NOW (before the first-token value is on host): the
             # next chunk dispatch must include these rows in its snapshot
+            now = time.perf_counter()
             for (req, _), slot in zip(group, slots):
                 self._active[slot] = req
+                if req.submit_at is not None:
+                    METRICS.histogram(
+                        "serving_queue_wait_seconds",
+                        buckets=QUEUE_WAIT_BUCKETS,
+                    ).observe(now - req.submit_at, trace_id=_trace_id(req))
+                _ev(req, "admitted", slot=slot)
+                _ev(req, "prefill_done")
             events.append(("first", first_n,
-                           [(req, slot) for (req, _), slot in zip(group, slots)]))
-        METRICS.gauge("serving_continuous_active_slots").set(len(self._active))
+                           [(req, slot) for (req, _), slot in zip(group, slots)],
+                           now))
+        self._set_occupancy()
         return events
 
-    def _retire(self, slot: int) -> None:
-        import time
+    def _set_occupancy(self) -> None:
+        active = len(self._active)
+        METRICS.gauge("serving_continuous_active_slots").set(active)
+        METRICS.gauge("serving_slot_occupancy").set(
+            active / self.slots if self.slots else 0.0)
 
+    def _retire(self, slot: int) -> None:
         req = self._active.pop(slot)
         self._free.append(slot)
         req.done_at = time.perf_counter()
+        if req.submit_at is not None:
+            METRICS.histogram("serving_request_seconds").observe(
+                req.done_at - req.submit_at, trace_id=_trace_id(req))
+        if req.span is not None:
+            _ev(req, "retired", slot=slot)
+            req.span.set("generated_tokens", len(req.tokens))
+            TRACER.end_span(req.span)
+            req.span = None
         req.done.set()
         METRICS.counter("serving_continuous_requests_total").inc()
-        METRICS.gauge("serving_continuous_active_slots").set(len(self._active))
+        self._set_occupancy()
 
     def _shutdown(self, cause: str) -> None:
         """Fail everything in flight, pending, and still queued — all with
         the SAME cause, so a device failure is debuggable from any failed
         caller, not only the in-flight ones."""
         for req in self._active.values():
-            req.error = RuntimeError(cause)
-            req.done.set()
+            _fail(req, RuntimeError(cause))
         self._active.clear()
         while self._pending:
-            req = self._pending.popleft()
-            req.error = RuntimeError(cause)
-            req.done.set()
+            _fail(self._pending.popleft(), RuntimeError(cause))
         while True:
             try:
                 rest = self._queue.get_nowait()
@@ -458,43 +535,79 @@ class ContinuousBatcher:
                 return
             if rest is not None:
                 for req in rest:
-                    req.error = RuntimeError(cause)
-                    req.done.set()
+                    _fail(req, RuntimeError(cause))
 
-    def _process_event(self, event: Tuple[str, Any, Any]) -> None:
+    def _process_event(self, event: Tuple[str, Any, Any, float]) -> None:
         """Consume one pipelined event in dispatch order. ``first``: fetch
         an admission group's first tokens (appended before any of that
         request's chunk tokens — FIFO order guarantees it). ``chunk``:
         fetch a token block and retire against the DISPATCH-TIME snapshot —
         a row whose request finished in an earlier event is a discarded
         tail; a row adopted after the dispatch is not in the snapshot."""
-        kind, dev, meta = event
+        kind, dev, meta, dispatched_at = event
         block = np.asarray(dev)  # host fetch (async copy started at dispatch)
+        now = time.perf_counter()
         if kind == "first":
             for (req, slot), tok in zip(meta, block):
                 req.tokens.append(int(tok))
+                req.first_token_at = req.last_token_at = now
+                METRICS.counter("serving_tokens_out_total").inc()
+                if req.submit_at is not None:
+                    METRICS.histogram(
+                        "serving_ttft_seconds", buckets=TTFT_BUCKETS
+                    ).observe(now - req.submit_at, trace_id=_trace_id(req))
+                _ev(req, "first_token")
                 hit_eos = req.eos_id is not None and req.tokens[-1] == req.eos_id
                 if req.max_new_tokens <= 1 or hit_eos:
                     # the slot was activated at admission, so the normal
                     # retirement path applies
                     self._retire(slot)
             return
+        # dispatch→fetch-complete latency of one pipelined decode chunk
+        METRICS.histogram(
+            "serving_decode_chunk_seconds", buckets=DECODE_CHUNK_BUCKETS
+        ).observe(now - dispatched_at)
         for slot, req in meta.items():
             if req.done.is_set():
-                continue  # retired in an earlier event; tail tokens discard
+                # retired in an earlier event; this row's whole block was
+                # computed for nobody — the engine's "preempted work" cost
+                METRICS.counter("serving_discarded_tail_tokens_total").inc(
+                    block.shape[1])
+                continue
+            appended = 0
             for j in range(block.shape[1]):
                 tok = int(block[slot, j])
                 req.tokens.append(tok)
+                appended += 1
                 hit_eos = req.eos_id is not None and tok == req.eos_id
                 if len(req.tokens) >= req.max_new_tokens or hit_eos:
+                    # inter-token latency amortized over the block BEFORE
+                    # _retire closes the span (one observe, count=n — the
+                    # per-token path must not pay per-token metric calls)
+                    self._note_tokens(req, appended, now)
                     self._retire(slot)
+                    METRICS.counter(
+                        "serving_discarded_tail_tokens_total"
+                    ).inc(block.shape[1] - j - 1)
+                    appended = 0
                     break
+            if appended:
+                self._note_tokens(req, appended, now)
+
+    def _note_tokens(self, req: _Request, n: int, now: float) -> None:
+        METRICS.counter("serving_tokens_out_total").inc(n)
+        if req.last_token_at is not None:
+            METRICS.histogram(
+                "serving_inter_token_seconds", buckets=ITL_BUCKETS
+            ).observe((now - req.last_token_at) / n, count=n,
+                      trace_id=_trace_id(req))
+        req.last_token_at = now
 
     def _loop(self) -> None:
-        events: "collections.deque[Tuple[str, Any, Any]]" = collections.deque()
+        events: "collections.deque[Tuple[str, Any, Any, float]]" = collections.deque()
 
         def chunk_depth() -> int:
-            return sum(1 for kind, _, _ in events if kind == "chunk")
+            return sum(1 for kind, _, _, _ in events if kind == "chunk")
 
         while True:
             # drain arrivals into the pending deque; block only when fully
@@ -513,6 +626,7 @@ class ContinuousBatcher:
                     timeout = 0.0
             except queue.Empty:
                 pass
+            METRICS.gauge("serving_queue_depth").set(len(self._pending))
             try:
                 dispatched = False
                 if self._free and self._pending:
@@ -533,7 +647,8 @@ class ContinuousBatcher:
                         toks.copy_to_host_async()
                     except Exception:
                         pass
-                    events.append(("chunk", toks, dict(self._active)))
+                    events.append(("chunk", toks, dict(self._active),
+                                   time.perf_counter()))
                     dispatched = True
                 # keep the dispatch frontier at most ``pipeline`` chunks
                 # ahead of the processed state; when nothing new could be

@@ -89,6 +89,11 @@ class ModelServer:
         self._max_wait_ms = max_wait_ms
         self._batchers: Dict[str, "DynamicBatcher"] = {}
         self._register_routes()
+        # /metrics + /debug/traces + /debug/vars on the serving port itself:
+        # the SLO histograms live in this process, so the scrape must too
+        from ..runtime.obs import mount_observability
+
+        mount_observability(self.app)
 
     def add(self, model: ServedModel) -> "ModelServer":
         self.models[model.name] = model
@@ -245,9 +250,17 @@ class GenerativeModel(ServedModel):
         # on by default must not shrink the servable prompt range below
         # cfg.max_seq (review finding, round 5)
         if self.continuous and prompts.shape[1] <= PREFILL_BUCKETS[-1]:
+            from ..runtime.tracing import TRACER, format_traceparent
+
             eng = self._continuous_engine()
+            # hand the engine our trace context: when this runs inside the
+            # HTTP dispatch span, every serving.request span parents there
+            # (continuing the client's traceparent if one came in)
+            cur = TRACER.current_span()
+            tp = format_traceparent(cur) if cur is not None else None
             futs = [eng.submit(row, self.max_new_tokens,
-                               temperature=self.temperature) for row in prompts]
+                               temperature=self.temperature,
+                               traceparent=tp) for row in prompts]
             try:
                 return [row.tolist() + f.result(timeout=600.0)
                         for row, f in zip(prompts, futs)]

@@ -83,15 +83,25 @@ class StepClock:
     as slow data loading (the classic misread this exists to kill). With a
     ``metrics`` namespace (``METRICS.namespace("train")``) every phase also
     lands in ``<ns>_step_<phase>_seconds`` histograms for ``/metrics``.
+    With a ``tracer`` (``runtime.tracing.TRACER``) every ``end_step()``
+    additionally emits one ``span_name`` span covering the step, its phases
+    attached as events — so a bench/dryrun's training timeline shows up in
+    ``/debug/traces`` next to the serving requests.
     """
 
-    def __init__(self, metrics: Optional[Any] = None) -> None:
+    def __init__(self, metrics: Optional[Any] = None,
+                 tracer: Optional[Any] = None,
+                 span_name: str = "train.step") -> None:
         self._metrics = metrics
+        self._tracer = tracer
+        self._span_name = span_name
         self.compile_s = 0.0
         self.steps: List[Dict[str, float]] = []
         self.notes: Dict[str, float] = {}
         self._current: Dict[str, float] = {}
         self._anchor = time.perf_counter()
+        self._step_start_ns = time.time_ns()
+        self._events: List[Dict[str, Any]] = []
 
     def note(self, key: str, value: float) -> None:
         """Attach a derived scalar (analytic comm bytes, bubble fraction —
@@ -111,6 +121,10 @@ class StepClock:
             self._current[name] = self._current.get(name, 0.0) + dt
             if self._metrics is not None:
                 self._metrics.histogram(f"step_{name}_seconds").observe(dt)
+            if self._tracer is not None:
+                self._events.append({"name": name,
+                                     "timeUnixNano": time.time_ns(),
+                                     "attributes": {"seconds": dt}})
 
     # The canonical phases as methods so call sites stay greppable.
     def data_wait(self):
@@ -136,12 +150,16 @@ class StepClock:
             if self._metrics is not None:
                 self._metrics.gauge("compile_seconds").set(self.compile_s)
             self._anchor = time.perf_counter()
+            self._step_start_ns = time.time_ns()
+            self._events = []
 
     def mark(self) -> None:
         """Reset the wall anchor without recording — call after untimed
         work between steps (warmup executions, logging) so the next step's
         ``total``/``other`` doesn't absorb it."""
         self._anchor = time.perf_counter()
+        self._step_start_ns = time.time_ns()
+        self._events = []
 
     def end_step(self) -> Dict[str, float]:
         now = time.perf_counter()
@@ -149,6 +167,15 @@ class StepClock:
         rec["total"] = now - self._anchor
         rec["other"] = max(0.0, rec["total"] - sum(self._current.values()))
         self.steps.append(rec)
+        if self._tracer is not None:
+            now_ns = time.time_ns()
+            self._tracer.emit_span(
+                self._span_name, self._step_start_ns, now_ns,
+                events=self._events,
+                **{"step": len(self.steps),
+                   **{f"phase.{k}": round(v, 6) for k, v in rec.items()}})
+            self._step_start_ns = now_ns
+            self._events = []
         self._current = {}
         self._anchor = now
         return rec

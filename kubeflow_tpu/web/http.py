@@ -154,6 +154,8 @@ class App:
     def dispatch(self, req: Request) -> JsonResponse:
         from ..runtime.tracing import TRACER  # late import: web ↛ runtime cycle
 
+        from ..runtime.tracing import format_traceparent
+
         with TRACER.span(
             f"{self.name} {req.method}",
             traceparent=req.header("traceparent") or None,
@@ -161,6 +163,9 @@ class App:
         ) as span:
             resp = self._dispatch_inner(req)
             span.set("http.status_code", resp.status)
+            # echo the handler span back so callers can join client + server
+            # timelines without a response-body contract
+            resp.headers.setdefault("traceparent", format_traceparent(span))
             if isinstance(resp, StreamingResponse):
                 span.set("http.streaming", True)  # span closes at stream start
             elif resp.status >= 500:

@@ -199,6 +199,57 @@ class _F32MatmulFinder(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# -- metric-catalog gate: every metric name must be documented ----------------
+#
+# docs/OBSERVABILITY.md is the catalog of record for the observability plane.
+# A metric registered in code but absent there is invisible to operators and
+# rots the moment someone renames it — so the catalog is lint-enforced.
+
+_METRIC_METHODS = {"counter", "gauge", "histogram", "timer"}
+
+
+def _registered_metric_names():
+    """(name, namespace prefixes in the file, path, lineno) for every
+    constant-name metric registration under kubeflow_tpu/. f-string and
+    variable names (StepClock's ``step_{name}_seconds``, note() gauges)
+    have no constant to check and are skipped — the catalog documents
+    their patterns prose-side instead."""
+    pkg = ROOT / "kubeflow_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        prefixes = set()
+        calls = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                    and node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            if node.func.attr == "namespace":
+                prefixes.add(node.args[0].value)
+            elif node.func.attr in _METRIC_METHODS:
+                calls.append((node.args[0].value, node.lineno))
+        for name, lineno in calls:
+            yield name, prefixes, path, lineno
+
+
+def test_metric_names_are_cataloged():
+    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
+    import re
+
+    documented = set(re.findall(r"`([A-Za-z_:][A-Za-z0-9_:]*)`", catalog))
+    missing = []
+    for name, prefixes, path, lineno in _registered_metric_names():
+        candidates = {name} | {f"{p}_{name}" for p in prefixes}
+        if not candidates & documented:
+            missing.append(
+                f"{path.relative_to(ROOT)}:{lineno}: metric {name!r} "
+                "not documented in docs/OBSERVABILITY.md")
+    assert not missing, (
+        "add these metrics to the docs/OBSERVABILITY.md catalog "
+        "(name, type, labels, meaning):\n" + "\n".join(missing)
+    )
+
+
 def test_no_f32_matmuls_outside_sanctioned_islands():
     """Model forward passes keep matmul/einsum inputs bf16; fp32 appears
     only in the allowlisted islands above. A new f32 contraction must either

@@ -88,6 +88,84 @@ class TestSpans:
         assert rec["status"]["code"] == "OK"
         assert len(rec["traceId"]) == 32 and len(rec["spanId"]) == 16
 
+    def test_ids_unaffected_by_seeded_random(self):
+        """Ids come from os.urandom: a fixture that calls random.seed(0)
+        (plenty do) must not make two spans mint the same trace id."""
+        import random
+
+        ids = set()
+        t = Tracer("t")
+        for _ in range(8):
+            random.seed(0)
+            with t.span("s") as s:
+                ids.add((s.trace_id, s.span_id))
+        assert len(ids) == 8
+
+    def test_slow_export_does_not_hold_ring_lock(self, tmp_path):
+        """The JSON-serialize + file write happens OUTSIDE the ring lock:
+        while one thread is stuck in a slow write, finished_spans() (ring
+        readers) and other recorders must not block behind it."""
+        path = tmp_path / "trace.jsonl"
+        t = Tracer("svc", export_path=str(path))
+
+        release = threading.Event()
+        entered = threading.Event()
+
+        class _SlowFile:
+            def write(self, line):
+                entered.set()
+                release.wait(5)
+
+            def flush(self):
+                pass
+
+        t._export_file = _SlowFile()
+        blocker = threading.Thread(
+            target=lambda: t.end_span(t.start_span("slow")), daemon=True)
+        blocker.start()
+        assert entered.wait(5), "exporter never reached the write"
+        try:
+            # the slow span already sits in the ring; a reader must see it
+            # without waiting for the write to finish
+            done = {}
+
+            def read():
+                done["spans"] = [s.name for s in t.finished_spans()]
+
+            reader = threading.Thread(target=read, daemon=True)
+            reader.start()
+            reader.join(2)
+            assert not reader.is_alive(), "finished_spans() blocked on a slow export"
+            assert done["spans"] == ["slow"]
+        finally:
+            release.set()
+            blocker.join(5)
+
+    def test_start_end_span_cross_thread(self):
+        """start_span/end_span is the cross-thread request lifecycle: the
+        span parents correctly but never becomes the thread-local current
+        span, and can be ended from a different thread."""
+        t = Tracer("t")
+        with t.span("handler") as handler:
+            req_span = t.start_span("work")
+            assert t.current_span() is handler  # NOT req_span
+        assert req_span.trace_id == handler.trace_id
+        assert req_span.parent_span_id == handler.span_id
+        th = threading.Thread(target=lambda: t.end_span(req_span))
+        th.start()
+        th.join()
+        assert [s.name for s in t.finished_spans(name="work")] == ["work"]
+
+    def test_emit_span_records_elapsed_interval(self):
+        t = Tracer("t")
+        s = t.emit_span("step", 100, 200,
+                        events=[{"name": "compute", "timeUnixNano": 150,
+                                 "attributes": {}}], foo="bar")
+        assert s.start_ns == 100 and s.end_ns == 200
+        (got,) = t.finished_spans(name="step")
+        assert got.events[0]["name"] == "compute"
+        assert got.attributes["foo"] == "bar"
+
 
 class TestRuntimeIntegration:
     def test_reconciles_emit_spans(self):
