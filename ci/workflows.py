@@ -130,12 +130,28 @@ def observability_e2e() -> Dict:
     return b.build()
 
 
+def control_plane_e2e() -> Dict:
+    """The control-plane observability job: an oversized gang against a
+    small fake topology over real HTTP — every candidate node must show up
+    in /debug/scheduler with a machine-readable rejection and each member
+    pod must carry ONE aggregated FailedScheduling Event
+    (e2e/control_plane_driver.py asserts both), plus the flight-recorder /
+    Event-pipeline / workqueue / informer / apiserver unit suite."""
+    b = WorkflowBuilder("control-plane-e2e")
+    b.run("gang-flight-recorder", ["python", "-m", "e2e.control_plane_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("control-plane-unit", "tests/test_control_plane_obs.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
     "platform-e2e": platform_e2e,
     "multichip-e2e": multichip_e2e,
     "observability-e2e": observability_e2e,
+    "control-plane-e2e": control_plane_e2e,
 }
 
 

@@ -18,6 +18,7 @@ from .store import NotFound, Store
 class Client:
     def __init__(self, store: Store):
         self.store = store
+        self._events: Optional["EventRecorder"] = None
 
     def _res(self, api_version: str, kind: str) -> Resource:
         return REGISTRY.for_kind(api_version, kind)
@@ -84,6 +85,16 @@ class Client:
                 apimeta.api_version_of(obj), obj["kind"], apimeta.name_of(obj), apimeta.namespace_of(obj)
             )
 
+    @property
+    def events(self) -> "EventRecorder":
+        """The client's EventRecorder (lazy — most clients never emit; the
+        import is deferred because runtime/__init__ imports this module)."""
+        if self._events is None:
+            from ..runtime.events import EventRecorder
+
+            self._events = EventRecorder(self)
+        return self._events
+
     def emit_event(
         self,
         involved: Dict[str, Any],
@@ -91,33 +102,11 @@ class Client:
         message: str,
         type_: str = "Normal",
         component: str = "kubeflow-tpu",
-    ) -> Dict[str, Any]:
+    ) -> Optional[Dict[str, Any]]:
         """Record a v1 Event against an object (reference mirrors pod events
-        onto Notebook CRs — notebook_controller.go:90-109)."""
-        ns = apimeta.namespace_of(involved) or "default"
-        ev = apimeta.new_object(
-            "v1",
-            "Event",
-            name="",
-            namespace=ns,
-        )
-        ev["metadata"]["generateName"] = f"{apimeta.name_of(involved)}."
-        ev.update(
-            {
-                "involvedObject": {
-                    "apiVersion": apimeta.api_version_of(involved),
-                    "kind": involved.get("kind"),
-                    "name": apimeta.name_of(involved),
-                    "namespace": ns,
-                    "uid": apimeta.uid_of(involved),
-                },
-                "reason": reason,
-                "message": message,
-                "type": type_,
-                "source": {"component": component},
-                "firstTimestamp": Store.now(),
-                "lastTimestamp": Store.now(),
-                "count": 1,
-            }
-        )
-        return self.create(ev)
+        onto Notebook CRs — notebook_controller.go:90-109). Routed through
+        the correlating :class:`EventRecorder`: a duplicate (same involved
+        object, reason, component, type) bumps ``count``/``lastTimestamp``
+        on the existing Event instead of minting a new object. Returns the
+        stored Event, or None when the spam filter dropped it."""
+        return self.events.emit(involved, reason, message, type_=type_, component=component)

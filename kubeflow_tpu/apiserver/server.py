@@ -29,6 +29,8 @@ from typing import Any, Dict, Iterator, List, Optional
 from ..api import meta as apimeta
 from ..api.conversion import convert, convert_fragment, hub_resource
 from ..api.meta import REGISTRY, Resource
+from ..runtime.metrics import METRICS
+from ..runtime.tracing import TRACER
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .auth import ApiAuth, Identity, Unauthenticated
 from .store import ApiError, Store
@@ -279,6 +281,50 @@ def make_apiserver_app(
         except ApiError as e:
             return error(e)
 
+    def instrumented(verb: str, handler):
+        """kube-apiserver's request SLI surface: one histogram + in-flight
+        gauge per (verb, resource), plus a child span under the dispatch
+        span (which already continues any inbound ``traceparent``, so a
+        controller's write shows up inside its reconcile trace)."""
+
+        def wrapped(req: Request):
+            v = verb
+            if v == "list" and req.query1("watch") in ("true", "1"):
+                v = "watch"
+            resource = req.params.get("plural", "")
+            gauge = METRICS.gauge("apiserver_inflight_requests", verb=v)
+            gauge.inc()
+            start = time.monotonic()
+            dec_on_exit = True
+            try:
+                with TRACER.span(f"apiserver.{v}", resource=resource, verb=v):
+                    resp = handler(req)
+                if v == "watch" and isinstance(resp, StreamingResponse):
+                    # a watch is long-running: it stays in-flight until the
+                    # stream closes, and its "duration" is the stream
+                    # lifetime — ~0s dispatch samples would pollute the
+                    # latency ladder, so the histogram skips watches
+                    dec_on_exit = False
+                    prev_close = resp.on_close
+
+                    def close() -> None:
+                        gauge.dec()
+                        if prev_close is not None:
+                            prev_close()
+
+                    resp.on_close = close
+                return resp
+            finally:
+                if dec_on_exit:
+                    gauge.dec()
+                if v != "watch":
+                    METRICS.histogram(
+                        "apiserver_request_seconds", verb=v, resource=resource
+                    ).observe(time.monotonic() - start)
+
+        wrapped.__name__ = getattr(handler, "__name__", verb)
+        return wrapped
+
     # -- route table ---------------------------------------------------------
     # /api/v1/... (core) and /apis/<group>/<version>/... share handlers; the
     # core prefix hard-pins version into the pattern params via defaults.
@@ -288,13 +334,14 @@ def make_apiserver_app(
     ]
     for prefix in prefixes:
         for scope in (f"{prefix}/namespaces/<ns>", prefix):
-            app.route(f"{scope}/<plural>", methods=("GET",))(list_or_watch)
-            app.route(f"{scope}/<plural>", methods=("POST",))(create)
-            app.route(f"{scope}/<plural>/<name>", methods=("GET",))(get_item)
-            app.route(f"{scope}/<plural>/<name>", methods=("PUT",))(put_item)
-            app.route(f"{scope}/<plural>/<name>/status", methods=("PUT",))(put_status)
-            app.route(f"{scope}/<plural>/<name>", methods=("PATCH",))(patch_item)
-            app.route(f"{scope}/<plural>/<name>", methods=("DELETE",))(delete_item)
+            app.route(f"{scope}/<plural>", methods=("GET",))(instrumented("list", list_or_watch))
+            app.route(f"{scope}/<plural>", methods=("POST",))(instrumented("create", create))
+            app.route(f"{scope}/<plural>/<name>", methods=("GET",))(instrumented("get", get_item))
+            app.route(f"{scope}/<plural>/<name>", methods=("PUT",))(instrumented("update", put_item))
+            app.route(f"{scope}/<plural>/<name>/status", methods=("PUT",))(
+                instrumented("update_status", put_status))
+            app.route(f"{scope}/<plural>/<name>", methods=("PATCH",))(instrumented("patch", patch_item))
+            app.route(f"{scope}/<plural>/<name>", methods=("DELETE",))(instrumented("delete", delete_item))
 
     @app.route("/healthz")
     def healthz(req: Request):

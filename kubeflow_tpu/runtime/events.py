@@ -1,0 +1,231 @@
+"""Event pipeline: a K8s-style EventRecorder with correlation, spam
+protection, and bounded retention.
+
+Before this module every ``client.emit_event`` call created a fresh v1
+Event object — a gang stuck in scheduling backoff would mint one Event per
+attempt forever, and nothing ever deleted them. Kubernetes solved the same
+problem in client-go's EventCorrelator (record/event.go +
+events_cache.go): correlate duplicates onto one object, rate-limit noisy
+sources, and let the apiserver GC old Events. The recorder rebuilds those
+three layers over our Store:
+
+- **Aggregation** — events are keyed on (involved uid, reason, component,
+  type). A duplicate emit PATCHes the existing Event — bump ``count``,
+  refresh ``lastTimestamp``/``message`` — instead of creating a new
+  object, so "FailedScheduling × 40 attempts" is ONE Event with
+  ``count=40``, exactly what ``kubectl describe`` renders.
+- **Spam filter** — a token bucket per (component, involved uid), the
+  shape of client-go's EventSourceObjectSpamFilter: ``burst`` emits up
+  front, then ``refill_per_second``. Dropped emits are counted in
+  ``events_discarded_total`` and return None; they must never block or
+  fail the caller.
+- **Retention GC** — the recorder remembers the Events it created in
+  insertion order and deletes the oldest once more than ``max_events``
+  correlation entries are live, bounding store growth from any single
+  process regardless of uptime.
+
+``Client.emit_event`` threads every existing call site (notebook
+controller mirroring, culler, scheduler, webhooks) through one recorder
+per client, so aggregation is platform-wide without touching callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ..api import meta as apimeta
+from .metrics import METRICS
+
+#: correlation key: involved object identity + what happened + who said it
+AggKey = Tuple[str, str, str, str]
+
+
+@dataclass
+class _AggEntry:
+    namespace: str
+    name: str  # Event object name in the store
+    count: int
+    #: spam bookkeeping rides the entry so both caches expire together
+    first_seen: float = field(default_factory=time.monotonic)
+
+
+class _TokenBucket:
+    def __init__(self, burst: int, refill_per_second: float) -> None:
+        self.capacity = float(burst)
+        self.tokens = float(burst)
+        self.refill = refill_per_second
+        self.last = time.monotonic()
+
+    def take(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        self.tokens = min(self.capacity, self.tokens + (now - self.last) * self.refill)
+        self.last = now
+        if self.tokens < 1.0:
+            return False
+        self.tokens -= 1.0
+        return True
+
+
+def _involved_ref(involved: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "apiVersion": apimeta.api_version_of(involved),
+        "kind": involved.get("kind"),
+        "name": apimeta.name_of(involved),
+        "namespace": apimeta.namespace_of(involved) or "default",
+        "uid": apimeta.uid_of(involved),
+    }
+
+
+def _involved_id(involved: Dict[str, Any]) -> str:
+    """Stable identity for correlation: uid when the object carries one,
+    else the (kind, ns, name) triple — fixture objects in unit tests are
+    often emitted before they ever hit the store."""
+    uid = apimeta.uid_of(involved)
+    if uid:
+        return str(uid)
+    ns = apimeta.namespace_of(involved) or "default"
+    return f"{involved.get('kind')}/{ns}/{apimeta.name_of(involved)}"
+
+
+class EventRecorder:
+    """Correlating, spam-filtered, retention-bounded Event writer.
+
+    One instance per :class:`~..apiserver.client.Client`; all methods are
+    thread-safe (controllers emit from worker threads concurrently).
+    """
+
+    def __init__(
+        self,
+        client,
+        max_events: int = 256,
+        burst: int = 25,
+        refill_per_second: float = 1.0 / 30.0,
+    ) -> None:
+        self.client = client
+        self.max_events = max_events
+        self.burst = burst
+        self.refill_per_second = refill_per_second
+        self._lock = threading.Lock()
+        #: insertion-ordered correlation cache — doubles as the GC ledger
+        self._agg: Dict[AggKey, _AggEntry] = {}
+        self._buckets: Dict[Tuple[str, str], _TokenBucket] = {}
+
+    # -- the one public verb --------------------------------------------------
+    def emit(
+        self,
+        involved: Dict[str, Any],
+        reason: str,
+        message: str,
+        type_: str = "Normal",
+        component: str = "kubeflow-tpu",
+    ) -> Optional[Dict[str, Any]]:
+        """Record an Event against ``involved``; returns the stored Event,
+        or None when the source's spam budget dropped it."""
+        key: AggKey = (_involved_id(involved), reason, component, type_)
+        with self._lock:
+            if not self._spam_ok(component, key[0]):
+                METRICS.counter("events_discarded_total", component=component).inc()
+                return None
+            entry = self._agg.get(key)
+        if entry is not None:
+            ev = self._bump(key, entry, message, component)
+            if ev is not None:
+                return ev
+            # the aggregated Event vanished under us (deleted externally);
+            # fall through and start a fresh correlation entry
+            with self._lock:
+                self._agg.pop(key, None)
+        ev = self._create(involved, reason, message, type_, component)
+        doomed = []
+        with self._lock:
+            self._agg[key] = _AggEntry(
+                namespace=ev["metadata"]["namespace"],
+                name=ev["metadata"]["name"],
+                count=1,
+            )
+            while len(self._agg) > self.max_events:
+                old_key = next(iter(self._agg))
+                doomed.append(self._agg.pop(old_key))
+        for old in doomed:  # retention GC: store deletes happen off-lock
+            METRICS.counter("events_retention_deleted_total").inc()
+            self.client.delete_opt("v1", "Event", old.name, old.namespace)
+        return ev
+
+    # -- internals -------------------------------------------------------------
+    def _spam_ok(self, component: str, involved_id: str) -> bool:
+        """Caller holds the lock. Per-(source, object) budget, the
+        EventSourceObjectSpamFilter shape — one chatty pod cannot starve
+        every other object's events from the same component."""
+        bkey = (component, involved_id)
+        bucket = self._buckets.get(bkey)
+        if bucket is None:
+            bucket = self._buckets[bkey] = _TokenBucket(self.burst, self.refill_per_second)
+            # the bucket map tracks the agg cache's bound: drop stale buckets
+            # once it outgrows the retention budget by a wide margin
+            if len(self._buckets) > 4 * self.max_events:
+                for stale in list(self._buckets)[: len(self._buckets) // 2]:
+                    del self._buckets[stale]
+        return bucket.take()
+
+    def _bump(
+        self, key: AggKey, entry: _AggEntry, message: str, component: str
+    ) -> Optional[Dict[str, Any]]:
+        """Aggregate a duplicate onto the existing Event via merge-patch."""
+        from ..apiserver.store import NotFound, Store
+
+        with self._lock:
+            entry.count += 1
+            count = entry.count
+        try:
+            ev = self.client.patch(
+                "v1",
+                "Event",
+                entry.name,
+                {"count": count, "lastTimestamp": Store.now(), "message": message},
+                entry.namespace,
+            )
+        except NotFound:
+            return None
+        METRICS.counter("events_emitted_total", component=component, outcome="aggregated").inc()
+        return ev
+
+    def _create(
+        self,
+        involved: Dict[str, Any],
+        reason: str,
+        message: str,
+        type_: str,
+        component: str,
+    ) -> Dict[str, Any]:
+        from ..apiserver.store import Store
+
+        ns = apimeta.namespace_of(involved) or "default"
+        ev = apimeta.new_object("v1", "Event", name="", namespace=ns)
+        ev["metadata"]["generateName"] = f"{apimeta.name_of(involved)}."
+        # ONE timestamp for both fields: calling Store.now() twice can
+        # straddle a second boundary and mint a fresh Event whose
+        # firstTimestamp != lastTimestamp (ISSUE 5 satellite).
+        now = Store.now()
+        ev.update(
+            {
+                "involvedObject": _involved_ref(involved),
+                "reason": reason,
+                "message": message,
+                "type": type_,
+                "source": {"component": component},
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "count": 1,
+            }
+        )
+        created = self.client.create(ev)
+        METRICS.counter("events_emitted_total", component=component, outcome="created").inc()
+        return created
+
+    # -- introspection (tests / debug) ----------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"correlated": len(self._agg), "buckets": len(self._buckets)}

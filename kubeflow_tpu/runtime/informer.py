@@ -21,10 +21,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
+from .metrics import METRICS
 
 log = logging.getLogger("kubeflow_tpu.informer")
 
@@ -64,16 +66,30 @@ class SharedInformer:
         # marker. wait_rv() is the read-your-writes barrier built on it.
         self._rv_cond = threading.Condition()
         self._last_rv = 0
+        self._last_sync_mono: Optional[float] = None
+        self._warned_malformed_rv = False
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "SharedInformer":
         if self._thread is not None:
             return self
+        # Staleness is the informer failure mode operators actually hit — a
+        # wedged watch serves reads forever without erroring. Scrape-time
+        # collector so the age keeps growing between syncs; keyed per kind
+        # so a replacement informer takes over the series.
+        METRICS.register_collector(f"informer_{self.kind}", self._collect)
         self._thread = threading.Thread(
             target=self._pump, name=f"informer-{self.kind}", daemon=True
         )
         self._thread.start()
         return self
+
+    def _collect(self) -> None:
+        last = self._last_sync_mono
+        if last is not None:
+            METRICS.gauge("informer_last_sync_age_seconds", kind=self.kind).set(
+                time.monotonic() - last
+            )
 
     def stop(self) -> None:
         self._stopped.set()
@@ -106,6 +122,19 @@ class SharedInformer:
         try:
             rv = int(rv_str)
         except (TypeError, ValueError):
+            # A malformed RV quietly disables the wait_rv() barrier for this
+            # write — readers fall back to sync timeouts. Count every one,
+            # log once per informer so a misbehaving backend is visible
+            # without flooding.
+            METRICS.counter("informer_malformed_rv_total", kind=self.kind).inc()
+            if not self._warned_malformed_rv:
+                self._warned_malformed_rv = True
+                log.warning(
+                    "informer %s: malformed resourceVersion %r; "
+                    "read-your-writes barrier degraded for such events",
+                    self.kind,
+                    rv_str,
+                )
             return
         with self._rv_cond:
             if rv > self._last_rv:
@@ -194,6 +223,7 @@ class SharedInformer:
                 )
             except Exception as e:
                 log.warning("informer %s: watch connect failed: %s", self.kind, e)
+                METRICS.counter("informer_watch_reconnects_total", kind=self.kind).inc()
                 self._stopped.wait(1.0)
                 continue
             with self._lock:
@@ -217,11 +247,15 @@ class SharedInformer:
                             for key, old in vanished:
                                 self._apply("DELETED", key, old)
                         self._note_rv((event.object or {}).get("resourceVersion"))
+                        self._last_sync_mono = time.monotonic()
                         self._synced.set()
                         for _key, old in vanished:
                             self._dispatch("DELETED", old)
                         continue
                     obj = event.object
+                    METRICS.counter(
+                        "informer_events_total", kind=self.kind, type=event.type
+                    ).inc()
                     key = (apimeta.namespace_of(obj), apimeta.name_of(obj))
                     if syncing:
                         seen.add(key)
@@ -232,6 +266,7 @@ class SharedInformer:
             except Exception as e:
                 log.warning("informer %s: watch stream error: %s", self.kind, e)
             if not self._stopped.is_set():
+                METRICS.counter("informer_watch_reconnects_total", kind=self.kind).inc()
                 self._stopped.wait(0.2)
 
     def _dispatch(self, event_type: str, obj: Dict[str, Any]) -> None:
@@ -239,6 +274,7 @@ class SharedInformer:
             try:
                 fn(event_type, obj)
             except Exception:
+                METRICS.counter("informer_handler_failures_total", kind=self.kind).inc()
                 log.exception("informer %s: handler failed", self.kind)
 
 

@@ -64,33 +64,68 @@ class Reconciler:
 
 
 class _WorkQueue:
-    """Deduplicating delayed workqueue with per-item failure backoff."""
+    """Deduplicating delayed workqueue with per-item failure backoff.
 
-    def __init__(self) -> None:
+    Instrumented with the controller-runtime workqueue metric family
+    (``workqueue_depth``/``adds``/``queue_duration``/``retries``/
+    ``unfinished_work``), labeled by the owning controller's name — the
+    first dashboard anyone opens when a controller looks stuck.
+    """
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
         self._cond = threading.Condition()
         self._pending: Dict[Request, None] = {}
         self._delayed: List[Tuple[float, int, Request]] = []
+        #: authoritative earliest deadline per request — heap entries whose
+        #: deadline disagrees are superseded duplicates and get dropped on pop
+        self._deadlines: Dict[Request, float] = {}
         self._seq = 0
         self._failures: Dict[Request, int] = {}
         self._processing = 0
+        #: enqueue time per pending request (queue-duration histogram)
+        self._added_at: Dict[Request, float] = {}
+        #: start times of in-flight items, FIFO-drained by task_done()
+        self._inflight: Dict[int, float] = {}
         self._shutdown = False
+        # unfinished-work must grow while a reconcile hangs, so it is
+        # computed at scrape time; keyed registration keeps remounts (and
+        # per-test Managers reusing controller names) from stacking up
+        METRICS.register_collector(f"workqueue_{name}", self._collect)
+
+    def _collect(self) -> None:
+        now = time.monotonic()
+        with self._cond:
+            depth = len(self._pending)
+            unfinished = sum(now - t for t in self._inflight.values())
+        METRICS.gauge("workqueue_depth", queue=self.name).set(depth)
+        METRICS.gauge("workqueue_unfinished_work_seconds", queue=self.name).set(unfinished)
 
     def add(self, req: Request) -> None:
         with self._cond:
             if req not in self._pending:
                 self._pending[req] = None
+                self._added_at.setdefault(req, time.monotonic())
+                METRICS.counter("workqueue_adds_total", queue=self.name).inc()
+                METRICS.gauge("workqueue_depth", queue=self.name).set(len(self._pending))
                 self._cond.notify()
 
     def add_after(self, req: Request, delay: float) -> None:
+        deadline = time.monotonic() + delay
         with self._cond:
+            cur = self._deadlines.get(req)
+            if cur is not None and cur <= deadline:
+                return  # already scheduled at least as early; no new entry
+            self._deadlines[req] = deadline
             self._seq += 1
-            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, req))
+            heapq.heappush(self._delayed, (deadline, self._seq, req))
             self._cond.notify()
 
     def add_rate_limited(self, req: Request) -> None:
         with self._cond:
             n = self._failures.get(req, 0)
             self._failures[req] = n + 1
+        METRICS.counter("workqueue_retries_total", queue=self.name).inc()
         self.add_after(req, min(0.005 * (2**n), 30.0))
 
     def forget(self, req: Request) -> None:
@@ -103,13 +138,26 @@ class _WorkQueue:
             while True:
                 now = time.monotonic()
                 while self._delayed and self._delayed[0][0] <= now:
-                    _, _, req = heapq.heappop(self._delayed)
-                    if req not in self._pending:
-                        self._pending[req] = None
+                    due, _, dreq = heapq.heappop(self._delayed)
+                    if self._deadlines.get(dreq) != due:
+                        continue  # superseded by an earlier add_after
+                    del self._deadlines[dreq]
+                    if dreq not in self._pending:
+                        self._pending[dreq] = None
+                        self._added_at.setdefault(dreq, now)
+                        METRICS.counter("workqueue_adds_total", queue=self.name).inc()
                 if self._pending:
                     req = next(iter(self._pending))
                     del self._pending[req]
+                    added = self._added_at.pop(req, None)
+                    if added is not None:
+                        METRICS.histogram(
+                            "workqueue_queue_duration_seconds", queue=self.name
+                        ).observe(now - added)
                     self._processing += 1
+                    self._seq += 1
+                    self._inflight[self._seq] = now
+                    METRICS.gauge("workqueue_depth", queue=self.name).set(len(self._pending))
                     return req
                 if self._shutdown:
                     return None
@@ -126,6 +174,8 @@ class _WorkQueue:
     def task_done(self) -> None:
         with self._cond:
             self._processing -= 1
+            if self._inflight:
+                del self._inflight[next(iter(self._inflight))]
 
     def shutdown(self) -> None:
         with self._cond:
@@ -144,8 +194,8 @@ class _Controller:
     def __init__(self, mgr: "Manager", reconciler: Reconciler):
         self.mgr = mgr
         self.reconciler = reconciler
-        self.queue = _WorkQueue()
         self.name = type(reconciler).__name__
+        self.queue = _WorkQueue(self.name)
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
         self._watchers: List[Any] = []

@@ -25,7 +25,7 @@ import os
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Any, Callable, Dict, Optional
 
 from ..web.http import App, HttpError, JsonResponse, Request
 from .metrics import (
@@ -43,6 +43,18 @@ EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 #: hard ceiling on one /debug/traces response (the ring holds 4096 spans)
 MAX_TRACE_SPANS = 4096
+
+#: named debug sources served at ``/debug/<name>`` — process-global so a
+#: subsystem (the scheduler flight recorder) can register before or after
+#: any particular app mounts observability; last registration wins, which
+#: is what per-test reconciler instances need
+_DEBUG_SOURCES: Dict[str, Callable[[Request], Any]] = {}
+
+
+def register_debug_source(name: str, handler: Callable[[Request], Any]) -> None:
+    """Expose ``handler(req) -> JSON-able`` at ``GET /debug/<name>`` on every
+    app that mounts observability (the Go expvar/pprof publish pattern)."""
+    _DEBUG_SOURCES[name] = handler
 
 
 def otlp_traces(tracer: Tracer, trace_id: Optional[str] = None,
@@ -117,6 +129,21 @@ def mount_observability(
             "trace_buffer_spans": len(trc.finished_spans()),
             "metric_families": families,
             "app": app.name,
+            "debug_sources": sorted(_DEBUG_SOURCES),
         }
+
+    # Registered LAST: dispatch matches routes in registration order, so the
+    # specific /debug/traces and /debug/vars patterns above always win over
+    # this parameterized catch-all.
+    @app.route("/debug/<source>")
+    def debug_source(req: Request):
+        handler = _DEBUG_SOURCES.get(req.params["source"])
+        if handler is None:
+            raise HttpError(
+                404,
+                f"unknown debug source {req.params['source']!r}; "
+                f"registered: {sorted(_DEBUG_SOURCES)}",
+            )
+        return handler(req)
 
     return app

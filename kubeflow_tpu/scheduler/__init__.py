@@ -5,6 +5,7 @@ from PodletReconciler (now a pure kubelet).
 """
 
 from .core import SCHED, BackoffQueue, SchedulerReconciler
+from .flight import Decision, FlightRecorder
 from .gang import (
     DEFAULT_PRIORITY,
     POD_GROUP_LABEL,
@@ -22,6 +23,8 @@ __all__ = [
     "BackoffQueue",
     "SchedulerReconciler",
     "ChipLedger",
+    "Decision",
+    "FlightRecorder",
     "Gang",
     "gang_of",
     "priority_of",

@@ -37,6 +37,12 @@ from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
 from ..runtime.tracing import TRACER
 from ..tpu.topology import chips_in_quota, pod_tpu_chips
+from .flight import (
+    Decision,
+    FlightRecorder,
+    dominant_node_reason,
+    failed_scheduling_message,
+)
 from .gang import (
     POD_GROUP_LABEL,
     QUOTA_NAME,
@@ -49,6 +55,9 @@ from .gang import (
 from .ledger import ChipLedger, GangKey
 
 SCHED = METRICS.namespace("scheduler")
+
+#: Event source.component for everything this scheduler writes
+COMPONENT = "tpu-scheduler"
 
 
 class BackoffQueue:
@@ -97,6 +106,8 @@ class SchedulerReconciler(Reconciler):
     ) -> None:
         self.ledger = ChipLedger()
         self.backoff = BackoffQueue(backoff_base, backoff_cap)
+        # every cycle's verdict, served at GET /debug/scheduler (flight.py)
+        self.flight = FlightRecorder()
         self.assembly_timeout = assembly_timeout
         self.reservation_ttl = reservation_ttl
         self._wired = False
@@ -188,7 +199,7 @@ class SchedulerReconciler(Reconciler):
             return "noop", 0.0
 
         if len(members) < gang.size:
-            return self._await_assembly(gang, pod, span)
+            return self._await_assembly(client, gang, pod, span)
 
         # Quota admission: chips already bound in the namespace plus this
         # gang's ask must fit the Profile's hard TPU limit.
@@ -204,7 +215,14 @@ class SchedulerReconciler(Reconciler):
                     )
                     self._mark_unschedulable(client, unbound, msg)
                     self._note_pending(key, unbound[0])
-                    return "quota_denied", self.backoff.next_delay(key)
+                    delay = self.backoff.next_delay(key)
+                    self._record(
+                        client, gang, unbound, "quota_denied", "quota", msg, delay,
+                        quota={"boundChips": bound_ns, "requestedChips": needed,
+                               "hardLimit": hard, "admitted": False},
+                        failed_event=True,
+                    )
+                    return "quota_denied", delay
 
         requirements = [
             (pod_tpu_chips(p), (p.get("spec") or {}).get("nodeSelector") or {})
@@ -212,23 +230,39 @@ class SchedulerReconciler(Reconciler):
         ]
         placement = self.ledger.place_and_reserve(key, requirements, self.reservation_ttl)
         if placement is None:
-            if self._try_preempt(client, gang, requirements, span):
+            preemption = self._try_preempt(client, gang, requirements, span)
+            if preemption.get("victim"):
                 # Victim evicted; its chips free asynchronously while our
                 # reservation (taken before the eviction) holds the claim.
                 self._note_pending(key, unbound[0])
+                self._record(
+                    client, gang, unbound, "preempted", "preemption",
+                    f"preempting lower-priority gang {preemption['victim']}",
+                    self.backoff.base, preemption=preemption,
+                )
                 return "preempted", self.backoff.base
             self.ledger.release(key)
-            self._mark_unschedulable(
-                client, unbound,
-                f"0/{gang.size} hosts bindable: no node set with enough free TPU chips "
-                f"for the whole gang",
-            )
+            # Re-judge each node AFTER releasing our own hold so the
+            # verdicts describe the world the next attempt will see.
+            nodes = self.ledger.explain(key, requirements)
+            msg = failed_scheduling_message(gang.size, nodes)
+            self._mark_unschedulable(client, unbound, msg)
             self._note_pending(key, unbound[0])
-            return "unschedulable", self.backoff.next_delay(key)
+            delay = self.backoff.next_delay(key)
+            self._record(
+                client, gang, unbound, "unschedulable",
+                dominant_node_reason(nodes), msg, delay,
+                nodes=nodes,
+                preemption=preemption if preemption["considered"] else None,
+                failed_event=True,
+            )
+            return "unschedulable", delay
 
         return self._bind(client, key, unbound, placement, span)
 
-    def _await_assembly(self, gang: Gang, pod: Dict[str, Any], span) -> Tuple[str, float]:
+    def _await_assembly(
+        self, client: Client, gang: Gang, pod: Dict[str, Any], span
+    ) -> Tuple[str, float]:
         """Gang not fully created yet: hold capacity for the FULL slice."""
         key = gang.key
         with self._lock:
@@ -239,16 +273,27 @@ class SchedulerReconciler(Reconciler):
             self.ledger.release(key)
             span.set("assembly_timeout", True)
             self._note_pending(key, pod)
-            return "assembly_timeout", self.backoff.next_delay(key)
+            delay = self.backoff.next_delay(key)
+            self._record(
+                client, gang, [pod], "assembly_timeout", "assembly_timeout",
+                f"gang incomplete after {waited:.1f}s (size {gang.size}); "
+                "capacity reservation released", delay, failed_event=True,
+            )
+            return "assembly_timeout", delay
         template = (
             pod_tpu_chips(pod),
             (pod.get("spec") or {}).get("nodeSelector") or {},
         )
         self.ledger.place_and_reserve(key, [template] * gang.size, self.reservation_ttl)
         self._note_pending(key, pod)
+        delay = min(self.reservation_ttl / 2, 1.0)
+        self._record(
+            client, gang, [pod], "waiting_gang", "assembling",
+            f"waiting for gang members (size {gang.size}); chips reserved", delay,
+        )
         # The missing members' ADDED events re-trigger scheduling; this
         # requeue only refreshes the reservation TTL / catches timeouts.
-        return "waiting_gang", min(self.reservation_ttl / 2, 1.0)
+        return "waiting_gang", delay
 
     def _bind(
         self,
@@ -258,6 +303,7 @@ class SchedulerReconciler(Reconciler):
         placement: List[str],
         span,
     ) -> Tuple[str, float]:
+        gang = gang_of(unbound[0])
         for target, node in zip(unbound, placement):
             ns, name = apimeta.namespace_of(target), apimeta.name_of(target)
             fresh = client.get_opt("v1", "Pod", name, ns)
@@ -269,18 +315,35 @@ class SchedulerReconciler(Reconciler):
             except Conflict:
                 # Raced a concurrent write; the reservation keeps the gang's
                 # chips held while we retry the remainder next cycle.
+                self._record(
+                    client, gang, [], "bind_conflict", "conflict",
+                    f"optimistic-concurrency conflict binding {ns}/{name}; retrying",
+                    self.backoff.base,
+                )
                 return "bind_conflict", self.backoff.base
             self.ledger.record_bind(bound)
+            client.emit_event(
+                bound, "Scheduled",
+                f"Successfully assigned {ns}/{name} to {node}",
+                component=COMPONENT,
+            )
         self.ledger.release(key)
         self._gang_done(key, bound=True)
         span.set("nodes", ",".join(sorted(set(placement))))
+        self._record(
+            client, gang, [], "bound", "scheduled",
+            f"all {len(placement)} members bound", 0.0,
+            placement=list(placement),
+        )
         return "bound", 0.0
 
     def _try_preempt(
         self, client: Client, gang: Gang, requirements, span
-    ) -> bool:
+    ) -> Dict[str, Any]:
         """Evict the lowest-priority running gang whose chips make this
-        gang's placement feasible. Reserve first, then evict."""
+        gang's placement feasible. Reserve first, then evict. Returns the
+        flight-recorder preemption record: every candidate considered and
+        the victim chosen (``victim`` is None when nothing helps)."""
         candidates = sorted(
             (
                 (info["priority"], sum(info["by_node"].values()), vkey, info)
@@ -289,12 +352,18 @@ class SchedulerReconciler(Reconciler):
                 and sum(info["by_node"].values()) > 0
             ),
         )
-        for _prio, _chips, vkey, info in candidates:
+        considered: List[Dict[str, Any]] = []
+        for prio, chips, vkey, info in candidates:
+            considered.append(
+                {"gang": f"{vkey[0]}/{vkey[1]}", "priority": prio, "chips": chips}
+            )
             placement = self.ledger.place_and_reserve(
                 gang.key, requirements, self.reservation_ttl, assume_freed=info["by_node"]
             )
             if placement is None:
+                considered[-1]["verdict"] = "would_not_help"
                 continue
+            considered[-1]["verdict"] = "chosen"
             for vns, vname in info["pods"]:
                 victim = client.get_opt("v1", "Pod", vname, vns)
                 if victim is not None:
@@ -303,14 +372,57 @@ class SchedulerReconciler(Reconciler):
                         "Preempted",
                         f"evicted by higher-priority gang {gang.namespace}/{gang.name}",
                         type_="Warning",
+                        component=COMPONENT,
                     )
                 client.delete_opt("v1", "Pod", vname, vns)
             SCHED.counter("preemptions_total").inc()
             span.set("preempted", f"{vkey[0]}/{vkey[1]}")
-            return True
-        return False
+            return {"considered": considered, "victim": f"{vkey[0]}/{vkey[1]}"}
+        return {"considered": considered, "victim": None}
 
     # -- helpers -------------------------------------------------------------
+
+    def _record(
+        self,
+        client: Client,
+        gang: Gang,
+        unbound: List[Dict[str, Any]],
+        outcome: str,
+        reason: str,
+        message: str,
+        delay: float,
+        nodes: Optional[List[Dict[str, Any]]] = None,
+        quota: Optional[Dict[str, Any]] = None,
+        preemption: Optional[Dict[str, Any]] = None,
+        placement: Optional[List[str]] = None,
+        failed_event: bool = False,
+    ) -> None:
+        """Flight-record this cycle's verdict; with ``failed_event``, also
+        summarize it as ONE aggregated FailedScheduling Warning per unbound
+        pod (the recorder bumps ``count`` on repeats, so a gang stuck for
+        an hour carries one Event whose count is the attempt tally)."""
+        key = gang.key
+        self.flight.record(
+            Decision(
+                gang=f"{key[0]}/{key[1]}",
+                outcome=outcome,
+                reason=reason,
+                message=message,
+                attempt=self.backoff.failures(key),
+                backoff_seconds=delay,
+                wall_time=time.time(),
+                nodes=nodes or [],
+                quota=quota,
+                preemption=preemption,
+                placement=placement,
+            )
+        )
+        if failed_event:
+            for p in unbound:
+                client.emit_event(
+                    p, "FailedScheduling", message, type_="Warning",
+                    component=COMPONENT,
+                )
 
     def _members(self, client: Client, gang: Gang, pod: Dict[str, Any]) -> List[Dict[str, Any]]:
         if not gang.labeled:

@@ -208,6 +208,58 @@ class ChipLedger:
                     self._reserved.pop(gang_key, None)
             return placement
 
+    def explain(
+        self,
+        gang_key: GangKey,
+        requirements: List[Tuple[int, Dict[str, str]]],
+        now: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Per-node feasibility verdict for the flight recorder: why each
+        candidate node can or cannot host (part of) the gang. Reasons are
+        machine-readable — ``feasible``, ``selector_mismatch``,
+        ``insufficient_chips``, ``reserved_by_other_gang`` — the scheduler
+        analog of kube-scheduler's per-plugin filter failure messages.
+
+        A node is judged against the *smallest* matching requirement: "can
+        this node host ANY member" — per-member assignment is the placer's
+        job, the verdict only explains infeasibility.
+        """
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            free = self._free_locked(gang_key, now)
+            raw_free = {n: cap - self._used.get(n, 0) for n, cap in self._capacity.items()}
+            verdicts: List[Dict[str, Any]] = []
+            for node in sorted(self._capacity):
+                labels = self._labels.get(node, {})
+                matching = [
+                    chips
+                    for chips, selector in requirements
+                    if not any(labels.get(k) != v for k, v in (selector or {}).items())
+                ]
+                if not matching:
+                    reason = "selector_mismatch"
+                    need = min((c for c, _s in requirements), default=0)
+                else:
+                    need = min(matching)
+                    if free.get(node, 0) >= need:
+                        reason = "feasible"
+                    elif raw_free.get(node, 0) >= need:
+                        # only reservations held by OTHER gangs separate
+                        # raw free capacity from schedulable free capacity
+                        reason = "reserved_by_other_gang"
+                    else:
+                        reason = "insufficient_chips"
+                verdicts.append(
+                    {
+                        "node": node,
+                        "reason": reason,
+                        "free_chips": free.get(node, 0),
+                        "capacity": self._capacity[node],
+                        "needed": need,
+                    }
+                )
+            return verdicts
+
     def reserve(self, gang_key: GangKey, by_node: Dict[str, int], ttl: float,
                 now: Optional[float] = None) -> None:
         now = time.monotonic() if now is None else now

@@ -1,0 +1,375 @@
+"""Control-plane observability (ISSUE 5): aggregated Events with spam
+protection and retention GC, workqueue/informer/apiserver telemetry, and
+the scheduler flight recorder's /debug/scheduler surface."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.server import make_apiserver_app
+from kubeflow_tpu.controllers.builtin import PodletReconciler, make_tpu_node
+from kubeflow_tpu.runtime.events import EventRecorder
+from kubeflow_tpu.runtime.informer import SharedInformer
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Request, Result, _WorkQueue
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.runtime.obs import mount_observability
+from kubeflow_tpu.scheduler import SchedulerReconciler
+from kubeflow_tpu.scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION
+from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+from kubeflow_tpu.web.http import App
+
+
+def mkpod(name, ns="default", chips=0, gang=None, size=1, selector=None):
+    spec = {"containers": [{"name": "c"}]}
+    if chips:
+        spec["containers"][0]["resources"] = {"limits": {RESOURCE_TPU: str(chips)}}
+    if selector:
+        spec["nodeSelector"] = selector
+    labels = {POD_GROUP_LABEL: gang} if gang else {}
+    annotations = {POD_GROUP_SIZE_ANNOTATION: str(size)} if gang else {}
+    return new_object("v1", "Pod", name, ns, labels=labels,
+                      annotations=annotations, spec=spec)
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), f"timed out waiting for {desc}"
+
+
+def events_for(client, name, ns="default", reason=None):
+    evs = client.list("v1", "Event", ns)
+    return [
+        e for e in evs
+        if (e.get("involvedObject") or {}).get("name") == name
+        and (reason is None or e.get("reason") == reason)
+    ]
+
+
+# -- Event pipeline ------------------------------------------------------------
+
+
+class TestEventAggregation:
+    def test_duplicate_emits_aggregate_onto_one_event(self, client):
+        pod = client.create(new_object("v1", "Pod", "p1", "default"))
+        n = 7
+        for _ in range(n):
+            client.emit_event(pod, "FailedScheduling", "no chips", type_="Warning")
+        evs = events_for(client, "p1", reason="FailedScheduling")
+        assert len(evs) == 1, "duplicates must aggregate, not create new Events"
+        assert evs[0]["count"] == n
+        assert evs[0]["type"] == "Warning"
+
+    def test_fresh_event_has_matching_timestamps(self, client):
+        # satellite: one Store.now() for both fields — never first != last
+        pod = client.create(new_object("v1", "Pod", "p2", "default"))
+        ev = client.emit_event(pod, "Started", "container started")
+        assert ev["firstTimestamp"] == ev["lastTimestamp"]
+        assert ev["count"] == 1
+
+    def test_distinct_reasons_stay_distinct_events(self, client):
+        pod = client.create(new_object("v1", "Pod", "p3", "default"))
+        client.emit_event(pod, "Pulled", "image pulled")
+        client.emit_event(pod, "Started", "container started")
+        assert len(events_for(client, "p3")) == 2
+
+    def test_aggregated_event_survives_external_delete(self, client):
+        # recorder falls back to a fresh create when its cached Event is gone
+        pod = client.create(new_object("v1", "Pod", "p4", "default"))
+        ev = client.emit_event(pod, "Killing", "bye", type_="Warning")
+        client.delete("v1", "Event", ev["metadata"]["name"], "default")
+        ev2 = client.emit_event(pod, "Killing", "bye again", type_="Warning")
+        assert ev2 is not None and ev2["count"] == 1
+
+    def test_retention_gc_bounds_stored_events(self, client):
+        rec = EventRecorder(client, max_events=4)
+        for i in range(10):
+            pod = client.create(new_object("v1", "Pod", f"gc-{i}", "default"))
+            rec.emit(pod, "Tick", "x")
+        stored = client.list("v1", "Event", "default")
+        assert len(stored) == 4, "retention GC must delete the oldest Events"
+        assert METRICS.value("events_retention_deleted_total") == 6
+        assert rec.stats()["correlated"] == 4
+
+    def test_spam_token_bucket_drops_and_counts(self, client):
+        rec = EventRecorder(client, burst=2, refill_per_second=0.0)
+        pod = client.create(new_object("v1", "Pod", "chatty", "default"))
+        # distinct reasons so aggregation can't absorb them: the bucket is
+        # per (component, involved object), not per correlation key
+        assert rec.emit(pod, "R0", "m") is not None
+        assert rec.emit(pod, "R1", "m") is not None
+        assert rec.emit(pod, "R2", "m") is None, "third emit exceeds burst"
+        assert METRICS.value("events_discarded_total", component="kubeflow-tpu") == 1
+        assert len(events_for(client, "chatty")) == 2
+
+    def test_emitted_metrics_by_outcome(self, client):
+        pod = client.create(new_object("v1", "Pod", "m1", "default"))
+        client.emit_event(pod, "Pulled", "once")
+        client.emit_event(pod, "Pulled", "twice")
+        assert METRICS.value(
+            "events_emitted_total", component="kubeflow-tpu", outcome="created") == 1
+        assert METRICS.value(
+            "events_emitted_total", component="kubeflow-tpu", outcome="aggregated") == 1
+
+
+# -- workqueue -----------------------------------------------------------------
+
+
+class TestWorkQueue:
+    def test_add_after_dedups_to_earliest_deadline(self):
+        q = _WorkQueue("t")
+        r = Request("ns", "a")
+        for _ in range(50):
+            q.add_after(r, 5.0)
+        assert len(q._delayed) == 1, "hot requeue loop must not grow the heap"
+        # an earlier deadline supersedes (one extra heap entry, same request)
+        q.add_after(r, 0.01)
+        assert len(q._delayed) == 2
+        assert q.get(timeout=2.0) == r
+        # the stale 5s duplicate must not redeliver the request
+        q.task_done()
+        assert q.get(timeout=0.05) is None
+
+    def test_later_deadline_never_delays_earlier_one(self):
+        q = _WorkQueue("t2")
+        r = Request("ns", "b")
+        q.add_after(r, 0.01)
+        q.add_after(r, 30.0)  # ignored: an earlier requeue already exists
+        start = time.monotonic()
+        assert q.get(timeout=2.0) == r
+        assert time.monotonic() - start < 1.0
+
+    def test_metrics_under_failing_reconciler(self, manager):
+        class Exploder(Reconciler):
+            FOR = ("v1", "Pod")
+
+            def reconcile(self, client, req):
+                raise RuntimeError("boom")
+
+        manager.add(Exploder()).start()
+        manager.client.create(new_object("v1", "Pod", "doomed", "default"))
+        wait_for(
+            lambda: METRICS.value("workqueue_retries_total", queue="Exploder") >= 3,
+            desc="rate-limited retries",
+        )
+        assert METRICS.value("workqueue_adds_total", queue="Exploder") >= 1
+        assert METRICS.histogram(
+            "workqueue_queue_duration_seconds", queue="Exploder").total >= 1
+        rendered = METRICS.render()  # collector fills depth/unfinished at scrape
+        assert 'workqueue_depth{queue="Exploder"}' in rendered
+        assert 'workqueue_unfinished_work_seconds{queue="Exploder"}' in rendered
+
+    def test_depth_and_duration_for_healthy_controller(self, manager):
+        seen = []
+
+        class Ok(Reconciler):
+            FOR = ("v1", "Pod")
+
+            def reconcile(self, client, req):
+                seen.append(req.name)
+                return Result()
+
+        manager.add(Ok()).start()
+        manager.client.create(new_object("v1", "Pod", "fine", "default"))
+        wait_for(lambda: "fine" in seen, desc="reconcile ran")
+        manager.wait_idle()
+        h = METRICS.histogram("workqueue_queue_duration_seconds", queue="Ok")
+        assert h.total >= 1
+        METRICS.render()
+        assert METRICS.value("workqueue_depth", queue="Ok") == 0
+
+
+# -- informer ------------------------------------------------------------------
+
+
+class TestInformerTelemetry:
+    def test_malformed_rv_counted_and_barrier_degrades(self, client):
+        inf = SharedInformer(client, "v1", "Pod")
+        inf._note_rv("not-a-number")
+        inf._note_rv(None)
+        assert METRICS.value("informer_malformed_rv_total", kind="Pod") == 2
+        assert inf._last_rv == 0
+
+    def test_handler_failure_counter(self, client):
+        inf = SharedInformer(client, "v1", "Pod")
+
+        def bad_handler(_type, _obj):
+            raise ValueError("handler bug")
+
+        inf.add_event_handler(bad_handler)
+        inf._dispatch("ADDED", new_object("v1", "Pod", "x", "default"))
+        assert METRICS.value("informer_handler_failures_total", kind="Pod") == 1
+
+    def test_events_and_sync_age_from_live_informer(self, client):
+        inf = SharedInformer(client, "v1", "Node").start()
+        try:
+            assert inf.wait_synced(5.0)
+            client.create(make_tpu_node("obs-node", "v5e", "2x4", 4))
+            wait_for(lambda: len(inf) == 1, desc="informer caught the node")
+            assert METRICS.value(
+                "informer_events_total", kind="Node", type="ADDED") >= 1
+            rendered = METRICS.render()
+            assert 'informer_last_sync_age_seconds{kind="Node"}' in rendered
+        finally:
+            inf.stop()
+
+
+# -- apiserver request telemetry ----------------------------------------------
+
+
+class TestApiserverTelemetry:
+    def test_request_histogram_and_inflight(self, store):
+        app = make_apiserver_app(store)
+        assert app.call("POST", "/api/v1/namespaces/default/pods",
+                        body=new_object("v1", "Pod", "t", "default")).status == 201
+        assert app.call("GET", "/api/v1/namespaces/default/pods").status == 200
+        assert app.call("GET", "/api/v1/namespaces/default/pods/t").status == 200
+        assert METRICS.histogram(
+            "apiserver_request_seconds", verb="create", resource="pods").total == 1
+        assert METRICS.histogram(
+            "apiserver_request_seconds", verb="list", resource="pods").total == 1
+        assert METRICS.histogram(
+            "apiserver_request_seconds", verb="get", resource="pods").total == 1
+        # in-flight gauges return to zero once the requests complete
+        for verb in ("create", "list", "get"):
+            assert METRICS.value("apiserver_inflight_requests", verb=verb) == 0
+
+    def test_request_spans_parent_to_dispatch(self, store):
+        from kubeflow_tpu.runtime.tracing import TRACER
+
+        app = make_apiserver_app(store)
+        app.call("GET", "/api/v1/namespaces/default/pods")
+        spans = TRACER.finished_spans(name="apiserver.list")
+        assert spans, "each request must open an apiserver.<verb> span"
+        assert spans[-1].parent_span_id, "span must parent to the dispatch span"
+
+    def test_unknown_debug_source_404s(self, store):
+        app = make_apiserver_app(store)
+        assert app.call("GET", "/debug/nonesuch").status == 404
+
+
+# -- scheduler flight recorder -------------------------------------------------
+
+
+@pytest.fixture()
+def sched():
+    return SchedulerReconciler(
+        assembly_timeout=5.0, reservation_ttl=5.0, backoff_base=0.02, backoff_cap=0.5
+    )
+
+
+@pytest.fixture()
+def cluster(sched):
+    mgr = Manager()
+    mgr.add(sched).add(PodletReconciler())
+    mgr.client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+    mgr.client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+    mgr.start()
+    try:
+        yield mgr
+    finally:
+        mgr.stop()
+
+
+class TestFlightRecorder:
+    def test_unschedulable_gang_trace_names_every_node(self, cluster, sched):
+        # 2 × 16 chips against two 4-chip nodes: permanently unschedulable
+        for i in range(2):
+            cluster.client.create(mkpod(f"huge-{i}", chips=16, gang="huge", size=2))
+        wait_for(
+            lambda: len(sched.flight.decisions(gang="default/huge", limit=512)) >= 2
+            and sched.flight.last_for("default/huge").outcome == "unschedulable",
+            desc="unschedulable decisions recorded",
+        )
+        cluster.stop()  # freeze: no cycle in progress while we assert
+
+        app = mount_observability(App("ops-test"))
+        resp = app.call("GET", "/debug/scheduler?gang=default/huge&limit=512")
+        assert resp.status == 200
+        decisions = [d for d in resp.body["decisions"] if d["outcome"] == "unschedulable"]
+        assert decisions, "flight recorder must serve the gang's decisions"
+        last = decisions[-1]
+        # every candidate node appears with a machine-readable reason
+        assert {v["node"] for v in last["nodes"]} == {"tpu-node-0", "tpu-node-1"}
+        assert all(v["reason"] == "insufficient_chips" for v in last["nodes"])
+        assert all(v["needed"] == 16 and v["capacity"] == 4 for v in last["nodes"])
+        assert last["attempt"] >= 1 and last["backoffSeconds"] > 0
+        assert "insufficient chips" in last["message"]
+
+        # ONE aggregated FailedScheduling Event per pod, count == attempts
+        n_attempts = len(decisions)
+        for i in range(2):
+            evs = events_for(cluster.client, f"huge-{i}", reason="FailedScheduling")
+            assert len(evs) == 1, "attempts must aggregate onto one Event"
+            assert evs[0]["count"] == n_attempts
+            assert evs[0]["type"] == "Warning"
+            assert evs[0]["source"]["component"] == "tpu-scheduler"
+
+        # decision counters mirror the trace taxonomy
+        assert METRICS.value(
+            "scheduler_decision_total",
+            outcome="unschedulable", reason="insufficient_chips") >= n_attempts
+
+    def test_bound_gang_records_placement_and_scheduled_events(self, cluster, sched):
+        for i in range(2):
+            cluster.client.create(mkpod(f"ok-{i}", chips=4, gang="ok", size=2))
+        wait_for(
+            lambda: (sched.flight.last_for("default/ok") or None) is not None
+            and sched.flight.last_for("default/ok").outcome == "bound",
+            desc="bound decision recorded",
+        )
+        last = sched.flight.last_for("default/ok")
+        assert sorted(last.placement) == ["tpu-node-0", "tpu-node-1"]
+        for i in range(2):
+            wait_for(
+                lambda i=i: len(events_for(cluster.client, f"ok-{i}", reason="Scheduled")) == 1,
+                desc="Scheduled event",
+            )
+            ev = events_for(cluster.client, f"ok-{i}", reason="Scheduled")[0]
+            assert "Successfully assigned" in ev["message"]
+        assert METRICS.value(
+            "scheduler_decision_total", outcome="bound", reason="scheduled") >= 1
+
+    def test_selector_mismatch_verdict(self, cluster, sched):
+        cluster.client.create(
+            mkpod("picky", chips=2, selector={"tpu/topology": "8x8"}))
+        wait_for(
+            lambda: (sched.flight.last_for("default/pod:picky") or None) is not None
+            and sched.flight.last_for("default/pod:picky").outcome == "unschedulable",
+            desc="selector-mismatch decision",
+        )
+        last = sched.flight.last_for("default/pod:picky")
+        assert all(v["reason"] == "selector_mismatch" for v in last.nodes)
+        assert "selector mismatch" in last.message
+
+    def test_quota_denied_decision_carries_admission_math(self, cluster, sched):
+        from kubeflow_tpu.scheduler.gang import QUOTA_NAME, TPU_QUOTA_KEY
+
+        cluster.client.create(new_object(
+            "v1", "ResourceQuota", QUOTA_NAME, "default",
+            spec={"hard": {TPU_QUOTA_KEY: "2"}}))
+        cluster.client.create(mkpod("greedy", chips=4))
+        wait_for(
+            lambda: (sched.flight.last_for("default/pod:greedy") or None) is not None
+            and sched.flight.last_for("default/pod:greedy").outcome == "quota_denied",
+            desc="quota_denied decision",
+        )
+        last = sched.flight.last_for("default/pod:greedy")
+        assert last.quota == {
+            "boundChips": 0, "requestedChips": 4, "hardLimit": 2, "admitted": False}
+        evs = events_for(cluster.client, "greedy", reason="FailedScheduling")
+        assert len(evs) == 1 and "quota exceeded" in evs[0]["message"]
+
+    def test_ring_is_bounded(self, sched):
+        from kubeflow_tpu.scheduler.flight import Decision, FlightRecorder
+
+        rec = FlightRecorder(capacity=8)
+        for i in range(50):
+            rec.record(Decision(
+                gang=f"g/{i}", outcome="unschedulable", reason="insufficient_chips",
+                message="m", attempt=1, backoff_seconds=0.1, wall_time=0.0))
+        assert len(rec.decisions(limit=1000)) == 8
