@@ -145,6 +145,21 @@ def control_plane_e2e() -> Dict:
     return b.build()
 
 
+def serving_fleet_e2e() -> Dict:
+    """The serving-fleet job: a 3-replica engine fleet over real HTTP —
+    prefix-affinity hits, a synthetic SLO breach scaling the fleet up and
+    idle windows scaling it back down, and a mid-burst drain that re-queues
+    every pending request to survivors with zero drops
+    (e2e/fleet_driver.py asserts all three), plus the router / autoscaler /
+    drain / gang-integration unit suite."""
+    b = WorkflowBuilder("serving-fleet-e2e")
+    b.run("fleet-drain-autoscale", ["python", "-m", "e2e.fleet_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("fleet-unit", "tests/test_fleet.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
@@ -152,6 +167,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "multichip-e2e": multichip_e2e,
     "observability-e2e": observability_e2e,
     "control-plane-e2e": control_plane_e2e,
+    "serving-fleet-e2e": serving_fleet_e2e,
 }
 
 

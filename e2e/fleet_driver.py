@@ -1,0 +1,198 @@
+"""Serving-fleet e2e: a 3-replica engine fleet driven over REAL HTTP
+(ISSUE 6 acceptance criteria, CI job serving-fleet-e2e).
+
+Boots a ModelServer hosting a tiny GPT ``GenerativeModel`` whose engine
+is an ``EngineFleet`` (3 replicas, 2 slots each) on a real listener,
+then:
+
+1. **Prefix affinity** — POSTs the SAME prompt repeatedly and asserts
+   ``fleet_prefix_hits_total`` > 0 on the ``/metrics`` scrape, that
+   ``/debug/fleet`` shows exactly one replica holding the warm prefix,
+   and that the engine gauges now carry ``replica`` labels.
+2. **SLO autoscaling** — injects a synthetic TTFT breach into the SLO
+   histogram, ticks a deterministic ``SLOAutoscaler``, and asserts the
+   fleet scales 3 → 4 (visible over HTTP in ``/debug/fleet``), then
+   scales back down once the windows go idle.
+3. **Drain/handoff** — fires a burst of same-prefix requests from
+   threads so pendings pile on one replica, drains that replica
+   mid-burst, and asserts every HTTP response came back 200 with the
+   identical greedy completion — zero dropped, zero failed — plus
+   ``fleet_requeued_total`` > 0 and a ``fleet_drain_seconds`` sample.
+
+Exit 0 on success, 1 with a JSON failure report otherwise. CPU-only,
+tiny config, ~tens of seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+REPLICAS = 3
+MAX_REPLICAS = 4
+SLOTS = 2
+BURST = 8
+BUDGET = 24
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        return resp.read()
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, json.dumps(body).encode(), {"content-type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def _poll(fn, timeout: float = 30.0, interval: float = 0.01,
+          desc: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+
+
+def _metric_value(text: str, name: str, **labels) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue
+        if all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def run() -> dict:
+    from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.serving.autoscaler import AutoscalerConfig, SLOAutoscaler
+    from kubeflow_tpu.serving.continuous import TTFT_BUCKETS
+    from kubeflow_tpu.serving.server import ModelServer, gpt_served_model
+
+    model = gpt_served_model(name="gpt", tiny=True, max_new_tokens=BUDGET,
+                             replicas=REPLICAS)
+    model.max_replicas = MAX_REPLICAS
+    model.slots = SLOTS
+    server = ModelServer()
+    server.add(model)
+    fleet = model._continuous_engine()
+    httpd = server.serve(0)
+    base = f"http://127.0.0.1:{httpd.port}"
+    report: dict = {"ok": True}
+    try:
+        prompt = list(range(1, 9))
+        url = f"{base}/v1/models/gpt:predict"
+
+        # -- (a) prefix affinity over HTTP -----------------------------------
+        reference = None
+        for _ in range(6):
+            out = _post(url, {"instances": [prompt]})["predictions"][0]
+            if reference is None:
+                reference = out
+            assert out == reference, "greedy decode must be deterministic"
+        text = _get(f"{base}/metrics").decode()
+        hits = _metric_value(text, "fleet_prefix_hits_total")
+        assert hits > 0, f"fleet_prefix_hits_total={hits}"
+        assert 'serving_queue_depth{replica="' in text, \
+            "engine gauges must carry the replica label"
+        fleet_doc = json.loads(_get(f"{base}/debug/fleet"))
+        assert fleet_doc["desired_replicas"] == REPLICAS, fleet_doc
+        warm = [r for r in fleet_doc["replicas"] if r["warm_prefixes"] > 0]
+        assert len(warm) == 1, \
+            f"one replica must own the warm prefix, got {len(warm)}"
+        report["prefix_hits"] = hits
+        report["warm_replica"] = warm[0]["id"]
+
+        # -- (b) SLO breach scales up; idle scales down ----------------------
+        autoscaler = SLOAutoscaler(fleet, AutoscalerConfig(
+            ttft_slo=0.5, queue_wait_slo=10.0, quantile=0.99,
+            breach_ticks=2, idle_ticks=2, cooldown_ticks=1))
+        autoscaler.tick()  # baseline snapshot
+        ttft = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        decisions = []
+        for _ in range(3):  # synthetic breach: p99 far past the 0.5s SLO
+            ttft.observe(3.0, count=20)
+            decisions.append(autoscaler.tick())
+        assert "up" in decisions, f"breach must scale up: {decisions}"
+        fleet_doc = json.loads(_get(f"{base}/debug/fleet"))
+        assert fleet_doc["desired_replicas"] == REPLICAS + 1, \
+            f"expected scale-up to {REPLICAS + 1}: {fleet_doc['desired_replicas']}"
+        for _ in range(4):  # no traffic: windows go idle
+            decisions.append(autoscaler.tick())
+        assert "down" in decisions, f"idle must scale down: {decisions}"
+        fleet_doc = json.loads(_get(f"{base}/debug/fleet"))
+        assert fleet_doc["desired_replicas"] <= REPLICAS, fleet_doc
+        text = _get(f"{base}/metrics").decode()
+        assert _metric_value(text, "fleet_autoscale_total",
+                             direction="up", reason="slo_breach") >= 1
+        assert _metric_value(text, "fleet_autoscale_total",
+                             direction="down", reason="idle") >= 1
+        report["autoscale_decisions"] = [d for d in decisions if d]
+
+        # -- (c) drain/handoff: zero dropped requests ------------------------
+        results: list = [None] * BURST
+        errors: list = [None] * BURST
+
+        def fire(i: int) -> None:
+            try:
+                results[i] = _post(url, {"instances": [prompt]})["predictions"][0]
+            except Exception as e:  # noqa: BLE001 — recorded, asserted below
+                errors[i] = str(e)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(BURST)]
+        for t in threads:
+            t.start()
+
+        def loaded_replica():
+            for h in fleet.live_handles():
+                if METRICS.value("serving_queue_depth",
+                                 replica=h.gauge_id) >= 2:
+                    return h
+            return None
+
+        victim = _poll(loaded_replica, timeout=30.0,
+                       desc="a replica with queued pendings")
+        requeued = fleet.drain_replica(victim.id, reason="e2e_drain")
+        for t in threads:
+            t.join(timeout=120)
+        assert all(e is None for e in errors), f"failed requests: {errors}"
+        assert all(r == reference for r in results), \
+            "every drained/re-queued request must return the exact greedy completion"
+        text = _get(f"{base}/metrics").decode()
+        assert requeued > 0, "the drain must have handed off pending requests"
+        assert _metric_value(text, "fleet_requeued_total") >= requeued
+        assert _metric_value(text, "fleet_drain_seconds_count") >= 1
+        report["drained_replica"] = victim.gauge_id
+        report["requeued"] = requeued
+        report["burst_ok"] = len(results)
+        return report
+    finally:
+        httpd.close()
+        server.close()
+        model.close()
+
+
+def main() -> int:
+    try:
+        report = run()
+    except AssertionError as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

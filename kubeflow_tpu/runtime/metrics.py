@@ -230,6 +230,29 @@ class MetricsRegistry:
             m = series.get(_label_key(labels))
             return getattr(m, "value", 0.0) if m else 0.0
 
+    def histogram_counts(
+        self, name: str
+    ) -> Optional[Tuple[Tuple[float, ...], List[int], int]]:
+        """Aggregated ``(buckets, counts, total)`` snapshot of histogram
+        ``name`` across every label series. Histograms are cumulative over
+        the process lifetime, so consumers that need an *interval* view
+        (the SLO autoscaler's windowed p99) snapshot this each tick and
+        quantile the per-tick count deltas via ``quantile_from_counts``.
+        Returns None when the name has no histogram series."""
+        with self._lock:
+            series = self._metrics.get(name, {})
+            hists = [m for m in series.values() if isinstance(m, _Histogram)]
+            if not hists:
+                return None
+            buckets = hists[0].buckets
+            counts = [0] * (len(buckets) + 1)
+            total = 0
+            for h in hists:
+                for i, c in enumerate(h.counts):
+                    counts[i] += c
+                total += h.total
+            return buckets, counts, total
+
     def quantile(self, name: str, q: float) -> float:
         """Estimate the q-quantile (0..1) of histogram ``name`` across every
         label series: find the bucket holding rank q*total and interpolate
@@ -238,31 +261,11 @@ class MetricsRegistry:
         that bound. Returns 0.0 with no observations."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile q={q} outside [0, 1]")
-        with self._lock:
-            series = self._metrics.get(name, {})
-            hists = [m for m in series.values() if isinstance(m, _Histogram)]
-            if not hists:
-                return 0.0
-            buckets = hists[0].buckets
-            counts = [0] * (len(buckets) + 1)
-            total = 0
-            for h in hists:
-                for i, c in enumerate(h.counts):
-                    counts[i] += c
-                total += h.total
-        if total == 0:
+        snap = self.histogram_counts(name)
+        if snap is None:
             return 0.0
-        rank = q * total
-        cum = 0
-        for i, bound in enumerate(buckets):
-            prev = cum
-            cum += counts[i]
-            if cum >= rank:
-                lo = buckets[i - 1] if i > 0 else 0.0
-                if counts[i] == 0:
-                    return bound
-                return lo + (bound - lo) * ((rank - prev) / counts[i])
-        return buckets[-1]  # rank fell in the +Inf bucket: clamp
+        buckets, counts, total = snap
+        return quantile_from_counts(buckets, counts, total, q)
 
     # -- collectors ----------------------------------------------------------
     def register_collector(self, key: str, fn: Callable[[], None]) -> None:
@@ -316,6 +319,27 @@ class MetricsRegistry:
             self._metrics.clear()
             self._types.clear()
             self._hist_buckets.clear()
+
+
+def quantile_from_counts(buckets: Sequence[float], counts: Sequence[int],
+                         total: int, q: float) -> float:
+    """The histogram_quantile() interpolation over an explicit bucket-count
+    vector (len(counts) == len(buckets)+1, last slot = +Inf). Shared by the
+    registry's cumulative ``quantile`` and windowed consumers quantiling
+    per-interval count deltas."""
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, bound in enumerate(buckets):
+        prev = cum
+        cum += counts[i]
+        if cum >= rank:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            if counts[i] == 0:
+                return bound
+            return lo + (bound - lo) * ((rank - prev) / counts[i])
+    return buckets[-1]  # rank fell in the +Inf bucket: clamp
 
 
 def _exemplar_suffix(ex: Optional[Tuple[float, str, float]]) -> str:

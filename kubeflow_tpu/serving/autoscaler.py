@@ -1,0 +1,185 @@
+"""SLO-driven fleet autoscaler (ISSUE 6).
+
+Closes the loop the observability plane opened: the engine exports
+``serving_ttft_seconds`` / ``serving_queue_wait_seconds`` histograms
+(PR 4); this module turns their tail quantiles into replica-count
+decisions with enough hysteresis that a boundary-riding quantile cannot
+flap the fleet.
+
+Windowed quantiles, not lifetime ones: the registry's histograms are
+cumulative, so one historic breach would otherwise hold the p99 above
+the SLO forever and the fleet could never scale back down. Each
+``tick()`` snapshots the aggregated bucket counts
+(``MetricsRegistry.histogram_counts``) and quantiles the DELTA since the
+previous tick — the same ``rate()``-window trick PromQL recording rules
+use, done in-process.
+
+Hysteresis (all tunable on :class:`AutoscalerConfig`):
+
+- scale UP only after ``breach_ticks`` consecutive windows whose p-``q``
+  exceeds the SLO,
+- scale DOWN only after ``idle_ticks`` consecutive windows that are
+  either traffic-free or comfortably below ``scale_down_margin * SLO``,
+- the band between ``margin*SLO`` and ``SLO`` holds (both streaks
+  reset) — a quantile sitting on the boundary moves nothing,
+- ``cooldown_ticks`` after any action before the next one (scaling has
+  real cost: a new replica compiles; a drain moves requests).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..runtime.metrics import METRICS, quantile_from_counts
+
+TTFT_METRIC = "serving_ttft_seconds"
+QUEUE_WAIT_METRIC = "serving_queue_wait_seconds"
+
+
+@dataclass
+class AutoscalerConfig:
+    ttft_slo: float = 1.0          # p-q TTFT ceiling (seconds)
+    queue_wait_slo: float = 0.5    # p-q queue-wait ceiling (seconds)
+    quantile: float = 0.99
+    scale_down_margin: float = 0.5  # idle iff p-q < margin * SLO (or no traffic)
+    breach_ticks: int = 2
+    idle_ticks: int = 3
+    cooldown_ticks: int = 2
+
+
+@dataclass
+class _Window:
+    """One tick's view of one SLO histogram."""
+    value: Optional[float]  # windowed quantile; None with no traffic/window
+    samples: int
+
+
+class SLOAutoscaler:
+    """Drives ``fleet.scale_to`` from windowed SLO quantiles.
+
+    Deterministic by construction: ``tick()`` does one evaluation (tests
+    and the e2e driver call it directly); ``start(interval)`` runs it on
+    a timer thread for real deployments.
+    """
+
+    def __init__(self, fleet, config: Optional[AutoscalerConfig] = None,
+                 registry=METRICS):
+        self.fleet = fleet
+        self.config = config or AutoscalerConfig()
+        self._registry = registry
+        self._prev: Dict[str, Tuple[List[int], int]] = {}
+        self._breach_streak = 0
+        self._idle_streak = 0
+        self._cooldown = 0
+        self._ticks = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        #: last tick's evaluation, surfaced in /debug/fleet
+        self.last: Dict = {}
+
+    # -- windowed quantile ---------------------------------------------------
+    def _window(self, name: str) -> _Window:
+        snap = self._registry.histogram_counts(name)
+        if snap is None:
+            return _Window(None, 0)
+        buckets, counts, total = snap
+        prev = self._prev.get(name)
+        self._prev[name] = (counts, total)
+        if prev is None:
+            return _Window(None, 0)  # first sight: no window yet
+        dcounts = [c - p for c, p in zip(counts, prev[0])]
+        dtotal = total - prev[1]
+        if dtotal <= 0:
+            return _Window(None, 0)
+        return _Window(
+            quantile_from_counts(buckets, dcounts, dtotal,
+                                 self.config.quantile),
+            dtotal)
+
+    # -- one evaluation ------------------------------------------------------
+    def tick(self) -> Optional[str]:
+        """Evaluate one window; returns ``"up"``/``"down"``/None."""
+        cfg = self.config
+        self._ticks += 1
+        ttft = self._window(TTFT_METRIC)
+        qwait = self._window(QUEUE_WAIT_METRIC)
+
+        def _breach(w: _Window, slo: float) -> bool:
+            return w.value is not None and w.value > slo
+
+        def _idle(w: _Window, slo: float) -> bool:
+            return w.value is None or w.value < cfg.scale_down_margin * slo
+
+        breach = _breach(ttft, cfg.ttft_slo) or _breach(qwait, cfg.queue_wait_slo)
+        idle = (not breach
+                and _idle(ttft, cfg.ttft_slo)
+                and _idle(qwait, cfg.queue_wait_slo))
+        if breach:
+            self._breach_streak += 1
+            self._idle_streak = 0
+        elif idle:
+            self._idle_streak += 1
+            self._breach_streak = 0
+        else:  # hysteresis band between margin*SLO and SLO: hold
+            self._breach_streak = 0
+            self._idle_streak = 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+
+        decision: Optional[str] = None
+        reason = ""
+        replicas = self.fleet.desired_replicas
+        if (self._breach_streak >= cfg.breach_ticks and self._cooldown == 0
+                and replicas < self.fleet.max_replicas):
+            reason = "slo_breach"
+            self.fleet.scale_to(replicas + 1, reason=reason)
+            decision = "up"
+        elif (self._idle_streak >= cfg.idle_ticks and self._cooldown == 0
+              and replicas > self.fleet.min_replicas):
+            reason = "idle"
+            self.fleet.scale_to(replicas - 1, reason=reason)
+            decision = "down"
+        if decision is not None:
+            self._breach_streak = 0
+            self._idle_streak = 0
+            self._cooldown = cfg.cooldown_ticks
+            METRICS.counter("fleet_autoscale_total",
+                            direction=decision, reason=reason).inc()
+
+        self.last = {
+            "tick": self._ticks,
+            "ttft_p": ttft.value, "ttft_samples": ttft.samples,
+            "queue_wait_p": qwait.value, "queue_wait_samples": qwait.samples,
+            "breach_streak": self._breach_streak,
+            "idle_streak": self._idle_streak,
+            "cooldown": self._cooldown,
+            "replicas": self.fleet.desired_replicas,
+            "decision": decision,
+        }
+        return decision
+
+    # -- background mode -----------------------------------------------------
+    def start(self, interval: float = 5.0) -> None:
+        if self._thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._stop.wait(interval):
+                try:
+                    self.tick()
+                except Exception:
+                    # an autoscaler bug must degrade to "fleet stays at its
+                    # current size", never take the serving path down
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="slo-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

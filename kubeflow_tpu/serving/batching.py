@@ -137,6 +137,25 @@ class DynamicBatcher:
                 p.error = BatcherClosed("batcher closed before serving request")
                 p.done.set()
 
+    def drain(self, timeout: float = 60.0) -> None:
+        """Graceful shutdown, distinct from ``close()``: stop admission
+        (predict raises BatcherClosed) but let the worker SERVE everything
+        already queued before it exits — close() instead fails leftovers.
+        Safe to call close() afterwards (idempotent no-op)."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        # the worker's loop exits only once the queue is empty
+        # (_take_batch returns [] when closed AND drained), so a plain
+        # join is the "finish in-flight" barrier
+        self._worker.join(timeout=timeout)
+        with self._lock:
+            leftover, self._queue = self._queue, []
+        for p in leftover:  # worker wedged past the timeout: fail, don't hang
+            if not p.done.is_set():
+                p.error = BatcherClosed("batcher drain timed out")
+                p.done.set()
+
     # -- worker side ---------------------------------------------------------
     def _take_batch(self) -> List[_Pending]:
         with self._lock:

@@ -74,6 +74,11 @@ DECODE_CHUNK_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 #: admission.
 MAX_GROUP = 8
 
+#: drain-queue sentinel (distinct from the ``None`` shutdown sentinel):
+#: the worker stops admitting, finishes in-flight slots, then parks the
+#: unserved pendings for handoff instead of failing them
+_DRAIN = object()
+
 
 def _bucket_for(n: int) -> int:
     for b in PREFILL_BUCKETS:
@@ -163,10 +168,15 @@ class ContinuousBatcher:
 
     def __init__(self, cfg: GptConfig, params: Any, slots: int = 8,
                  chunk: int = 16, pipeline: int = 3,
-                 kv_kernel: Optional[bool] = None):
+                 kv_kernel: Optional[bool] = None,
+                 engine_id: str = "0"):
         self.cfg = cfg
         self.params = params
         self.slots = slots
+        # engine id -> the ``replica`` label on this engine's gauges: N
+        # engines sharing one process registry (the fleet) must not clobber
+        # each other's queue_depth / slot_occupancy series
+        self.engine_id = str(engine_id)
         self.chunk = max(1, int(chunk))
         self.pipeline = max(1, int(pipeline))
         # fixed admission-group pad: one prefill program + one zero
@@ -197,6 +207,9 @@ class ContinuousBatcher:
         self._free = list(range(slots))
         self._lock = threading.Lock()
         self._closed = False
+        self._draining = False
+        #: requests drain() could not serve — handed off to the fleet router
+        self._handoff: List[_Request] = []
         self._step_fn = self._build_step()
         self._adopt_fn = self._build_adopt()
         self._prefill_fns: Dict[Tuple[int, int], Any] = {}
@@ -410,6 +423,22 @@ class ContinuousBatcher:
             self._queue.put(None)
         self._worker.join(timeout=30)
 
+    def drain(self, timeout: float = 600.0) -> List[_Request]:
+        """Graceful shutdown, distinct from ``close()``: stop admission,
+        let the in-flight slots run to completion, then return the
+        unserved requests (queued waves + pending) with their futures
+        still open so a fleet can re-submit them to a surviving replica.
+        ``close()`` after a drain is a no-op; submit() raises once the
+        drain begins. Idempotent — a second call returns the same
+        handoff list."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+            if not already:
+                self._queue.put(_DRAIN)
+        self._worker.join(timeout=timeout)
+        return list(self._handoff)
+
     # -- engine loop ---------------------------------------------------------
     def _admit_wave(self, reqs: List[_Request]) -> List[Tuple[str, Any, Any]]:
         """Admit up to ``len(self._free)`` requests together: one batched
@@ -499,8 +528,9 @@ class ContinuousBatcher:
 
     def _set_occupancy(self) -> None:
         active = len(self._active)
-        METRICS.gauge("serving_continuous_active_slots").set(active)
-        METRICS.gauge("serving_slot_occupancy").set(
+        METRICS.gauge("serving_continuous_active_slots",
+                      replica=self.engine_id).set(active)
+        METRICS.gauge("serving_slot_occupancy", replica=self.engine_id).set(
             active / self.slots if self.slots else 0.0)
 
     def _retire(self, slot: int) -> None:
@@ -533,7 +563,7 @@ class ContinuousBatcher:
                 rest = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if rest is not None:
+            if rest is not None and rest is not _DRAIN:
                 for req in rest:
                     _fail(req, RuntimeError(cause))
 
@@ -615,21 +645,29 @@ class ContinuousBatcher:
             # of single submits admit as ONE batched prefill.
             try:
                 timeout = (None if not (self._active or self._pending
-                                        or events) else 0.0)
+                                        or events or self._draining) else 0.0)
                 while True:
                     item = self._queue.get(timeout=timeout) if timeout is None \
                         else self._queue.get_nowait()
                     if item is None:
                         self._shutdown("batcher closed mid-flight")
                         return
-                    self._pending.extend(item)
+                    if item is _DRAIN:
+                        # submits racing the drain land BEFORE the sentinel
+                        # (submit checks _closed under the lock that also
+                        # enqueues it), so everything still queued here is
+                        # part of the handoff set
+                        self._draining = True
+                    else:
+                        self._pending.extend(item)
                     timeout = 0.0
             except queue.Empty:
                 pass
-            METRICS.gauge("serving_queue_depth").set(len(self._pending))
+            METRICS.gauge("serving_queue_depth",
+                          replica=self.engine_id).set(len(self._pending))
             try:
                 dispatched = False
-                if self._free and self._pending:
+                if self._free and self._pending and not self._draining:
                     wave = [self._pending.popleft()
                             for _ in range(min(len(self._free),
                                                len(self._pending)))]
@@ -657,6 +695,16 @@ class ContinuousBatcher:
                     self._process_event(events.popleft())
                 if not dispatched and events:
                     self._process_event(events.popleft())
+                if self._draining and not self._active and not events:
+                    # drain complete: every in-flight slot ran to its
+                    # budget/EOS; park the unserved pendings (futures still
+                    # open) for the caller and zero this replica's gauges
+                    self._handoff.extend(self._pending)
+                    self._pending.clear()
+                    METRICS.gauge("serving_queue_depth",
+                                  replica=self.engine_id).set(0)
+                    self._set_occupancy()
+                    return
             except Exception as e:
                 # a device/RPC failure must not wedge the engine silently:
                 # fail everything in flight, pending, and queued; refuse

@@ -74,9 +74,13 @@ class InferenceServiceReconciler(Reconciler):
         container: Dict[str, Any] = {
             "name": "server",
             "image": spec.get("image", self.config.default_image),
-            "args": [f"--model={model}", f"--port={SERVING_PORT}"],
+            # spec.replicas reaches the fleet INSIDE each server process:
+            # serving/server.py main() sizes its EngineFleet from it
+            "args": [f"--model={model}", f"--port={SERVING_PORT}",
+                     f"--replicas={replicas}"],
             "ports": [{"containerPort": SERVING_PORT, "name": "http-serving"}],
-            "env": [{"name": "MODEL_NAME", "value": model}],
+            "env": [{"name": "MODEL_NAME", "value": model},
+                    {"name": "FLEET_REPLICAS", "value": str(replicas)}],
             "readinessProbe": {"httpGet": {"path": "/healthz", "port": SERVING_PORT}},
         }
         pod_spec: Dict[str, Any] = {"containers": [container]}
@@ -151,11 +155,18 @@ class InferenceServiceReconciler(Reconciler):
         name, ns = apimeta.name_of(isvc), apimeta.namespace_of(isvc)
         dep = client.get_opt("apps/v1", "Deployment", name, ns)
         ready = (dep or {}).get("status", {}).get("readyReplicas", 0)
+        desired = int(isvc.get("spec", {}).get("replicas", 1))
         status = {
+            "replicas": desired,
             "readyReplicas": ready,
             "url": f"http://{name}.{ns}.svc.{self.config.cluster_domain}:{SERVING_PORT}/v1/models/"
             + (isvc.get("spec", {}).get("model") or name),
-            "conditions": [{"type": "Ready", "status": "True" if ready > 0 else "False"}],
+            "conditions": [{
+                "type": "Ready",
+                "status": "True" if ready > 0 else "False",
+                "reason": "ReplicasReady" if ready > 0 else "AwaitingReplicas",
+                "message": f"{ready}/{desired} replicas ready",
+            }],
         }
         if isvc.get("status") != status:
             fresh = apimeta.deepcopy(isvc)

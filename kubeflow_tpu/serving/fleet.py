@@ -1,0 +1,428 @@
+"""Serving fleet: N engine replicas behind one routing plane (ISSUE 6).
+
+The Podracer architectures paper (PAPERS.md) frames replicas as cheap,
+preemptible, re-schedulable gangs; here each replica is
+
+- one :class:`~kubeflow_tpu.serving.continuous.ContinuousBatcher`
+  engine (its gauges labeled ``replica=<id>``), and
+- optionally one Pod registered through the gang scheduler
+  (``scheduling.kubeflow.org/pod-group`` of size 1 requesting the
+  replica's chips), so the chip ledger, quota, and priority preemption
+  apply to serving capacity exactly as they do to training gangs.
+
+The fleet composes the other two ISSUE-6 modules:
+
+- :class:`~kubeflow_tpu.serving.router.PrefixRouter` picks a replica per
+  request (warm-prefix affinity, least-loaded fallback, 503 when
+  saturated),
+- :class:`~kubeflow_tpu.serving.autoscaler.SLOAutoscaler` calls
+  ``scale_to`` from windowed TTFT/queue-wait quantiles.
+
+Drain/handoff: ``drain_replica`` flips the replica out of the routing
+set, lets its engine finish in-flight slots (``ContinuousBatcher.drain``),
+then re-submits the unserved pendings to survivors — the ORIGINAL request
+futures stay valid (a bridge thread copies the survivor's result back),
+so callers blocked in ``result()`` never see the drain. With a client
+attached, a watcher thread notices the scheduler preempting/deleting a
+replica's pod and runs the same drain, then re-creates the pod so the
+replica re-enters the scheduling queue.
+
+``EngineFleet.submit`` mirrors ``ContinuousBatcher.submit`` so
+``GenerativeModel`` can use either interchangeably.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..runtime.metrics import METRICS
+from ..runtime.obs import register_debug_source
+from ..runtime.tracing import TRACER
+from .router import FleetSaturated, PrefixRouter
+
+#: drain wall time is dominated by the slowest in-flight request — seconds
+#: scale, with headroom for a replica finishing a long budget
+DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                 120.0)
+
+#: how long a handoff bridge waits on the survivor before failing the
+#: original future (matches the HTTP layer's result() ceiling)
+BRIDGE_TIMEOUT_S = 600.0
+
+
+@dataclass
+class ReplicaHandle:
+    """Fleet-side record of one engine replica."""
+
+    id: str
+    engine: Any
+    gauge_id: str  # the engine's ``replica`` gauge label
+    state: str = "pending"  # pending | ready | draining | stopped
+    #: LRU of prefix keys routed here (contents owned by PrefixRouter)
+    prefixes: "collections.OrderedDict" = field(
+        default_factory=collections.OrderedDict)
+    pod_name: Optional[str] = None
+    node: Optional[str] = None
+    started_at: float = field(default_factory=time.monotonic)
+
+
+class EngineFleet:
+    """ReplicaSet manager for continuous-batching engines.
+
+    ``engine_factory(engine_id) -> engine`` defaults to building a
+    :class:`ContinuousBatcher` from ``cfg``/``params``; tests inject
+    fakes. With ``client`` set, each replica also materializes a Pod
+    gang-labeled for the TPU scheduler (``replica_chips`` chips at
+    ``priority_class``), a replica only becomes routable ("ready") once
+    its pod binds, and a watcher thread turns pod deletion/preemption
+    into a drain + re-queue + pod re-create.
+    """
+
+    def __init__(self, cfg: Any = None, params: Any = None, *,
+                 replicas: int = 1, min_replicas: int = 1,
+                 max_replicas: int = 8, slots: int = 8, chunk: int = 16,
+                 pipeline: int = 3, name: str = "fleet",
+                 router: Optional[PrefixRouter] = None,
+                 engine_factory: Optional[Callable[[str], Any]] = None,
+                 client: Any = None, namespace: str = "default",
+                 replica_chips: int = 0, priority_class: str = "default",
+                 poll_interval: float = 0.2, register_debug: bool = True):
+        self.name = name
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.router = router or PrefixRouter()
+        self._client = client
+        self._namespace = namespace
+        self._replica_chips = int(replica_chips)
+        self._priority_class = priority_class
+        self._poll_interval = poll_interval
+        if engine_factory is None:
+            if cfg is None or params is None:
+                raise ValueError("EngineFleet needs cfg+params or an engine_factory")
+
+            def engine_factory(engine_id: str):
+                from .continuous import ContinuousBatcher
+
+                return ContinuousBatcher(cfg, params, slots=slots,
+                                         chunk=chunk, pipeline=pipeline,
+                                         engine_id=engine_id)
+
+        self._factory = engine_factory
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, ReplicaHandle] = {}
+        self._next_id = 0
+        self._closed = False
+        #: recent drains for /debug/fleet: (replica, reason, seconds, requeued)
+        self._drains: "collections.deque" = collections.deque(maxlen=32)
+        self._scale_log: "collections.deque" = collections.deque(maxlen=32)
+        self._target = self.min_replicas  # last scale_to target (watcher restores to it)
+        self.scale_to(max(self.min_replicas, min(int(replicas),
+                                                 self.max_replicas)),
+                      reason="initial")
+        self._watcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if client is not None:
+            self._watcher = threading.Thread(target=self._watch_pods,
+                                             name=f"{name}-pod-watcher",
+                                             daemon=True)
+            self._watcher.start()
+        if register_debug:
+            register_debug_source("fleet", lambda query: self.debug_snapshot())
+
+    # -- sizing --------------------------------------------------------------
+    @property
+    def desired_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._replicas.values()
+                       if h.state in ("pending", "ready"))
+
+    def live_handles(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return [h for h in self._replicas.values()
+                    if h.state in ("pending", "ready")]
+
+    def scale_to(self, n: int, reason: str = "") -> None:
+        """Grow or shrink the fleet to ``n`` live replicas (clamped to
+        [min_replicas, max_replicas]). Shrinking drains the newest ready
+        replicas — their pendings re-queue to survivors."""
+        n = max(self.min_replicas, min(int(n), self.max_replicas))
+        victims: List[str] = []
+        with self._lock:
+            if self._closed:
+                return
+            self._target = n
+            current = self.desired_replicas
+            while current < n:
+                self._add_replica()
+                current += 1
+            if current > n:
+                live = [h for h in self._replicas.values()
+                        if h.state in ("pending", "ready")]
+                live.sort(key=lambda h: h.started_at, reverse=True)
+                victims = [h.id for h in live[: current - n]]
+            self._scale_log.append({"at": time.time(), "to": n,
+                                    "reason": reason})
+        for rid in victims:
+            self.drain_replica(rid, reason=reason or "scale_down")
+        self._set_replica_gauge()
+
+    def _add_replica(self) -> ReplicaHandle:
+        """Caller holds the lock."""
+        rid = str(self._next_id)
+        self._next_id += 1
+        gauge_id = f"{self.name}-{rid}"
+        engine = self._factory(gauge_id)
+        handle = ReplicaHandle(id=rid, engine=engine, gauge_id=gauge_id)
+        if self._client is not None:
+            handle.pod_name = gauge_id
+            self._create_pod(handle)
+            handle.state = "pending"  # routable once the scheduler binds it
+        else:
+            handle.state = "ready"
+        self._replicas[rid] = handle
+        return handle
+
+    def _set_replica_gauge(self) -> None:
+        METRICS.gauge("fleet_replicas").set(self.desired_replicas)
+
+    # -- scheduler integration ----------------------------------------------
+    def _pod_body(self, handle: ReplicaHandle) -> Dict[str, Any]:
+        from ..api import meta as apimeta
+        from ..scheduler.gang import (POD_GROUP_LABEL,
+                                      POD_GROUP_SIZE_ANNOTATION)
+        from ..tpu.topology import RESOURCE_TPU
+
+        container: Dict[str, Any] = {"name": "engine",
+                                     "image": "kubeflow-tpu/model-server"}
+        if self._replica_chips > 0:
+            container["resources"] = {
+                "limits": {RESOURCE_TPU: str(self._replica_chips)}}
+        return apimeta.new_object(
+            "v1", "Pod", handle.pod_name, self._namespace,
+            labels={POD_GROUP_LABEL: handle.pod_name,
+                    "app": "serving-fleet", "fleet": self.name},
+            annotations={POD_GROUP_SIZE_ANNOTATION: "1"},
+            spec={"priorityClassName": self._priority_class,
+                  "containers": [container]})
+
+    def _create_pod(self, handle: ReplicaHandle) -> None:
+        self._client.create_or_get(self._pod_body(handle))
+
+    def _watch_pods(self) -> None:
+        """Poll replica pods: a bind promotes pending → ready; a deletion
+        (scheduler preemption, operator kubectl delete) drains the replica
+        and re-creates the pod so the gang re-enters the queue."""
+        while not self._stop.wait(self._poll_interval):
+            with self._lock:
+                handles = list(self._replicas.values())
+            for h in handles:
+                if h.pod_name is None or h.state in ("draining", "stopped"):
+                    continue
+                try:
+                    pod = self._client.get_opt("v1", "Pod", h.pod_name,
+                                               self._namespace)
+                except Exception:
+                    continue  # apiserver hiccup: keep last known state
+                phase = ((pod or {}).get("status") or {}).get("phase")
+                if pod is None or phase in ("Failed", "Succeeded"):
+                    # preempted (scheduler deletes victim pods) or killed
+                    self.drain_replica(h.id, reason="preempted")
+                    with self._lock:
+                        # restore the last scale_to target: the replacement
+                        # replica re-enters the scheduler queue and binds
+                        # whenever the ledger next has chips
+                        if (not self._closed
+                                and self.desired_replicas < self._target):
+                            self._add_replica()
+                    self._set_replica_gauge()
+                    continue
+                node = (pod.get("spec") or {}).get("nodeName")
+                if h.state == "pending" and node:
+                    with self._lock:
+                        h.state = "ready"
+                        h.node = node
+
+    # -- request path --------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_id: Optional[int] = None, temperature: float = 0.0,
+               traceparent: Optional[str] = None):
+        """Route and submit; same signature/return as
+        ``ContinuousBatcher.submit`` so GenerativeModel can't tell the
+        difference. Raises :class:`FleetSaturated` (a RuntimeError → the
+        HTTP layer's 503) when no replica can take the request."""
+        last_err: Optional[BaseException] = None
+        for _ in range(2):  # one retry if the routed engine died underneath us
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("fleet closed")
+                handle, _policy = self.router.route(self.live_handles(),
+                                                    prompt_ids)
+                try:
+                    return handle.engine.submit(
+                        prompt_ids, max_new_tokens, eos_id=eos_id,
+                        temperature=temperature, traceparent=traceparent)
+                except RuntimeError as e:
+                    # engine wedged/closed outside our control: retire the
+                    # handle and retry the route against the survivors
+                    handle.state = "stopped"
+                    last_err = e
+        raise FleetSaturated(f"no replica accepted the request: {last_err}")
+
+    # -- drain / handoff ------------------------------------------------------
+    def drain_replica(self, rid: str, reason: str = "scale_down") -> int:
+        """Drain one replica and re-queue its unserved requests to the
+        survivors; returns how many were re-queued. Blocking: when this
+        returns the engine has finished its in-flight slots."""
+        with self._lock:
+            handle = self._replicas.get(rid)
+            if handle is None or handle.state in ("draining", "stopped"):
+                return 0
+            handle.state = "draining"
+        t0 = time.perf_counter()
+        try:
+            unserved = handle.engine.drain()
+        except Exception:
+            unserved = []
+        drain_s = time.perf_counter() - t0
+        METRICS.histogram("fleet_drain_seconds",
+                          buckets=DRAIN_BUCKETS).observe(drain_s)
+        requeued = self._requeue(unserved, exclude=rid)
+        with self._lock:
+            handle.state = "stopped"
+            handle.prefixes.clear()  # its KV cache is gone with it
+            self._replicas.pop(rid, None)
+            pod_name = handle.pod_name
+        if pod_name is not None and self._client is not None:
+            try:
+                self._client.delete_opt("v1", "Pod", pod_name,
+                                        self._namespace)
+            except Exception:
+                pass  # preemption already deleted it
+        self._drains.append({"replica": handle.gauge_id, "reason": reason,
+                             "seconds": round(drain_s, 4),
+                             "requeued": requeued, "at": time.time()})
+        self._set_replica_gauge()
+        return requeued
+
+    def _requeue(self, unserved: List[Any], exclude: str) -> int:
+        """Re-submit drained requests to surviving replicas. The drained
+        engine handed back its ORIGINAL ``_Request`` objects (futures the
+        HTTP handlers still hold), so each re-submission gets a bridge
+        thread that copies the survivor's outcome back into the original."""
+        requeued = 0
+        for req in unserved:
+            try:
+                with self._lock:
+                    handle, _policy = self.router.route(
+                        self.live_handles(), req.prompt, exclude=exclude)
+                    shadow = handle.engine.submit(
+                        req.prompt, req.max_new_tokens, eos_id=req.eos_id,
+                        temperature=req.temperature)
+            except Exception as e:
+                self._fail_request(req, e)
+                continue
+            requeued += 1
+            METRICS.counter("fleet_requeued_total").inc()
+            threading.Thread(target=self._bridge, args=(req, shadow),
+                             name=f"{self.name}-handoff", daemon=True).start()
+        return requeued
+
+    @staticmethod
+    def _bridge(original: Any, shadow: Any) -> None:
+        done = shadow.done.wait(timeout=BRIDGE_TIMEOUT_S)
+        original.tokens = list(shadow.tokens)
+        error = shadow.error if done else TimeoutError(
+            "handoff request not finished")
+        span = getattr(original, "span", None)
+        if span is not None:
+            span.add_event("requeued")
+            TRACER.end_span(span, error=error)
+            original.span = None
+        original.error = error
+        original.done.set()
+
+    @staticmethod
+    def _fail_request(req: Any, error: BaseException) -> None:
+        span = getattr(req, "span", None)
+        if span is not None:
+            TRACER.end_span(span, error=error)
+            req.span = None
+        req.error = error
+        req.done.set()
+
+    # -- lifecycle -----------------------------------------------------------
+    def wait_ready(self, n: Optional[int] = None, timeout: float = 30.0) -> bool:
+        """Block until ``n`` (default: all live) replicas are routable."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                ready = sum(1 for h in self._replicas.values()
+                            if h.state == "ready")
+                want = n if n is not None else self.desired_replicas
+            if ready >= want:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            handles = list(self._replicas.values())
+            self._replicas.clear()
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=10)
+        for h in handles:
+            try:
+                h.engine.close()
+            except Exception:
+                pass
+            if h.pod_name is not None and self._client is not None:
+                try:
+                    self._client.delete_opt("v1", "Pod", h.pod_name,
+                                            self._namespace)
+                except Exception:
+                    pass
+        self._set_replica_gauge()
+
+    # -- debug surface -------------------------------------------------------
+    def debug_snapshot(self) -> Dict[str, Any]:
+        reg = self.router._registry
+        with self._lock:
+            replicas = [{
+                "id": h.gauge_id,
+                "state": h.state,
+                "queue_depth": reg.value("serving_queue_depth",
+                                         replica=h.gauge_id),
+                "active_slots": reg.value("serving_continuous_active_slots",
+                                          replica=h.gauge_id),
+                "slot_occupancy": reg.value("serving_slot_occupancy",
+                                            replica=h.gauge_id),
+                "warm_prefixes": len(h.prefixes),
+                "pod": h.pod_name,
+                "node": h.node,
+            } for h in self._replicas.values()]
+            scale_log = list(self._scale_log)
+            drains = list(self._drains)
+        return {
+            "fleet": self.name,
+            "desired_replicas": self.desired_replicas,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "replicas": replicas,
+            "router": {
+                "max_queue_depth": self.router.max_queue_depth,
+                "prefix_len": self.router.prefix_len,
+                "routed": {p: METRICS.value("fleet_routed_total", policy=p)
+                           for p in ("prefix", "prefix_spill",
+                                     "least_loaded")},
+                "prefix_hits": METRICS.value("fleet_prefix_hits_total"),
+                "saturated": METRICS.value("fleet_saturated_total"),
+            },
+            "scale_log": scale_log,
+            "drains": drains,
+        }

@@ -1,0 +1,127 @@
+"""Prefix-aware fleet router (ISSUE 6).
+
+Sits between the HTTP predict dispatch and the engine replicas. Two
+policies, in order:
+
+- ``prefix``: requests whose prompt prefix hashes to a prefix a replica
+  served recently go back to THAT replica — its per-slot KV cache rows
+  (and, for repeated prompts, the XLA-compiled prefill for the bucket)
+  are warm, so TTFT skips the cold path. "Evaluating Kubernetes
+  Performance for GenAI Inference" (PAPERS.md) measures exactly this
+  affinity/locality effect dominating LLM tail latency on K8s.
+- ``least_loaded``: otherwise (or when the prefix owner is saturated)
+  pick the ready replica with the lowest live load score, read straight
+  off the ``serving_queue_depth`` / ``serving_slot_occupancy`` gauges
+  each engine publishes under its ``replica`` label — the router trusts
+  the observability plane rather than keeping shadow accounting.
+
+When EVERY ready replica is saturated (queue depth at or past
+``max_queue_depth``) the router refuses with :class:`FleetSaturated`
+rather than piling onto a queue that already blows the SLO — the HTTP
+layer maps it to 503 and the autoscaler's breach streak takes it from
+there.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.metrics import METRICS
+
+#: tokens hashed into the affinity key — long enough to separate real
+#: system prompts, short enough that "same instruction, different tail"
+#: still lands on the warm replica
+DEFAULT_PREFIX_LEN = 16
+
+#: per-replica LRU of prefix keys assumed warm; bounded so a long-lived
+#: replica doesn't accrete an unbounded claim on every prefix ever seen
+PREFIX_CACHE_SIZE = 512
+
+
+class FleetSaturated(RuntimeError):
+    """Every ready replica's queue is at max_queue_depth — shed load."""
+
+
+def prefix_key(prompt_ids: Sequence[int], prefix_len: int = DEFAULT_PREFIX_LEN) -> int:
+    """Stable hash of the first ``prefix_len`` token ids (crc32 of the
+    int32 bytes — deterministic across processes, unlike ``hash()``)."""
+    head = np.asarray(prompt_ids, np.int32).reshape(-1)[:prefix_len]
+    return zlib.crc32(head.tobytes())
+
+
+class PrefixRouter:
+    """Pure routing policy over the fleet's replica handles.
+
+    The fleet calls ``route(handles, prompt_ids)`` under its own lock and
+    gets back ``(handle, policy)``. Handles must expose ``gauge_id`` (the
+    ``replica`` gauge label), ``state`` and ``prefixes`` (an OrderedDict
+    LRU this router owns the contents of).
+    """
+
+    def __init__(self, prefix_len: int = DEFAULT_PREFIX_LEN,
+                 max_queue_depth: int = 32,
+                 prefix_cache_size: int = PREFIX_CACHE_SIZE,
+                 registry=METRICS):
+        self.prefix_len = int(prefix_len)
+        self.max_queue_depth = int(max_queue_depth)
+        self.prefix_cache_size = int(prefix_cache_size)
+        self._registry = registry
+
+    # -- live load, straight from the gauges --------------------------------
+    def queue_depth(self, handle) -> float:
+        return self._registry.value("serving_queue_depth",
+                                    replica=handle.gauge_id)
+
+    def load_score(self, handle) -> float:
+        """Queued requests plus fractional slot occupancy: queue depth
+        dominates (each unit is a whole parked request), occupancy breaks
+        ties between empty-queue replicas."""
+        return self.queue_depth(handle) + self._registry.value(
+            "serving_slot_occupancy", replica=handle.gauge_id)
+
+    def route(self, handles: Sequence, prompt_ids: Sequence[int],
+              exclude: Optional[str] = None) -> Tuple[object, str]:
+        """Pick a replica for ``prompt_ids``; returns ``(handle, policy)``.
+
+        ``exclude`` drops one replica id from consideration (re-queueing a
+        drained replica's pendings must not route them back to it)."""
+        ready = [h for h in handles
+                 if h.state == "ready" and h.id != exclude]
+        if not ready:
+            raise FleetSaturated("no ready replicas in the fleet")
+        key = prefix_key(prompt_ids, self.prefix_len)
+        owner = next((h for h in ready if key in h.prefixes), None)
+        if owner is not None and self.queue_depth(owner) < self.max_queue_depth:
+            policy = "prefix"
+            chosen = owner
+            METRICS.counter("fleet_prefix_hits_total").inc()
+        else:
+            candidates = [h for h in ready
+                          if self.queue_depth(h) < self.max_queue_depth]
+            if not candidates:
+                METRICS.counter("fleet_saturated_total").inc()
+                raise FleetSaturated(
+                    f"all {len(ready)} ready replicas at max queue depth "
+                    f"{self.max_queue_depth}")
+            # owner existed but was saturated → distinct policy label so
+            # the miss is visible next to the hit counter
+            policy = "prefix_spill" if owner is not None else "least_loaded"
+            chosen = min(candidates, key=self.load_score)
+        self._note_prefix(chosen, key)
+        METRICS.counter("fleet_routed_total", policy=policy).inc()
+        return chosen, policy
+
+    def _note_prefix(self, handle, key: int) -> None:
+        """Record that ``handle`` now holds the warm state for ``key``
+        (LRU, bounded)."""
+        cache: "OrderedDict[int, None]" = handle.prefixes
+        if key in cache:
+            cache.move_to_end(key)
+        else:
+            cache[key] = None
+            while len(cache) > self.prefix_cache_size:
+                cache.popitem(last=False)

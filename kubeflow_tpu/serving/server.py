@@ -204,6 +204,11 @@ class GenerativeModel(ServedModel):
     temperature: float = 0.0
     continuous: bool = True
     slots: int = 8
+    #: >1 builds an EngineFleet (serving/fleet.py) instead of a single
+    #: engine: prefix-aware routing + drain/handoff across N replicas
+    replicas: int = 1
+    #: autoscaler headroom; None pins the fleet at ``replicas``
+    max_replicas: Optional[int] = None
 
     def __post_init__(self):
         # Per-request sampling state: a base key seeded from OS entropy folded
@@ -222,7 +227,16 @@ class GenerativeModel(ServedModel):
 
         with self._engine_lock:
             if self._engine is None:
-                self._engine = ContinuousBatcher(self.cfg, self.params, slots=self.slots)
+                if self.replicas > 1 or self.max_replicas:
+                    from .fleet import EngineFleet
+
+                    self._engine = EngineFleet(
+                        self.cfg, self.params, replicas=self.replicas,
+                        max_replicas=self.max_replicas or max(self.replicas, 1),
+                        slots=self.slots, name=self.name)
+                else:
+                    self._engine = ContinuousBatcher(self.cfg, self.params,
+                                                     slots=self.slots)
             return self._engine
 
     def close(self) -> None:
@@ -300,9 +314,11 @@ def gpt_served_model(
     tiny: bool = True,
     max_new_tokens: int = 16,
     temperature: float = 0.0,
+    replicas: int = 1,
 ) -> GenerativeModel:
     """GPT text-generation servable (``tiny`` for CPU CI; ``tiny=False``
-    builds the GPT-2-small-class config)."""
+    builds the GPT-2-small-class config). ``replicas`` > 1 serves through
+    an EngineFleet instead of a single engine."""
     from kubeflow_tpu.models.gpt import GptConfig, GptLM
 
     cfg = GptConfig.tiny() if tiny else GptConfig.small()
@@ -315,6 +331,7 @@ def gpt_served_model(
         cfg=cfg,
         max_new_tokens=max_new_tokens,
         temperature=temperature,
+        replicas=replicas,
     )
 
 
@@ -336,3 +353,41 @@ def bert_served_model(name: str = "bert", tiny: bool = True) -> ServedModel:
         return model.apply({"params": p}, ids)
 
     return ServedModel(name=name, apply_fn=apply_fn, params=params, input_dtype=jnp.int32)
+
+
+def main() -> None:
+    """``python -m kubeflow_tpu.serving.server`` — the model-server image
+    CMD. The InferenceService controller materializes ``spec.replicas``
+    as the ``FLEET_REPLICAS`` env / ``--replicas`` arg, which sizes the
+    in-process engine fleet here."""
+    import argparse
+
+    from ..runtime.bootstrap import block_forever
+
+    parser = argparse.ArgumentParser(description="JAX model server")
+    parser.add_argument("--model",
+                        default=os.environ.get("MODEL_NAME", "gpt"))
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("SERVING_PORT", "8500")))
+    parser.add_argument("--replicas", type=int,
+                        default=int(os.environ.get("FLEET_REPLICAS", "1")))
+    args = parser.parse_args()
+
+    server = ModelServer()
+    if args.model == "bert":
+        server.add(bert_served_model(name=args.model))
+    else:
+        server.add(gpt_served_model(name=args.model,
+                                    replicas=args.replicas))
+    httpd = server.serve(args.port)
+    print(f"model-server: {args.model!r} on :{httpd.port} "
+          f"(fleet replicas={args.replicas})", flush=True)
+    try:
+        block_forever()
+    finally:
+        httpd.close()
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

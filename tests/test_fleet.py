@@ -1,0 +1,538 @@
+"""Serving fleet (ISSUE 6): prefix-affinity routing, least-loaded
+fallback, saturation refusal, SLO autoscaler hysteresis (no flapping on a
+boundary quantile), engine/batcher graceful drain, drain re-queue with
+zero dropped requests, scheduler-gang integration (bind + preemption →
+drain + replacement), and the InferenceService controller's Ready
+status."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.controllers.builtin import PodletReconciler, make_tpu_node
+from kubeflow_tpu.models.gpt import GptConfig, GptLM, generate
+from kubeflow_tpu.runtime.manager import Manager, Request
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.scheduler import SchedulerReconciler
+from kubeflow_tpu.scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION
+from kubeflow_tpu.serving.autoscaler import AutoscalerConfig, SLOAutoscaler
+from kubeflow_tpu.serving.batching import BatcherClosed, DynamicBatcher
+from kubeflow_tpu.serving.continuous import TTFT_BUCKETS, ContinuousBatcher
+from kubeflow_tpu.serving.controller import (
+    SERVING_API,
+    InferenceServiceReconciler,
+    ServingConfig,
+)
+from kubeflow_tpu.serving.fleet import EngineFleet
+from kubeflow_tpu.serving.router import FleetSaturated, PrefixRouter, prefix_key
+from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+
+CFG = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64, max_seq=128,
+                vocab_size=101)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GptLM(CFG).init(jax.random.PRNGKey(0),
+                           np.zeros((1, 8), np.int32))["params"]
+
+
+def prompt(seed: int, n: int = 6) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, CFG.vocab_size, size=(n,)).astype(np.int32)
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), f"timed out waiting for {desc}"
+
+
+# -- fakes --------------------------------------------------------------------
+
+
+class FakeRequest:
+    def __init__(self, prompt_ids, max_new_tokens, eos_id, temperature):
+        self.prompt = np.asarray(prompt_ids, np.int32)
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.tokens = []
+        self.error = None
+        self.span = None
+        self.done = threading.Event()
+
+
+class FakeEngine:
+    """Duck-typed engine: instant results, records what it saw."""
+
+    def __init__(self, engine_id: str):
+        self.engine_id = engine_id
+        self.submitted = []
+        self.drained = False
+        self.closed = False
+
+    def submit(self, prompt_ids, max_new_tokens, eos_id=None,
+               temperature=0.0, traceparent=None):
+        req = FakeRequest(prompt_ids, max_new_tokens, eos_id, temperature)
+        req.tokens = [7] * max_new_tokens
+        req.done.set()
+        self.submitted.append(req)
+        return req
+
+    def drain(self):
+        self.drained = True
+        return []
+
+    def close(self):
+        self.closed = True
+
+
+def fake_fleet(n=3, name="flt", **kw) -> EngineFleet:
+    return EngineFleet(replicas=n, min_replicas=1, max_replicas=8, name=name,
+                       engine_factory=FakeEngine, register_debug=False, **kw)
+
+
+class FakeScalableFleet:
+    """Counts scale decisions for autoscaler tests."""
+
+    def __init__(self, n=2, lo=1, hi=4):
+        self.n = n
+        self.min_replicas = lo
+        self.max_replicas = hi
+        self.calls = []
+
+    @property
+    def desired_replicas(self):
+        return self.n
+
+    def scale_to(self, n, reason=""):
+        self.calls.append((n, reason))
+        self.n = n
+
+
+# -- router -------------------------------------------------------------------
+
+
+class TestRouter:
+    def test_same_prefix_routes_to_warm_replica(self):
+        fleet = fake_fleet(3)
+        try:
+            p = prompt(0)
+            first = fleet.submit(p, 4)
+            for _ in range(3):
+                fleet.submit(p, 4)
+            engines = [h.engine for h in fleet.live_handles()]
+            owners = [e for e in engines if e.submitted]
+            assert len(owners) == 1, "same prefix must stick to one replica"
+            assert len(owners[0].submitted) == 4
+            assert first.tokens == [7] * 4
+            assert METRICS.value("fleet_prefix_hits_total") == 3.0
+            assert METRICS.value("fleet_routed_total", policy="prefix") == 3.0
+        finally:
+            fleet.close()
+
+    def test_least_loaded_fallback_uses_live_gauges(self):
+        fleet = fake_fleet(3, name="ll")
+        try:
+            METRICS.gauge("serving_queue_depth", replica="ll-0").set(5)
+            METRICS.gauge("serving_queue_depth", replica="ll-1").set(0)
+            METRICS.gauge("serving_queue_depth", replica="ll-2").set(2)
+            # occupancy breaks the tie among empty-queue replicas
+            METRICS.gauge("serving_slot_occupancy", replica="ll-1").set(0.25)
+            fleet.submit(prompt(1), 4)
+            by_id = {h.gauge_id: h.engine for h in fleet.live_handles()}
+            assert len(by_id["ll-1"].submitted) == 1
+            assert METRICS.value("fleet_routed_total",
+                                 policy="least_loaded") == 1.0
+        finally:
+            fleet.close()
+
+    def test_saturated_owner_spills_to_least_loaded(self):
+        fleet = fake_fleet(2, name="sp",
+                           router=PrefixRouter(max_queue_depth=4))
+        try:
+            p = prompt(2)
+            fleet.submit(p, 4)  # replica becomes the prefix owner
+            owner = next(h for h in fleet.live_handles() if h.engine.submitted)
+            other = next(h for h in fleet.live_handles() if h is not owner)
+            METRICS.gauge("serving_queue_depth",
+                          replica=owner.gauge_id).set(4)
+            fleet.submit(p, 4)
+            assert len(other.engine.submitted) == 1, \
+                "saturated owner must spill instead of queueing deeper"
+            assert METRICS.value("fleet_routed_total",
+                                 policy="prefix_spill") == 1.0
+        finally:
+            fleet.close()
+
+    def test_every_replica_saturated_raises(self):
+        fleet = fake_fleet(2, name="sat",
+                           router=PrefixRouter(max_queue_depth=2))
+        try:
+            for h in fleet.live_handles():
+                METRICS.gauge("serving_queue_depth",
+                              replica=h.gauge_id).set(2)
+            with pytest.raises(FleetSaturated):
+                fleet.submit(prompt(3), 4)
+            assert METRICS.value("fleet_saturated_total") == 1.0
+            assert METRICS.total("fleet_routed_total") == 0.0
+        finally:
+            fleet.close()
+
+    def test_prefix_key_ignores_suffix(self):
+        head = list(range(16))
+        assert prefix_key(head + [1, 2, 3]) == prefix_key(head + [9, 9])
+        assert prefix_key([5] + head) != prefix_key(head)
+
+
+# -- autoscaler ---------------------------------------------------------------
+
+
+def _cfg(**kw) -> AutoscalerConfig:
+    base = dict(ttft_slo=0.5, queue_wait_slo=0.25, quantile=0.99,
+                scale_down_margin=0.5, breach_ticks=2, idle_ticks=3,
+                cooldown_ticks=2)
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+class TestAutoscaler:
+    def test_breach_streak_scales_up_once_then_cools_down(self):
+        fleet = FakeScalableFleet(n=2)
+        asc = SLOAutoscaler(fleet, _cfg(cooldown_ticks=3))
+        hist = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        assert asc.tick() is None  # baseline snapshot, no window yet
+        decisions = []
+        for _ in range(4):  # sustained breach, far past the SLO
+            hist.observe(3.0, count=10)
+            decisions.append(asc.tick())
+        # tick 2 satisfies breach_ticks; ticks 3-4 keep breaching but sit
+        # inside the cooldown window
+        assert decisions.count("up") == 1, \
+            f"cooldown must stop back-to-back scaling: {decisions}"
+        assert fleet.calls == [(3, "slo_breach")]
+        assert METRICS.value("fleet_autoscale_total", direction="up",
+                             reason="slo_breach") == 1.0
+
+    def test_boundary_quantile_never_flaps(self):
+        """p99 between margin*SLO and SLO sits in the hysteresis band:
+        neither streak accumulates, the fleet holds its size."""
+        fleet = FakeScalableFleet(n=2)
+        asc = SLOAutoscaler(fleet, _cfg())
+        hist = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        asc.tick()
+        for _ in range(8):
+            hist.observe(0.35, count=10)  # 0.25 < p99 < 0.5
+            assert asc.tick() is None
+        assert fleet.calls == []
+
+    def test_idle_windows_scale_down_to_min(self):
+        fleet = FakeScalableFleet(n=3, lo=1)
+        asc = SLOAutoscaler(fleet, _cfg(idle_ticks=2, cooldown_ticks=0))
+        asc.tick()
+        decisions = [asc.tick() for _ in range(6)]  # no traffic at all
+        assert decisions.count("down") >= 2
+        assert fleet.n == 1, "idle fleet must shrink to min_replicas"
+        assert fleet.n >= fleet.min_replicas
+
+    def test_windowed_quantile_forgets_old_breach(self):
+        """Cumulative histograms would pin p99 high forever after one
+        breach; the windowed delta must go idle once traffic stops."""
+        fleet = FakeScalableFleet(n=3)
+        asc = SLOAutoscaler(fleet, _cfg(idle_ticks=2, cooldown_ticks=0))
+        hist = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        asc.tick()
+        hist.observe(30.0, count=100)  # historic breach
+        assert asc.tick() is None  # breach tick (streak 1 of 2)
+        assert asc.tick() is None  # idle again: streak 1 of 2
+        assert asc.tick() == "down", \
+            "no NEW observations → the window is idle regardless of history"
+
+
+# -- graceful drain (engine + static batcher) ---------------------------------
+
+
+class TestEngineDrain:
+    def test_drain_finishes_active_and_returns_pendings(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="d0")
+        p = prompt(4)
+        futs = [eng.submit(p, 6) for _ in range(3)]
+        wait_for(lambda: any(f.tokens for f in futs), desc="first token")
+        unserved = eng.drain()
+        served = [f for f in futs if f.done.is_set() and f.error is None]
+        assert len(served) >= 1, "in-flight slots must run to completion"
+        ref = np.asarray(generate(CFG, params, p[None, :], 6))[0, len(p):]
+        for f in served:
+            assert f.tokens == ref.tolist()
+        assert len(unserved) == len(futs) - len(served)
+        for f in unserved:
+            assert not f.done.is_set(), "handoff futures must stay open"
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit(p, 2)
+        eng.close()  # idempotent after drain
+        # the drained replica's gauges are zeroed, not left stale
+        assert METRICS.value("serving_queue_depth", replica="d0") == 0.0
+        assert METRICS.value("serving_slot_occupancy", replica="d0") == 0.0
+
+    def test_dynamic_batcher_drain_serves_queue(self):
+        started = threading.Event()
+
+        def slow_predict(instances):
+            started.set()
+            time.sleep(0.05)
+            return [x * 2 for x in instances]
+
+        b = DynamicBatcher(slow_predict, max_batch=2, max_wait_ms=1.0)
+        results = {}
+
+        def call(i):
+            results[i] = b.predict([i])
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        started.wait(timeout=5)
+        b.drain()
+        for t in threads:
+            t.join(timeout=10)
+        assert results == {i: [i * 2] for i in range(6)}, \
+            "drain must SERVE the queue, not fail it"
+        with pytest.raises(BatcherClosed):
+            b.predict([1])
+
+    def test_dynamic_batcher_close_still_fails_leftovers(self):
+        release = threading.Event()
+
+        def wedged_predict(instances):
+            release.wait(timeout=10)
+            return list(instances)
+
+        b = DynamicBatcher(wedged_predict, max_batch=2, max_wait_ms=1.0)
+        errs = []
+
+        def call():
+            try:
+                b.predict([1])
+            except BatcherClosed as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        b.close()
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(errs) >= 1
+
+
+# -- fleet drain / handoff ----------------------------------------------------
+
+
+class TestFleetHandoff:
+    def test_drain_requeues_pendings_with_zero_drops(self, params):
+        fleet = EngineFleet(CFG, params, replicas=2, min_replicas=1,
+                            max_replicas=3, slots=1, chunk=2, pipeline=1,
+                            name="ho", register_debug=False)
+        try:
+            p = prompt(5)
+            futs = [fleet.submit(p, 8) for _ in range(5)]
+
+            def loaded():
+                return next(
+                    (h for h in fleet.live_handles()
+                     if METRICS.value("serving_queue_depth",
+                                      replica=h.gauge_id) >= 1), None)
+
+            victim = None
+            deadline = time.monotonic() + 10
+            while victim is None and time.monotonic() < deadline:
+                victim = loaded()
+            assert victim is not None
+            requeued = fleet.drain_replica(victim.id, reason="test")
+            assert requeued >= 1
+            assert METRICS.value("fleet_requeued_total") == requeued
+            ref = np.asarray(generate(CFG, params, p[None, :], 8))[0, len(p):]
+            for f in futs:  # ZERO dropped or failed
+                assert f.result(timeout=120) == ref.tolist()
+            assert fleet.desired_replicas == 1
+            snap = METRICS.histogram_counts("fleet_drain_seconds")
+            assert snap is not None and snap[2] >= 1
+        finally:
+            fleet.close()
+
+    def test_drain_with_no_survivors_fails_cleanly(self):
+        fleet = fake_fleet(1, name="solo")
+        try:
+            h = fleet.live_handles()[0]
+            # park an unserved request on the engine's handoff list
+            stuck = FakeRequest(prompt(6), 4, None, 0.0)
+            h.engine.drain = lambda: [stuck]
+            fleet.drain_replica(h.id, reason="test")
+            assert stuck.done.is_set()
+            assert isinstance(stuck.error, FleetSaturated), \
+                "no survivors → the future must error, never hang"
+        finally:
+            fleet.close()
+
+    def test_scale_down_drains_and_scale_up_adds(self):
+        fleet = fake_fleet(3, name="sc")
+        try:
+            engines = {h.id: h.engine for h in fleet.live_handles()}
+            fleet.scale_to(1, reason="test")
+            assert fleet.desired_replicas == 1
+            assert sum(1 for e in engines.values() if e.drained) == 2
+            assert METRICS.value("fleet_replicas") == 1.0
+            fleet.scale_to(2, reason="test")
+            assert fleet.desired_replicas == 2
+            assert METRICS.value("fleet_replicas") == 2.0
+        finally:
+            fleet.close()
+
+    def test_debug_snapshot_names_every_replica(self):
+        fleet = fake_fleet(2, name="dbg")
+        try:
+            fleet.submit(prompt(7), 4)
+            snap = fleet.debug_snapshot()
+            assert snap["desired_replicas"] == 2
+            assert {r["id"] for r in snap["replicas"]} == {"dbg-0", "dbg-1"}
+            assert sum(r["warm_prefixes"] for r in snap["replicas"]) == 1
+            assert snap["router"]["max_queue_depth"] == 32
+        finally:
+            fleet.close()
+
+
+# -- scheduler integration ----------------------------------------------------
+
+
+class TestFleetScheduler:
+    @pytest.fixture()
+    def cluster(self):
+        mgr = Manager()
+        mgr.add(SchedulerReconciler(assembly_timeout=5.0, reservation_ttl=5.0,
+                                    backoff_base=0.02, backoff_cap=0.5))
+        mgr.add(PodletReconciler())
+        mgr.client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+        mgr.start()
+        try:
+            yield mgr
+        finally:
+            mgr.stop()
+
+    def test_replica_pod_binds_through_gang_scheduler(self, cluster):
+        fleet = EngineFleet(replicas=1, min_replicas=1, max_replicas=2,
+                            name="srv", engine_factory=FakeEngine,
+                            client=cluster.client, replica_chips=4,
+                            priority_class="trial", poll_interval=0.05,
+                            register_debug=False)
+        try:
+            pod = cluster.client.get("v1", "Pod", "srv-0", "default")
+            assert pod["metadata"]["labels"][POD_GROUP_LABEL] == "srv-0"
+            assert pod["metadata"]["annotations"][POD_GROUP_SIZE_ANNOTATION] == "1"
+            limits = pod["spec"]["containers"][0]["resources"]["limits"]
+            assert limits[RESOURCE_TPU] == "4"
+            assert fleet.wait_ready(1, timeout=10), \
+                "replica must become routable once the scheduler binds its pod"
+            handle = fleet.live_handles()[0]
+            assert handle.node == "tpu-node-0"
+            fleet.submit(prompt(8), 4)  # ready replica serves
+        finally:
+            fleet.close()
+
+    def test_preemption_drains_replica_and_requeues_pod(self, cluster):
+        fleet = EngineFleet(replicas=1, min_replicas=1, max_replicas=2,
+                            name="srv", engine_factory=FakeEngine,
+                            client=cluster.client, replica_chips=4,
+                            priority_class="trial", poll_interval=0.05,
+                            register_debug=False)
+        try:
+            assert fleet.wait_ready(1, timeout=10)
+            old_engine = fleet.live_handles()[0].engine
+            # a higher-priority gang needs the node's only 4 chips
+            cluster.client.create(new_object(
+                "v1", "Pod", "urgent-0", "default",
+                labels={POD_GROUP_LABEL: "urgent"},
+                annotations={POD_GROUP_SIZE_ANNOTATION: "1"},
+                spec={"priorityClassName": "system",
+                      "containers": [{"name": "c", "resources": {
+                          "limits": {RESOURCE_TPU: "4"}}}]}))
+            wait_for(lambda: old_engine.drained, timeout=15.0,
+                     desc="preempted replica drained")
+            wait_for(
+                lambda: (cluster.client.get("v1", "Pod", "urgent-0",
+                                            "default").get("spec") or {}
+                         ).get("nodeName"),
+                timeout=15.0, desc="preemptor bound")
+            # the fleet replaced the replica; its pod waits for chips
+            def replacement_up():
+                handles = fleet.live_handles()
+                if len(handles) != 1 or handles[0].engine is old_engine:
+                    return False
+                return cluster.client.get_opt(
+                    "v1", "Pod", handles[0].pod_name, "default") is not None
+
+            wait_for(replacement_up, timeout=10.0,
+                     desc="replacement replica with a re-queued pod")
+        finally:
+            fleet.close()
+
+
+# -- controller status --------------------------------------------------------
+
+
+class TestInferenceServiceStatus:
+    def test_ready_condition_and_fleet_replicas_wiring(self, client):
+        client.create(new_object(
+            SERVING_API, "InferenceService", "gen", "team-a",
+            spec={"model": "gpt", "replicas": 3}))
+        rec = InferenceServiceReconciler(ServingConfig(use_istio=False))
+        rec.reconcile(client, Request("team-a", "gen"))
+
+        dep = client.get("apps/v1", "Deployment", "gen", "team-a")
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["FLEET_REPLICAS"] == "3"
+        assert "--replicas=3" in container["args"]
+
+        isvc = client.get(SERVING_API, "InferenceService", "gen", "team-a")
+        cond = isvc["status"]["conditions"][0]
+        assert cond["type"] == "Ready" and cond["status"] == "False"
+        assert cond["reason"] == "AwaitingReplicas"
+        assert isvc["status"]["replicas"] == 3
+        assert isvc["status"]["readyReplicas"] == 0
+
+        dep["status"] = {"readyReplicas": 3}
+        client.update_status(dep)
+        rec.reconcile(client, Request("team-a", "gen"))
+        isvc = client.get(SERVING_API, "InferenceService", "gen", "team-a")
+        cond = isvc["status"]["conditions"][0]
+        assert cond["status"] == "True" and cond["reason"] == "ReplicasReady"
+        assert cond["message"] == "3/3 replicas ready"
+        assert isvc["status"]["readyReplicas"] == 3
+
+
+# -- registry support ---------------------------------------------------------
+
+
+class TestHistogramCounts:
+    def test_aggregates_label_series(self):
+        METRICS.histogram("h_test", buckets=(1.0, 2.0), a="x").observe(0.5)
+        METRICS.histogram("h_test", a="y").observe(1.5)
+        METRICS.histogram("h_test", a="y").observe(9.0)
+        buckets, counts, total = METRICS.histogram_counts("h_test")
+        assert buckets == (1.0, 2.0)
+        assert counts == [1, 1, 1]
+        assert total == 3
+
+    def test_missing_name_returns_none(self):
+        assert METRICS.histogram_counts("nope") is None
