@@ -160,6 +160,22 @@ def serving_fleet_e2e() -> Dict:
     return b.build()
 
 
+def elastic_e2e() -> Dict:
+    """The elastic-training job: the chaos dryrun — an ElasticTrainer on
+    the 8-virtual-device topology surviving an organic scheduler drain plus
+    two chaos preemptions with one reshard down to a smaller slice and back,
+    the loss curve matching an uninterrupted run, and a kill-9-mid-save
+    restart resuming from the previous complete checkpoint
+    (e2e/elastic_driver.py asserts all of it, under a seeded benign-chaos
+    schedule) — plus the drain-protocol / checkpointer / trainer / chaos
+    unit suite."""
+    b = WorkflowBuilder("elastic-e2e")
+    b.run("elastic-chaos-dryrun", ["python", "-m", "e2e.elastic_driver"],
+          env=EIGHT_DEVICE_ENV)
+    b.pytest("elastic-unit", "tests/test_elastic.py", env=EIGHT_DEVICE_ENV)
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
@@ -168,6 +184,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "observability-e2e": observability_e2e,
     "control-plane-e2e": control_plane_e2e,
     "serving-fleet-e2e": serving_fleet_e2e,
+    "elastic-e2e": elastic_e2e,
 }
 
 

@@ -47,11 +47,12 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import AXIS_FSDP, AXIS_MODEL, AXIS_PIPE, BATCH_AXES
-from .pipeline import interleave_stage_params, pipeline_apply
+from .pipeline import deinterleave_stage_params, interleave_stage_params, pipeline_apply
 
 GATHER_MODES = ("eager", "overlap", "amortized")
 
@@ -127,6 +128,63 @@ def init_params(
     embed = jax.device_put(
         jax.random.normal(ks[4], (cfg.vocab_size, d), jnp.float32) * scale,
         NamedSharding(mesh, P(AXIS_MODEL, None)),
+    )
+    return {"embed": embed, "stages": stages}
+
+
+def canonical_params(
+    params: Dict[str, Any], mesh: Mesh, *, virtual_stages: int = 1
+) -> Dict[str, Any]:
+    """Sharded stage tree -> canonical per-layer host arrays.
+
+    Inverse of :func:`init_params`'s chunk+interleave: un-permutes the V>1
+    round-robin layout and flattens [chunks, lpc, ...] back to
+    [n_layers, ...]. The result is factorization-independent — the elastic
+    checkpoint format (docs/ELASTICITY.md): a (pp=4, V=1) job saves here
+    and a (pp=2, V=2) restart rebuilds its own chunking from it via
+    :func:`params_from_canonical`.
+    """
+    pp = mesh.shape[AXIS_PIPE]
+    stages = {
+        k: np.asarray(jax.device_get(v)) for k, v in params["stages"].items()
+    }
+    if virtual_stages > 1:
+        stages = jax.tree_util.tree_map(
+            np.asarray, deinterleave_stage_params(stages, pp, virtual_stages)
+        )
+    stages = {k: v.reshape((-1,) + v.shape[2:]) for k, v in stages.items()}
+    return {"embed": np.asarray(jax.device_get(params["embed"])), "stages": stages}
+
+
+def params_from_canonical(
+    canon: Dict[str, Any], cfg: CompositeConfig, mesh: Mesh, *, virtual_stages: int = 1
+) -> Dict[str, Any]:
+    """Canonical per-layer arrays -> the sharded stage tree for THIS mesh.
+
+    Mirrors :func:`init_params`'s chunk/interleave/device_put exactly, so
+    ``params_from_canonical(canonical_params(p, m1, V=a), cfg, m2, V=b)``
+    is the same logical model on a different (pp, V) factorization.
+    """
+    pp = mesh.shape[AXIS_PIPE]
+    chunks = pp * virtual_stages
+    if cfg.n_layers % chunks:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by "
+            f"pipe={pp} * virtual_stages={virtual_stages}"
+        )
+    lpc = cfg.n_layers // chunks
+    stages = {}
+    for k, v in canon["stages"].items():
+        arr = jnp.asarray(v)
+        stages[k] = arr.reshape((chunks, lpc) + arr.shape[1:])
+    if virtual_stages > 1:
+        stages = interleave_stage_params(stages, pp, virtual_stages)
+    specs = _param_specs(cfg)
+    stages = {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k])) for k, v in stages.items()
+    }
+    embed = jax.device_put(
+        jnp.asarray(canon["embed"]), NamedSharding(mesh, P(AXIS_MODEL, None))
     )
     return {"embed": embed, "stages": stages}
 

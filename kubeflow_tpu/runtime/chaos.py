@@ -1,0 +1,267 @@
+"""Fault injection for the control plane: seeded, deterministic chaos.
+
+The elastic-training claim (docs/ELASTICITY.md) is only worth making if a
+harness can break the cluster on purpose and watch training survive. This
+module is that harness — four injectors matching the real failure modes of
+a TPU pool, driven by a seeded schedule so CI runs are reproducible:
+
+- ``kill_node``           — a host vanishes: the Node object is deleted and
+  every pod bound to it flips to Failed with NO drain warning (the
+  spot-VM-reclaim / hardware-death case);
+- ``preempt_gang``        — protocol-faithful preemption: stamp the drain
+  deadline annotation + ``TrainingPreempted`` Event on the gang's pods,
+  then delete them once all live pods ack or the deadline passes (what
+  scheduler/core.py does, minus needing a real higher-priority gang);
+- ``drop_informer_watch`` — close an informer's watch stream mid-flight,
+  forcing the relist/reconnect path (bumps
+  ``informer_watch_reconnects_total``);
+- ``delay_apiserver``     — hold the store's global lock for N seconds so
+  every API call in the process stalls (etcd brown-out).
+
+Every firing bumps ``chaos_faults_injected_total{kind}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..api import meta as apimeta
+from .metrics import METRICS
+
+LOG = logging.getLogger(__name__)
+
+KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver")
+
+#: chaos components stamp Events under this source
+COMPONENT = "chaos-monkey"
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled failure: fire ``kind`` against ``target`` at ``at``
+    seconds after the monkey starts. ``param`` is kind-specific: drain
+    grace seconds for preempt_gang, stall seconds for delay_apiserver."""
+
+    at: float
+    kind: str
+    target: Optional[str] = None  # node name | "ns/gang" | informer kind
+    param: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; expected one of {KINDS}")
+
+
+class ChaosSchedule:
+    """An ordered fault list. Build explicitly, or derive deterministically
+    from a seed with :meth:`seeded` — the same (seed, spec) always yields
+    the same schedule, which is what lets the elastic-e2e CI job inject
+    chaos and still be a reproducible test."""
+
+    def __init__(self, faults: Sequence[Fault]) -> None:
+        self.faults: List[Fault] = sorted(faults, key=lambda f: f.at)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n: int,
+        duration: float,
+        targets: Dict[str, Sequence[str]],
+        param: Dict[str, float] = None,
+    ) -> "ChaosSchedule":
+        """``n`` faults uniformly over ``duration`` seconds, kinds drawn
+        from ``targets``' keys, target drawn per kind."""
+        rng = random.Random(seed)
+        kinds = sorted(targets)
+        faults = []
+        for _ in range(n):
+            kind = rng.choice(kinds)
+            choices = list(targets[kind])
+            faults.append(
+                Fault(
+                    at=rng.uniform(0.0, duration),
+                    kind=kind,
+                    target=rng.choice(choices) if choices else None,
+                    param=(param or {}).get(kind, 0.0),
+                )
+            )
+        return cls(faults)
+
+
+class ChaosMonkey:
+    """Fires a :class:`ChaosSchedule` against a live control plane.
+
+    ``store`` is only needed for ``delay_apiserver``; ``informers`` (any
+    iterable of SharedInformers) only for ``drop_informer_watch``. Faults
+    whose dependencies are absent are logged and skipped, not errors — a
+    schedule is reusable across harnesses of different completeness.
+    """
+
+    def __init__(
+        self,
+        client,
+        schedule: ChaosSchedule,
+        *,
+        store=None,
+        informers: Sequence[Any] = (),
+    ) -> None:
+        self._client = client
+        self._schedule = schedule
+        self._store = store
+        self._informers = list(informers)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.fired: List[Fault] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ChaosMonkey":
+        t = threading.Thread(target=self._run, name="chaos-monkey", daemon=True)
+        self._threads.append(t)
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for t in list(self._threads):
+            t.join(timeout=timeout)
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for fault in self._schedule.faults:
+            delay = fault.at - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            self.inject(fault)
+
+    # -- injectors -----------------------------------------------------------
+    def inject(self, fault: Fault) -> None:
+        LOG.warning("chaos: injecting %s target=%s param=%s",
+                    fault.kind, fault.target, fault.param)
+        try:
+            getattr(self, f"_{fault.kind}")(fault)
+        except Exception as e:  # a failed injection must not kill the monkey
+            LOG.warning("chaos: %s failed: %s", fault.kind, e)
+            return
+        METRICS.counter("chaos_faults_injected_total", kind=fault.kind).inc()
+        self.fired.append(fault)
+
+    def _kill_node(self, fault: Fault) -> None:
+        """Hardware death: pods on the node fail with no warning, then the
+        Node object disappears from the ledger's world."""
+        node = fault.target
+        for pod in self._client.list("v1", "Pod"):
+            if (pod.get("spec") or {}).get("nodeName") != node:
+                continue
+            pod = dict(pod)
+            pod["status"] = dict(pod.get("status") or {})
+            pod["status"]["phase"] = "Failed"
+            try:
+                self._client.update_status(pod)
+            except Exception:
+                continue
+        try:
+            self._client.delete("v1", "Node", node)
+        except Exception:
+            pass
+
+    def _preempt_gang(self, fault: Fault) -> None:
+        """The drain protocol, chaos-issued: deadline annotation +
+        TrainingPreempted Event now; deletion on ack or deadline (in a
+        side thread so later faults stay on schedule)."""
+        from ..scheduler.gang import (
+            DRAIN_ACK_ANNOTATION,
+            DRAIN_DEADLINE_ANNOTATION,
+            POD_GROUP_LABEL,
+        )
+
+        ns, _, gang = (fault.target or "").partition("/")
+        ns = ns or None
+        grace = max(0.0, fault.param)
+        deadline = time.time() + grace
+        pods = self._client.list(
+            "v1", "Pod", ns, label_selector={POD_GROUP_LABEL: gang}
+        )
+        names = [apimeta.name_of(p) for p in pods]
+        for p in pods:
+            self._client.patch(
+                "v1", "Pod", apimeta.name_of(p),
+                {"metadata": {"annotations": {
+                    DRAIN_DEADLINE_ANNOTATION: f"{deadline:.3f}"}}},
+                ns,
+            )
+            self._client.emit_event(
+                p, "TrainingPreempted",
+                f"chaos preemption: checkpoint within {grace:.1f}s "
+                f"(deadline {deadline:.3f}) or be evicted",
+                type_="Warning", component=COMPONENT,
+            )
+
+        def evict_when_ready():
+            while not self._stop.is_set() and time.time() < deadline:
+                live = acked = 0
+                for name in names:
+                    pod = self._client.get_opt("v1", "Pod", name, ns)
+                    if pod is None:
+                        continue
+                    live += 1
+                    if apimeta.annotations_of(pod).get(DRAIN_ACK_ANNOTATION):
+                        acked += 1
+                if live == 0 or acked == live:
+                    break
+                self._stop.wait(0.02)
+            for name in names:
+                try:
+                    self._client.delete("v1", "Pod", name, ns)
+                except Exception:
+                    continue
+
+        t = threading.Thread(target=evict_when_ready, name="chaos-evict", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _drop_informer_watch(self, fault: Fault) -> None:
+        """Sever the watch stream; the informer must relist + reconnect."""
+        dropped = 0
+        for inf in self._informers:
+            if fault.target and getattr(inf, "kind", None) != fault.target:
+                continue
+            watcher = getattr(inf, "_watcher", None)
+            if watcher is None:
+                continue
+            try:
+                watcher.close()
+                dropped += 1
+            except Exception:
+                continue
+        if not dropped:
+            raise RuntimeError(f"no informer watch to drop for {fault.target!r}")
+
+    def _delay_apiserver(self, fault: Fault) -> None:
+        """etcd brown-out: hold the store's global lock so every API call
+        (and every informer watch delivery) stalls for ``param`` seconds."""
+        if self._store is None:
+            raise RuntimeError("delay_apiserver needs a store")
+        seconds = max(0.0, fault.param)
+
+        def hold():
+            with self._store._lock:
+                # interruptible sleep — stop() must not wait out the stall
+                end = time.monotonic() + seconds
+                while time.monotonic() < end and not self._stop.is_set():
+                    time.sleep(min(0.02, end - time.monotonic()))
+
+        t = threading.Thread(target=hold, name="chaos-apiserver-delay", daemon=True)
+        self._threads.append(t)
+        t.start()

@@ -44,10 +44,13 @@ from .flight import (
     failed_scheduling_message,
 )
 from .gang import (
+    DRAIN_ACK_ANNOTATION,
+    DRAIN_DEADLINE_ANNOTATION,
     POD_GROUP_LABEL,
     QUOTA_NAME,
     TPU_QUOTA_KEY,
     Gang,
+    drain_grace_of,
     gang_of,
     is_terminal,
     requires_scheduling,
@@ -118,6 +121,9 @@ class SchedulerReconciler(Reconciler):
         self._first_attempt: Dict[GangKey, float] = {}
         #: pod key → gang key, for cleanup when a pod vanishes
         self._gang_of_pod: Dict[Tuple[Optional[str], str], GangKey] = {}
+        #: victim gang → in-flight drain (docs/ELASTICITY.md): who asked,
+        #: the grace deadline, and the pods/chips the eviction will free
+        self._draining: Dict[GangKey, Dict[str, Any]] = {}
 
     def watches(self):
         def wake_pending(_node: Dict[str, Any]) -> List[Request]:
@@ -241,6 +247,20 @@ class SchedulerReconciler(Reconciler):
                     self.backoff.base, preemption=preemption,
                 )
                 return "preempted", self.backoff.base
+            if preemption.get("draining"):
+                # Victim is checkpointing under its drain grace; our
+                # reservation (refreshed each cycle) holds the claim until
+                # it acks or the deadline passes, then we evict and bind.
+                d = preemption["draining"]
+                self._note_pending(key, unbound[0])
+                delay = max(0.05, min(d["graceDeadline"] - time.time(), 1.0))
+                self._record(
+                    client, gang, unbound, "awaiting_drain", "draining",
+                    f"victim gang {d['gang']} draining "
+                    f"(grace deadline {d['graceDeadline']:.3f})",
+                    delay, preemption=preemption,
+                )
+                return "awaiting_drain", delay
             self.ledger.release(key)
             # Re-judge each node AFTER releasing our own hold so the
             # verdicts describe the world the next attempt will see.
@@ -341,9 +361,18 @@ class SchedulerReconciler(Reconciler):
         self, client: Client, gang: Gang, requirements, span
     ) -> Dict[str, Any]:
         """Evict the lowest-priority running gang whose chips make this
-        gang's placement feasible. Reserve first, then evict. Returns the
-        flight-recorder preemption record: every candidate considered and
-        the victim chosen (``victim`` is None when nothing helps)."""
+        gang's placement feasible. Reserve first, then evict — and when the
+        victim opted into drain grace (gang.DRAIN_GRACE_ANNOTATION), evict
+        in two phases: signal a drain deadline, give the workload until ack
+        or deadline to checkpoint, THEN delete (docs/ELASTICITY.md).
+
+        Returns the flight-recorder preemption record: every candidate
+        considered, the victim chosen with its identity + grace deadline
+        (``victim`` is None when nothing helps), or ``draining`` while a
+        victim's grace window is still open."""
+        in_flight = self._check_draining(client, gang, requirements, span)
+        if in_flight is not None:
+            return in_flight
         candidates = sorted(
             (
                 (info["priority"], sum(info["by_node"].values()), vkey, info)
@@ -357,6 +386,13 @@ class SchedulerReconciler(Reconciler):
             considered.append(
                 {"gang": f"{vkey[0]}/{vkey[1]}", "priority": prio, "chips": chips}
             )
+            with self._lock:
+                claimed = vkey in self._draining
+            if claimed:
+                # Already draining for some other preemptor; its chips are
+                # spoken for, so evicting it twice would double-count them.
+                considered[-1]["verdict"] = "already_draining"
+                continue
             placement = self.ledger.place_and_reserve(
                 gang.key, requirements, self.reservation_ttl, assume_freed=info["by_node"]
             )
@@ -364,21 +400,201 @@ class SchedulerReconciler(Reconciler):
                 considered[-1]["verdict"] = "would_not_help"
                 continue
             considered[-1]["verdict"] = "chosen"
-            for vns, vname in info["pods"]:
-                victim = client.get_opt("v1", "Pod", vname, vns)
-                if victim is not None:
-                    client.emit_event(
-                        victim,
-                        "Preempted",
-                        f"evicted by higher-priority gang {gang.namespace}/{gang.name}",
-                        type_="Warning",
-                        component=COMPONENT,
-                    )
-                client.delete_opt("v1", "Pod", vname, vns)
-            SCHED.counter("preemptions_total").inc()
-            span.set("preempted", f"{vkey[0]}/{vkey[1]}")
-            return {"considered": considered, "victim": f"{vkey[0]}/{vkey[1]}"}
+            victim_id = f"{vkey[0]}/{vkey[1]}"
+            grace = self._victim_grace(client, info["pods"])
+            if grace <= 0:
+                self._evict_pods(client, gang, info["pods"])
+                SCHED.counter("preemptions_total").inc()
+                span.set("preempted", victim_id)
+                return {
+                    "considered": considered,
+                    "victim": victim_id,
+                    "graceDeadline": None,
+                }
+            deadline = time.time() + grace
+            self._request_drain(client, gang, victim_id, info["pods"], grace, deadline)
+            with self._lock:
+                self._draining[vkey] = {
+                    "for": gang.key,
+                    "victim": victim_id,
+                    "deadline": deadline,
+                    "pods": list(info["pods"]),
+                    "by_node": dict(info["by_node"]),
+                }
+            span.set("draining", victim_id)
+            return {
+                "considered": considered,
+                "victim": None,
+                "draining": {
+                    "gang": victim_id,
+                    "preemptor": f"{gang.namespace}/{gang.name}",
+                    "graceDeadline": deadline,
+                },
+            }
         return {"considered": considered, "victim": None}
+
+    def _check_draining(
+        self, client: Client, gang: Gang, requirements, span
+    ) -> Optional[Dict[str, Any]]:
+        """Phase 2 of the drain protocol: if this gang already signalled a
+        victim, either finish the eviction (all live pods acked, pods gone,
+        or deadline passed) or keep waiting with the reservation alive."""
+        with self._lock:
+            item = next(
+                ((vk, d) for vk, d in self._draining.items() if d["for"] == gang.key),
+                None,
+            )
+        if item is None:
+            return None
+        vkey, drain = item
+        if drain.get("evicted"):
+            # Eviction already issued, but the informer-fed ledger may not
+            # have echoed the deletes yet — the victim's chips still look
+            # used. Hold the claim (refreshing the reservation) until they
+            # actually free, so this gang neither re-preempts the ghost nor
+            # loses the capacity to a third gang in the lag window.
+            info = self.ledger.running_gangs().get(vkey)
+            if info is None or sum(info["by_node"].values()) == 0:
+                with self._lock:
+                    self._draining.pop(vkey, None)
+                return None
+            self.ledger.place_and_reserve(
+                gang.key, requirements, self.reservation_ttl,
+                assume_freed=drain["by_node"],
+            )
+            return {
+                "considered": [],
+                "victim": None,
+                "draining": {
+                    "gang": drain["victim"],
+                    "preemptor": f"{gang.namespace}/{gang.name}",
+                    "graceDeadline": drain["deadline"],
+                    "freeing": True,
+                },
+            }
+        # Refresh our claim on the victim's chips each cycle so the TTL
+        # cannot lapse while the victim checkpoints.
+        self.ledger.place_and_reserve(
+            gang.key, requirements, self.reservation_ttl, assume_freed=drain["by_node"]
+        )
+        acked, live = self._drain_progress(client, drain["pods"])
+        if live == 0 or acked == live or time.time() >= drain["deadline"]:
+            self._evict_pods(client, gang, drain["pods"])
+            with self._lock:
+                # Keep the entry in an "evicted" state (see above) until the
+                # ledger stops counting the victim's chips.
+                drain["evicted"] = True
+            SCHED.counter("preemptions_total").inc()
+            SCHED.counter(
+                "drains_completed_total",
+                outcome="acked" if live and acked == live else
+                ("gone" if live == 0 else "deadline"),
+            ).inc()
+            span.set("preempted", drain["victim"])
+            return {
+                "considered": [],
+                "victim": drain["victim"],
+                "graceDeadline": drain["deadline"],
+                "drainAckedPods": acked,
+            }
+        return {
+            "considered": [],
+            "victim": None,
+            "draining": {
+                "gang": drain["victim"],
+                "preemptor": f"{gang.namespace}/{gang.name}",
+                "graceDeadline": drain["deadline"],
+                "ackedPods": acked,
+                "livePods": live,
+            },
+        }
+
+    def _victim_grace(self, client: Client, pods) -> float:
+        grace = 0.0
+        for vns, vname in pods:
+            victim = client.get_opt("v1", "Pod", vname, vns)
+            if victim is not None:
+                grace = max(grace, drain_grace_of(victim))
+        return grace
+
+    def _request_drain(
+        self, client: Client, gang: Gang, victim_id: str, pods, grace: float,
+        deadline: float,
+    ) -> None:
+        """Phase 1: stamp the deadline on every live victim pod, tell the
+        workload (TrainingPreempted Event), and flight-record the drain
+        under the VICTIM's gang so its operator sees who preempted it."""
+        for vns, vname in pods:
+            victim = client.get_opt("v1", "Pod", vname, vns)
+            if victim is None:
+                continue
+            try:
+                client.patch(
+                    "v1", "Pod", vname,
+                    {"metadata": {"annotations": {
+                        DRAIN_DEADLINE_ANNOTATION: f"{deadline:.3f}"}}},
+                    vns,
+                )
+            except (Conflict, NotFound):
+                continue
+            client.emit_event(
+                victim,
+                "TrainingPreempted",
+                f"drain requested by higher-priority gang "
+                f"{gang.namespace}/{gang.name}: checkpoint within {grace:.1f}s "
+                f"(deadline {deadline:.3f}) or be evicted",
+                type_="Warning",
+                component=COMPONENT,
+            )
+        SCHED.counter("drains_requested_total").inc()
+        self.flight.record(
+            Decision(
+                gang=victim_id,
+                outcome="drain_requested",
+                reason="preemption",
+                message=(
+                    f"draining for higher-priority gang "
+                    f"{gang.namespace}/{gang.name}; grace {grace:.1f}s"
+                ),
+                attempt=0,
+                backoff_seconds=0.0,
+                wall_time=time.time(),
+                nodes=[],
+                quota=None,
+                preemption={
+                    "victim": victim_id,
+                    "preemptor": f"{gang.namespace}/{gang.name}",
+                    "graceDeadline": deadline,
+                },
+                placement=None,
+            )
+        )
+
+    def _drain_progress(self, client: Client, pods) -> Tuple[int, int]:
+        """(acked, live) across the victim's pods; terminal/vanished pods
+        count as neither (their chips free on their own)."""
+        acked = live = 0
+        for vns, vname in pods:
+            victim = client.get_opt("v1", "Pod", vname, vns)
+            if victim is None or is_terminal(victim):
+                continue
+            live += 1
+            if apimeta.annotations_of(victim).get(DRAIN_ACK_ANNOTATION):
+                acked += 1
+        return acked, live
+
+    def _evict_pods(self, client: Client, gang: Gang, pods) -> None:
+        for vns, vname in pods:
+            victim = client.get_opt("v1", "Pod", vname, vns)
+            if victim is not None:
+                client.emit_event(
+                    victim,
+                    "Preempted",
+                    f"evicted by higher-priority gang {gang.namespace}/{gang.name}",
+                    type_="Warning",
+                    component=COMPONENT,
+                )
+            client.delete_opt("v1", "Pod", vname, vns)
 
     # -- helpers -------------------------------------------------------------
 
@@ -477,6 +693,7 @@ class SchedulerReconciler(Reconciler):
     def _gang_done(self, key: GangKey, bound: bool) -> None:
         self.backoff.forget(key)
         self.ledger.release(key)
+        self._cancel_drains_for(key)
         with self._lock:
             self._pending.pop(key, None)
             first = self._first_attempt.pop(key, None)
@@ -490,10 +707,20 @@ class SchedulerReconciler(Reconciler):
         if orphaned:
             self.backoff.forget(gkey)
             self.ledger.release(gkey)
+            self._cancel_drains_for(gkey)
             with self._lock:
                 self._pending.pop(gkey, None)
                 self._first_attempt.pop(gkey, None)
                 SCHED.gauge("pending_gangs").set(len(self._pending))
+
+    def _cancel_drains_for(self, key: GangKey) -> None:
+        """Preemptor bound or vanished: forget drains it requested so the
+        victim is no longer claimed (the stale deadline annotation is
+        harmless — without a deletion the workload just keeps training)."""
+        with self._lock:
+            stale = [vk for vk, d in self._draining.items() if d["for"] == key]
+            for vk in stale:
+                self._draining.pop(vk, None)
 
 
 def main() -> None:  # python -m kubeflow_tpu.scheduler.core

@@ -27,6 +27,16 @@ POD_GROUP_LABEL = "scheduling.kubeflow.org/pod-group"
 #: Annotation carrying the expected member count of the gang.
 POD_GROUP_SIZE_ANNOTATION = "scheduling.kubeflow.org/pod-group-size"
 
+#: Drain protocol annotations (docs/ELASTICITY.md). A workload opts into
+#: graceful preemption by stamping DRAIN_GRACE on its pods; the scheduler
+#: then signals eviction by writing DRAIN_DEADLINE (unix seconds) instead
+#: of deleting immediately, and the workload acks with DRAIN_ACK (the step
+#: it checkpointed) once its state is safe. Pods without DRAIN_GRACE keep
+#: the original immediate-evict behavior.
+DRAIN_GRACE_ANNOTATION = "scheduling.kubeflow.org/drain-grace-seconds"
+DRAIN_DEADLINE_ANNOTATION = "scheduling.kubeflow.org/drain-deadline"
+DRAIN_ACK_ANNOTATION = "scheduling.kubeflow.org/drain-acked"
+
 #: Name of the per-namespace ResourceQuota ProfileReconciler materializes.
 QUOTA_NAME = "kf-resource-quota"
 #: The hard-limit key for TPU chips inside that quota.
@@ -81,6 +91,17 @@ def gang_of(pod: Dict[str, Any]) -> Gang:
     except ValueError:
         size = 1
     return Gang(ns, group, max(size, 1), priority_of(pod), True)
+
+
+def drain_grace_of(pod: Dict[str, Any]) -> float:
+    """Seconds of drain grace this pod opted into (0 = evict immediately)."""
+    raw = apimeta.annotations_of(pod).get(DRAIN_GRACE_ANNOTATION)
+    if raw is None:
+        return 0.0
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def is_terminal(pod: Dict[str, Any]) -> bool:

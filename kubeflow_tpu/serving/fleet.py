@@ -34,6 +34,7 @@ replica re-enters the scheduling queue.
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -52,6 +53,11 @@ DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 #: how long a handoff bridge waits on the survivor before failing the
 #: original future (matches the HTTP layer's result() ceiling)
 BRIDGE_TIMEOUT_S = 600.0
+
+#: ceiling for the pod watcher's crash-restart backoff
+WATCHER_BACKOFF_CAP_S = 5.0
+
+LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -213,6 +219,23 @@ class EngineFleet:
         self._client.create_or_get(self._pod_body(handle))
 
     def _watch_pods(self) -> None:
+        """Thread target: the poll loop, wrapped so an unexpected exception
+        restarts it (log + exponential backoff + counter) instead of
+        silently killing the only thing noticing preempted replicas."""
+        backoff = max(self._poll_interval, 0.01)
+        while not self._stop.is_set():
+            try:
+                self._watch_pods_loop()
+                return  # _stop set: clean shutdown
+            except Exception:
+                LOG.exception("fleet %s: pod watcher crashed; restarting in %.2fs",
+                              self.name, backoff)
+                METRICS.counter("fleet_watcher_restarts_total").inc()
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2, WATCHER_BACKOFF_CAP_S)
+
+    def _watch_pods_loop(self) -> None:
         """Poll replica pods: a bind promotes pending → ready; a deletion
         (scheduler preemption, operator kubectl delete) drains the replica
         and re-creates the pod so the gang re-enters the queue."""

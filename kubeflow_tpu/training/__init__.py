@@ -7,6 +7,14 @@ donated state, shardings from kubeflow_tpu.parallel.
 """
 
 from kubeflow_tpu.training.checkpoint import Checkpointer  # noqa: F401
+from kubeflow_tpu.training.elastic import (  # noqa: F401
+    CompositeWorkload,
+    DrainStatus,
+    ElasticReport,
+    ElasticTrainer,
+    PreemptionHandler,
+    SliceOffer,
+)
 from kubeflow_tpu.training.classifier import (  # noqa: F401
     ClassifierTask,
     TrainState,

@@ -1,0 +1,362 @@
+"""Elastic training: survive preemption, resume on whatever slice is next.
+
+The scheduler's drain protocol (docs/ELASTICITY.md) turns eviction into a
+two-phase signal: a drain-deadline annotation lands on the victim's pods,
+and deletion waits for an ack or the deadline. This module is the workload
+side of that contract — the Podracer discipline (PAPERS.md) of cheap,
+preemptible, restartable workers:
+
+- :class:`PreemptionHandler` — polls the gang's pods between steps for the
+  drain signal (or their disappearance), and acks once state is safe;
+- :class:`ElasticTrainer`   — the supervising loop: train → on drain,
+  urgent-checkpoint + ack → on eviction/crash, re-request a gang, accept
+  WHATEVER slice the ledger offers next, restore from the latest complete
+  checkpoint, keep going;
+- :class:`CompositeWorkload` — the composed-4D GPT as an elastic workload:
+  snapshots are the canonical per-layer weights
+  (``composite.canonical_params``), so a (pp=4, V=1) checkpoint restores
+  onto a (pp=2, V=2) mesh by re-chunking, not by luck.
+
+Metrics: ``training_preemptions_survived_total``,
+``training_restart_seconds`` (plus ``checkpoint_save_seconds`` from
+training/checkpoint.py) — the elastic e2e driver asserts on all three.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..api import meta as apimeta
+from ..runtime.metrics import METRICS
+from ..scheduler.gang import (
+    DRAIN_ACK_ANNOTATION,
+    DRAIN_DEADLINE_ANNOTATION,
+    is_terminal,
+)
+from .checkpoint import Checkpointer
+
+LOG = logging.getLogger(__name__)
+TRAIN = METRICS.namespace("training")
+
+
+@dataclass(frozen=True)
+class DrainStatus:
+    """What the gang's pods say about this incarnation's future."""
+
+    state: str  # "ok" | "draining" | "lost"
+    deadline: Optional[float] = None  # unix seconds, when draining
+
+
+@dataclass(frozen=True)
+class SliceOffer:
+    """One gang's worth of capacity the ledger granted us — whatever shape
+    it happens to be. ``devices`` are the local jax devices backing it (in
+    the dryrun harness: a subset of the virtual CPU devices sized like the
+    slice); (pp, virtual_stages) is the factorization the workload should
+    rebuild for."""
+
+    devices: Sequence[Any]
+    pp: int = 1
+    virtual_stages: int = 1
+    pods: Sequence[str] = ()
+    namespace: Optional[str] = None
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "chips": len(self.devices),
+            "pp": self.pp,
+            "virtualStages": self.virtual_stages,
+            "pods": list(self.pods),
+        }
+
+
+class PreemptionHandler:
+    """Between-step watcher for the drain protocol on a gang's pods.
+
+    ``check()`` is called once per training step, so the apiserver sweep is
+    rate-limited to ``poll_interval``; a drain verdict is sticky (once
+    draining, always draining — the scheduler never un-asks).
+    ``request_local_drain`` injects the same signal in-process, used by the
+    chaos harness to exercise the handler without a scheduler.
+    """
+
+    def __init__(
+        self,
+        client,
+        namespace: Optional[str],
+        pod_names: Sequence[str],
+        poll_interval: float = 0.05,
+    ) -> None:
+        self._client = client
+        self._namespace = namespace
+        self._pods = list(pod_names)
+        self._poll_interval = poll_interval
+        self._last_poll = 0.0
+        self._cached = DrainStatus("ok")
+
+    def request_local_drain(self, grace: float = 5.0) -> None:
+        self._cached = DrainStatus("draining", time.time() + grace)
+
+    def check(self) -> DrainStatus:
+        if self._cached.state == "draining":
+            return self._cached
+        now = time.monotonic()
+        if now - self._last_poll < self._poll_interval:
+            return self._cached
+        self._last_poll = now
+        self._cached = self._sweep()
+        return self._cached
+
+    def _sweep(self) -> DrainStatus:
+        live = 0
+        deadline: Optional[float] = None
+        for name in self._pods:
+            pod = self._client.get_opt("v1", "Pod", name, self._namespace)
+            if pod is None or is_terminal(pod):
+                continue
+            live += 1
+            raw = apimeta.annotations_of(pod).get(DRAIN_DEADLINE_ANNOTATION)
+            if raw is not None:
+                try:
+                    d = float(raw)
+                except (TypeError, ValueError):
+                    d = time.time()
+                deadline = d if deadline is None else min(deadline, d)
+        if deadline is not None:
+            return DrainStatus("draining", deadline)
+        if live == 0 and self._pods:
+            # gang gone without a drain signal — killed node, hard crash
+            return DrainStatus("lost")
+        return DrainStatus("ok")
+
+    def ack(self, step: int) -> None:
+        """Tell the scheduler our state is safe: it may evict immediately
+        instead of waiting out the grace deadline."""
+        for name in self._pods:
+            try:
+                self._client.patch(
+                    "v1", "Pod", name,
+                    {"metadata": {"annotations": {DRAIN_ACK_ANNOTATION: str(step)}}},
+                    self._namespace,
+                )
+            except Exception:  # pod already deleted: the ack is moot
+                continue
+
+
+@dataclass
+class ElasticReport:
+    """What one ``ElasticTrainer.run()`` lived through."""
+
+    completed: bool
+    losses: Dict[int, float] = field(default_factory=dict)
+    preemptions_survived: int = 0
+    restarts: int = 0
+    incarnations: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class ElasticTrainer:
+    """Supervising loop: (re)acquire a slice, restore-or-init, train until
+    drained/lost/done, checkpoint, repeat.
+
+    The workload is pluggable (duck-typed):
+
+    - ``init(offer) -> state``
+    - ``restore(offer, snapshot, meta) -> state``   (re-chunk for the offer)
+    - ``snapshot(state) -> (tree, meta)``           (factorization-free)
+    - ``run_step(state, step) -> (state, loss)``    (data chosen BY step, so
+      replayed steps reproduce the same curve)
+
+    ``slice_provider(attempt)`` blocks until the ledger grants a gang and
+    returns a :class:`SliceOffer` (or None to give up);
+    ``handler_factory(offer)`` builds the :class:`PreemptionHandler`-shaped
+    watcher for that gang (None disables drain detection).
+    """
+
+    def __init__(
+        self,
+        workload,
+        checkpointer: Checkpointer,
+        slice_provider: Callable[[int], Optional[SliceOffer]],
+        total_steps: int,
+        *,
+        checkpoint_every: int = 0,
+        handler_factory: Optional[Callable[[SliceOffer], Any]] = None,
+        max_incarnations: int = 32,
+    ) -> None:
+        self.workload = workload
+        self.ckpt = checkpointer
+        self.slice_provider = slice_provider
+        self.total_steps = int(total_steps)
+        self.checkpoint_every = int(checkpoint_every)
+        self.handler_factory = handler_factory
+        self.max_incarnations = int(max_incarnations)
+
+    def run(self) -> ElasticReport:
+        report = ElasticReport(completed=False)
+        for attempt in range(self.max_incarnations):
+            t0 = time.perf_counter()
+            offer = self.slice_provider(attempt)
+            if offer is None:
+                break
+            state, start = self._restore_or_init(offer)
+            handler = self.handler_factory(offer) if self.handler_factory else None
+            if attempt > 0:
+                # acquire + restore + reshard — the restart cost the chaos
+                # driver bounds
+                TRAIN.histogram("restart_seconds").observe(time.perf_counter() - t0)
+                report.restarts += 1
+            inc = {"attempt": attempt, "startStep": start, "offer": offer.describe()}
+            report.incarnations.append(inc)
+            outcome, end_step = self._train(state, start, handler, report)
+            inc["outcome"] = outcome
+            inc["endStep"] = end_step
+            if outcome == "completed":
+                report.completed = True
+                return report
+            LOG.warning(
+                "elastic: incarnation %d ended %s at step %d; re-requesting slice",
+                attempt, outcome, end_step,
+            )
+        return report
+
+    # -- one incarnation -----------------------------------------------------
+    def _restore_or_init(self, offer: SliceOffer) -> Tuple[Any, int]:
+        try:
+            snap, meta = self.ckpt.restore_numpy()
+        except FileNotFoundError:
+            return self.workload.init(offer), 0
+        state = self.workload.restore(offer, snap, meta)
+        return state, int(meta.get("step", -1)) + 1
+
+    def _train(self, state, start: int, handler, report: ElasticReport):
+        step = start
+        while step < self.total_steps:
+            state, loss = self.workload.run_step(state, step)
+            report.losses[step] = float(loss)
+            if self.checkpoint_every and (step + 1) % self.checkpoint_every == 0:
+                self._save(state, step)
+            status = handler.check() if handler is not None else DrainStatus("ok")
+            if status.state == "draining":
+                # the urgent save: everything up to and including this step
+                # survives the eviction, so zero steps are lost
+                self._save(state, step)
+                if handler is not None:
+                    handler.ack(step)
+                TRAIN.counter("preemptions_survived_total").inc()
+                report.preemptions_survived += 1
+                return "preempted", step
+            if status.state == "lost":
+                # no drain, no save: the next incarnation replays from the
+                # last periodic checkpoint
+                return "lost", step
+            step += 1
+        return "completed", step
+
+    def _save(self, state, step: int) -> None:
+        snap, wmeta = self.workload.snapshot(state)
+        meta = {"step": step}
+        meta.update(wmeta or {})
+        self.ckpt.save(step, snap, meta=meta)
+
+
+class CompositeWorkload:
+    """The composed-4D pipeline GPT (parallel/composite.py) as an elastic
+    workload. The snapshot is the CANONICAL per-layer weight tree, so every
+    incarnation rebuilds its own (pp, virtual_stages) chunking from it —
+    restoring a (pp=4, V=1) checkpoint on a (pp=2, V=2) mesh is the same
+    logical model continuing its loss curve.
+
+    Batches are derived from the step index (seeded), never from an
+    in-memory iterator, so the data pipeline "cursor" in the checkpoint
+    meta is just the step — replay after restore sees identical data.
+    """
+
+    def __init__(
+        self,
+        cfg=None,
+        *,
+        lr: float = 0.1,
+        num_micro: int = 4,
+        microbatch: int = 4,
+        data_seed: int = 0,
+        init_seed: int = 0,
+        gather_mode: str = "eager",
+    ) -> None:
+        from ..parallel.composite import CompositeConfig
+
+        self.cfg = cfg or CompositeConfig()
+        self.lr = lr
+        self.num_micro = num_micro
+        self.microbatch = microbatch
+        self.data_seed = data_seed
+        self.init_seed = init_seed
+        self.gather_mode = gather_mode
+
+    def _setup(self, offer: SliceOffer):
+        from ..parallel.composite import make_train_step
+        from ..parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(
+            MeshConfig(data=-1, pipe=offer.pp), devices=list(offer.devices)
+        )
+        step_fn = make_train_step(
+            self.cfg, mesh, self.lr,
+            virtual_stages=offer.virtual_stages, gather_mode=self.gather_mode,
+        )
+        return mesh, step_fn
+
+    def init(self, offer: SliceOffer):
+        import jax
+
+        from ..parallel.composite import init_params
+
+        mesh, step_fn = self._setup(offer)
+        params = init_params(
+            jax.random.PRNGKey(self.init_seed), self.cfg, mesh,
+            virtual_stages=offer.virtual_stages,
+        )
+        return {"mesh": mesh, "step_fn": step_fn, "params": params, "offer": offer}
+
+    def restore(self, offer: SliceOffer, snap, meta):
+        from ..parallel.composite import params_from_canonical
+
+        mesh, step_fn = self._setup(offer)
+        params = params_from_canonical(
+            snap["params"], self.cfg, mesh, virtual_stages=offer.virtual_stages
+        )
+        return {"mesh": mesh, "step_fn": step_fn, "params": params, "offer": offer}
+
+    def snapshot(self, state):
+        from ..parallel.composite import canonical_params
+
+        canon = canonical_params(
+            state["params"], state["mesh"],
+            virtual_stages=state["offer"].virtual_stages,
+        )
+        offer = state["offer"]
+        return {"params": canon}, {
+            "pp": offer.pp,
+            "virtualStages": offer.virtual_stages,
+            "dataCursor": None,  # data is step-addressed; the step IS the cursor
+        }
+
+    def _batch(self, state, step: int):
+        import jax
+        import numpy as np
+
+        from ..parallel.composite import batch_sharding
+
+        rng = np.random.RandomState(self.data_seed + step)
+        ids = rng.randint(
+            0, self.cfg.vocab_size,
+            size=(self.num_micro, self.microbatch, self.cfg.seq),
+        ).astype(np.int32)
+        return jax.device_put(ids, batch_sharding(state["mesh"]))
+
+    def run_step(self, state, step: int):
+        params, loss = state["step_fn"](state["params"], self._batch(state, step))
+        state["params"] = params
+        return state, float(loss)

@@ -1,0 +1,576 @@
+"""Elastic preemption-survivable training (docs/ELASTICITY.md): crash-safe
+checkpoints (atomic rename, corrupt skip-over, GC floor), the scheduler's
+two-phase drain protocol (annotation + ack/deadline before eviction), the
+PreemptionHandler/ElasticTrainer restart loop (drain mid-checkpoint, second
+preemption during restart, restore on a smaller slice, replay after a
+no-warning crash), the chaos injectors, and the fleet watcher's
+crash-restart wrapper."""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api.meta import annotations_of, new_object
+from kubeflow_tpu.controllers.builtin import PodletReconciler, make_tpu_node
+from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.scheduler import SchedulerReconciler
+from kubeflow_tpu.scheduler.gang import (
+    DRAIN_ACK_ANNOTATION,
+    DRAIN_DEADLINE_ANNOTATION,
+    DRAIN_GRACE_ANNOTATION,
+    POD_GROUP_LABEL,
+    POD_GROUP_SIZE_ANNOTATION,
+)
+from kubeflow_tpu.serving.fleet import EngineFleet
+from kubeflow_tpu.training.checkpoint import Checkpointer
+from kubeflow_tpu.training.elastic import (
+    DrainStatus,
+    ElasticTrainer,
+    PreemptionHandler,
+    SliceOffer,
+)
+from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), f"timed out waiting for {desc}"
+
+
+def mkpod(name, ns="default", chips=0, gang=None, size=1, priority_class=None,
+          grace=None):
+    spec = {"containers": [{"name": "c"}]}
+    if chips:
+        spec["containers"][0]["resources"] = {"limits": {RESOURCE_TPU: str(chips)}}
+    if priority_class:
+        spec["priorityClassName"] = priority_class
+    labels = {POD_GROUP_LABEL: gang} if gang else {}
+    annotations = {POD_GROUP_SIZE_ANNOTATION: str(size)} if gang else {}
+    if grace is not None:
+        annotations[DRAIN_GRACE_ANNOTATION] = str(grace)
+    return new_object("v1", "Pod", name, ns, labels=labels,
+                      annotations=annotations, spec=spec)
+
+
+# -- crash-safe checkpointer --------------------------------------------------
+
+
+class TestCrashSafeCheckpointer:
+    def test_meta_and_restore_numpy_roundtrip(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        tree = {"params": {"w": np.arange(6.0).reshape(2, 3)},
+                "opt": [np.float32(0.5), np.arange(3, dtype=np.int32)]}
+        ckpt.save(7, tree, meta={"step": 7, "pp": 4, "virtualStages": 1})
+        got, meta = ckpt.restore_numpy()
+        assert meta == {"step": 7, "pp": 4, "virtualStages": 1}
+        np.testing.assert_array_equal(got["params"]["w"], tree["params"]["w"])
+        np.testing.assert_array_equal(got["opt"][1], tree["opt"][1])
+        assert ckpt.read_meta()["pp"] == 4
+
+    def test_corrupt_newest_checkpoint_is_skipped(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        ckpt.save(0, {"x": np.full(4, 1.0)}, meta={"step": 0})
+        ckpt.save(1, {"x": np.full(4, 2.0)}, meta={"step": 1})
+        # bit-flip a leaf of the newest checkpoint (same size: crc catches it)
+        leaf = os.path.join(str(tmp_path), "step_1", "leaf_00000.npy")
+        data = bytearray(open(leaf, "rb").read())
+        data[-1] ^= 0xFF
+        open(leaf, "wb").write(bytes(data))
+        got, meta = ckpt.restore_numpy()
+        assert meta["step"] == 0
+        np.testing.assert_array_equal(got["x"], np.full(4, 1.0))
+        # template restore skips it the same way
+        out = ckpt.restore({"x": np.zeros(4)})
+        np.testing.assert_array_equal(out["x"], np.full(4, 1.0))
+
+    def test_truncated_manifest_is_skipped(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path))
+        ckpt.save(0, {"x": np.ones(2)}, meta={"step": 0})
+        ckpt.save(1, {"x": np.ones(2) * 2}, meta={"step": 1})
+        mpath = os.path.join(str(tmp_path), "step_1", "manifest.json")
+        open(mpath, "w").write(open(mpath).read()[:20])  # torn write
+        assert ckpt.latest_step() == 0
+
+    def test_kill9_mid_save_leaves_no_visible_checkpoint(self, tmp_path):
+        # a process killed -9 mid-save leaves only the un-renamed temp dir
+        tmp = os.path.join(str(tmp_path), "_tmp.3.deadbeef")
+        os.makedirs(tmp)
+        open(os.path.join(tmp, "leaf_00000.npy"), "wb").write(b"partial")
+        ckpt = Checkpointer(str(tmp_path))  # reopen after the crash
+        assert ckpt.latest_step() is None
+        assert not os.path.exists(tmp), "orphan temp dir not reclaimed"
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore_numpy()
+
+    def test_gc_keeps_newest_complete_never_corrupt_floor(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path), max_to_keep=2)
+        for s in range(4):
+            ckpt.save(s, {"x": np.full(2, float(s))}, meta={"step": s})
+        assert ckpt.all_steps() == [2, 3]
+        # corrupt the newest; the previous complete one must survive both
+        # the corruption AND the next save's GC
+        shutil.rmtree(os.path.join(str(tmp_path), "step_3"))
+        os.makedirs(os.path.join(str(tmp_path), "step_3"))  # empty = corrupt
+        assert ckpt.all_steps() == [2]
+        ckpt.save(4, {"x": np.full(2, 4.0)}, meta={"step": 4})
+        assert 4 in ckpt.all_steps()
+        got, meta = ckpt.restore_numpy()
+        assert meta["step"] == 4
+
+    def test_concurrent_saves_serialize_without_corruption(self, tmp_path):
+        # the drain-mid-checkpoint shape: an urgent save fires while a
+        # periodic save is still writing; the lock serializes them and both
+        # land complete
+        ckpt = Checkpointer(str(tmp_path), max_to_keep=4)
+        big = {"x": np.random.RandomState(0).rand(256, 256)}
+        errs = []
+
+        def periodic():
+            try:
+                ckpt.save(10, big, meta={"step": 10})
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t = threading.Thread(target=periodic)
+        t.start()
+        ckpt.save(11, big, meta={"step": 11})  # urgent drain save
+        t.join()
+        assert not errs
+        assert set(ckpt.all_steps()) == {10, 11}
+        assert ckpt.restore_numpy()[1]["step"] == 11
+
+    def test_checkpoint_save_seconds_observed(self, tmp_path):
+        Checkpointer(str(tmp_path)).save(0, {"x": np.ones(1)})
+        assert METRICS.histogram("checkpoint_save_seconds").total >= 1
+
+
+# -- scheduler drain protocol -------------------------------------------------
+
+
+@pytest.fixture()
+def sched():
+    return SchedulerReconciler(
+        assembly_timeout=5.0, reservation_ttl=5.0, backoff_base=0.02, backoff_cap=0.5
+    )
+
+
+@pytest.fixture()
+def cluster(sched):
+    mgr = Manager()
+    mgr.add(sched).add(PodletReconciler())
+    mgr.client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+    mgr.client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+    mgr.start()
+    try:
+        yield mgr
+    finally:
+        mgr.stop()
+
+
+def drain_deadline_of(client, name, ns="default"):
+    pod = client.get_opt("v1", "Pod", name, ns)
+    if pod is None:
+        return None
+    return annotations_of(pod).get(DRAIN_DEADLINE_ANNOTATION)
+
+
+class TestDrainProtocol:
+    def test_graceful_victim_drains_then_evicts_on_ack(self, cluster, sched):
+        for i in range(2):
+            cluster.client.create(mkpod(f"trial-{i}", chips=4, gang="hpo", size=2,
+                                        priority_class="trial", grace=30))
+        wait_for(lambda: all(
+            (cluster.client.get("v1", "Pod", f"trial-{i}", "default")
+             .get("spec") or {}).get("nodeName") for i in range(2)),
+            desc="trial gang bound")
+        for i in range(2):
+            cluster.client.create(mkpod(f"nb-{i}", chips=4, gang="nb", size=2,
+                                        priority_class="notebook"))
+        # phase 1: drain signal lands, victims NOT deleted yet
+        wait_for(lambda: all(drain_deadline_of(cluster.client, f"trial-{i}")
+                             for i in range(2)), desc="drain annotations")
+        deadline = float(drain_deadline_of(cluster.client, "trial-0"))
+        assert deadline > time.time() + 5  # long grace still ahead
+        time.sleep(0.2)
+        assert cluster.client.get_opt("v1", "Pod", "trial-0", "default") is not None
+        assert METRICS.total("scheduler_drains_requested_total") >= 1
+        # the workload-facing Event names the drain
+        evs = cluster.client.list("v1", "Event", "default")
+        assert any(e.get("reason") == "TrainingPreempted" for e in evs)
+        # flight recorder: the VICTIM gang's record carries preemptor + deadline
+        drains = [d for d in sched.flight.decisions(gang="default/hpo")
+                  if d.outcome == "drain_requested"]
+        assert drains and drains[-1].preemption["preemptor"] == "default/nb"
+        assert drains[-1].preemption["graceDeadline"] == pytest.approx(deadline)
+        # the PREEMPTOR's /debug/scheduler records name the draining victim
+        waits = [d for d in sched.flight.decisions(gang="default/nb")
+                 if d.outcome == "awaiting_drain"]
+        assert waits and waits[-1].preemption["draining"]["gang"] == "default/hpo"
+        # phase 2: ack both pods → eviction + preemptor binds
+        for i in range(2):
+            cluster.client.patch(
+                "v1", "Pod", f"trial-{i}",
+                {"metadata": {"annotations": {DRAIN_ACK_ANNOTATION: "41"}}},
+                "default")
+        wait_for(lambda: cluster.client.get_opt("v1", "Pod", "trial-0", "default")
+                 is None, desc="victims evicted after ack")
+        wait_for(lambda: all(
+            (cluster.client.get("v1", "Pod", f"nb-{i}", "default")
+             .get("status") or {}).get("phase") == "Running" for i in range(2)),
+            desc="preemptor Running")
+        assert METRICS.value("scheduler_drains_completed_total",
+                             outcome="acked") >= 1
+        # the eviction decision also carries identity + deadline
+        evict = [d for d in sched.flight.decisions(gang="default/nb")
+                 if d.outcome == "preempted"]
+        assert evict and evict[-1].preemption["victim"] == "default/hpo"
+        assert evict[-1].preemption["graceDeadline"] == pytest.approx(deadline)
+
+    def test_drain_deadline_expiry_evicts_without_ack(self, cluster):
+        for i in range(2):
+            cluster.client.create(mkpod(f"trial-{i}", chips=4, gang="hpo", size=2,
+                                        priority_class="trial", grace=0.4))
+        wait_for(lambda: all(
+            (cluster.client.get("v1", "Pod", f"trial-{i}", "default")
+             .get("spec") or {}).get("nodeName") for i in range(2)),
+            desc="trial gang bound")
+        for i in range(2):
+            cluster.client.create(mkpod(f"nb-{i}", chips=4, gang="nb", size=2,
+                                        priority_class="notebook"))
+        # never ack: the deadline evicts
+        wait_for(lambda: cluster.client.get_opt("v1", "Pod", "trial-0", "default")
+                 is None, desc="victims evicted on deadline")
+        wait_for(lambda: all(
+            (cluster.client.get("v1", "Pod", f"nb-{i}", "default")
+             .get("status") or {}).get("phase") == "Running" for i in range(2)),
+            desc="preemptor Running")
+        assert METRICS.value("scheduler_drains_completed_total",
+                             outcome="deadline") >= 1
+
+
+# -- PreemptionHandler --------------------------------------------------------
+
+
+class TestPreemptionHandler:
+    def test_detects_drain_and_acks(self, client):
+        client.create(mkpod("w-0"))
+        client.create(mkpod("w-1"))
+        h = PreemptionHandler(client, "default", ["w-0", "w-1"], poll_interval=0.0)
+        assert h.check().state == "ok"
+        deadline = time.time() + 9.0
+        client.patch("v1", "Pod", "w-0",
+                     {"metadata": {"annotations": {
+                         DRAIN_DEADLINE_ANNOTATION: f"{deadline:.3f}"}}},
+                     "default")
+        status = h.check()
+        assert status.state == "draining"
+        assert status.deadline == pytest.approx(deadline, abs=0.01)
+        h.ack(17)
+        for name in ("w-0", "w-1"):
+            pod = client.get("v1", "Pod", name, "default")
+            assert annotations_of(pod).get(DRAIN_ACK_ANNOTATION) == "17"
+
+    def test_lost_when_gang_vanishes_without_drain(self, client):
+        client.create(mkpod("w-0"))
+        h = PreemptionHandler(client, "default", ["w-0"], poll_interval=0.0)
+        assert h.check().state == "ok"
+        client.delete("v1", "Pod", "w-0", "default")
+        assert h.check().state == "lost"
+
+
+# -- ElasticTrainer -----------------------------------------------------------
+
+
+class ToyWorkload:
+    """Deterministic scalar model whose state is 'sharded' by chunking a
+    canonical vector across the offer's devices — a stand-in for the
+    composite re-chunking that keeps these tests off the jit path. Carries
+    a momentum term so snapshots cover params + opt state."""
+
+    CANON = 8  # canonical vector length
+
+    def init(self, offer):
+        n = len(offer.devices)
+        return {"x": np.zeros((n, self.CANON // n)),
+                "m": np.zeros((n, self.CANON // n)), "offer": offer}
+
+    def restore(self, offer, snap, meta):
+        n = len(offer.devices)
+        return {"x": np.asarray(snap["x"]).reshape(n, self.CANON // n),
+                "m": np.asarray(snap["m"]).reshape(n, self.CANON // n),
+                "offer": offer}
+
+    def snapshot(self, state):
+        return ({"x": state["x"].reshape(-1), "m": state["m"].reshape(-1)},
+                {"dataCursor": None})
+
+    def run_step(self, state, step):
+        g = 0.01 * (step + 1)  # "gradient" addressed purely by step
+        state["m"] = 0.9 * state["m"] + g
+        state["x"] = state["x"] - state["m"]
+        return state, float(np.sum(state["x"]) * (step + 1))
+
+
+class ScriptedHandler:
+    """Drains at a fixed step (or never); records acks."""
+
+    def __init__(self, drain_at=None):
+        self.drain_at = drain_at
+        self.acked = []
+        self.lost_at = None
+
+    def check(self):
+        # the trainer checks after running `step`, so comparing against the
+        # just-completed step makes drain_at the last surviving step
+        if self.lost_at is not None and self._step >= self.lost_at:
+            return DrainStatus("lost")
+        if self.drain_at is not None and self._step >= self.drain_at:
+            return DrainStatus("draining", time.time() + 5)
+        return DrainStatus("ok")
+
+    def ack(self, step):
+        self.acked.append(step)
+
+
+def scripted_trainer(tmp_path, widths, drains, total_steps=10, every=0,
+                     workload=None):
+    """Trainer whose incarnation i gets ``widths[i]`` fake devices and a
+    handler scripted by ``drains[i]`` (int → drain after that step,
+    ("lost", s) → vanish at step s, None → run free)."""
+    workload = workload or ToyWorkload()
+    handlers = []
+
+    def provider(attempt):
+        if attempt >= len(widths):
+            return None
+        return SliceOffer(devices=list(range(widths[attempt])),
+                          pods=[f"p{attempt}-{i}" for i in range(2)])
+
+    def handler_factory(offer):
+        i = len(handlers)
+        spec = drains[i] if i < len(drains) else None
+        h = ScriptedHandler()
+        if isinstance(spec, tuple) and spec[0] == "lost":
+            h.lost_at = spec[1]
+        elif spec is not None:
+            h.drain_at = spec
+        handlers.append(h)
+        return h
+
+    trainer = ElasticTrainer(
+        workload, Checkpointer(str(tmp_path)), provider, total_steps,
+        checkpoint_every=every, handler_factory=handler_factory)
+    # thread the current step into the scripted handlers
+    orig = trainer.workload.run_step
+
+    def run_step(state, step):
+        for h in handlers:
+            h._step = step
+        return orig(state, step)
+
+    trainer.workload.run_step = run_step  # type: ignore[attr-defined]
+    return trainer, handlers
+
+
+def reference_losses(total_steps=10):
+    w = ToyWorkload()
+    state = w.init(SliceOffer(devices=list(range(8))))
+    out = {}
+    for s in range(total_steps):
+        state, loss = w.run_step(state, s)
+        out[s] = loss
+    return out
+
+
+class TestElasticTrainer:
+    def test_survives_preemptions_reshards_smaller_and_matches_reference(
+            self, tmp_path):
+        # inc 0 (8 devices) drains after step 3; inc 1 (4 devices) is
+        # preempted AGAIN on its very first step (second preemption during
+        # restart); inc 2 restores onto 2 devices — smaller than any slice
+        # used before — and finishes.
+        trainer, handlers = scripted_trainer(
+            tmp_path, widths=[8, 4, 2], drains=[3, 4, None])
+        report = trainer.run()
+        assert report.completed
+        assert report.preemptions_survived == 2
+        assert report.restarts == 2
+        # zero lost steps: each incarnation resumes exactly after the last
+        # checkpointed step
+        assert [i["startStep"] for i in report.incarnations] == [0, 4, 5]
+        assert handlers[0].acked == [3] and handlers[1].acked == [4]
+        # loss-curve continuity: identical to an uninterrupted run
+        ref = reference_losses()
+        assert set(report.losses) == set(ref)
+        for s, loss in ref.items():
+            assert report.losses[s] == pytest.approx(loss, abs=1e-12), s
+        assert METRICS.total("training_preemptions_survived_total") == 2
+        assert METRICS.histogram("training_restart_seconds").total == 2
+
+    def test_crash_without_drain_replays_from_periodic_checkpoint(self, tmp_path):
+        # inc 0 vanishes at step 4 with NO drain (killed node): the last
+        # periodic checkpoint is step 3 (every=2 saves after steps 1, 3), so
+        # step 4 is lost in flight and REPLAYS in incarnation 1
+        trainer, _ = scripted_trainer(
+            tmp_path, widths=[8, 8], drains=[("lost", 4), None], every=2)
+        report = trainer.run()
+        assert report.completed
+        assert report.preemptions_survived == 0  # a crash is not a survival
+        assert report.incarnations[0]["outcome"] == "lost"
+        assert report.incarnations[1]["startStep"] == 4  # replay from step 3
+        ref = reference_losses()
+        for s, loss in ref.items():
+            assert report.losses[s] == pytest.approx(loss, abs=1e-12), s
+
+    def test_corrupt_checkpoint_skipped_on_restart(self, tmp_path):
+        # preempt at step 4 (urgent save at 4), then corrupt that newest
+        # checkpoint before the restart: the trainer must fall back to the
+        # periodic save at step 3 and replay step 4
+        trainer, _ = scripted_trainer(
+            tmp_path, widths=[8, 8], drains=[4, None], every=2)
+        orig_provider = trainer.slice_provider
+
+        def corrupting_provider(attempt):
+            if attempt == 1:
+                leaf = os.path.join(str(tmp_path), "step_4", "leaf_00000.npy")
+                data = bytearray(open(leaf, "rb").read())
+                data[-1] ^= 0xFF
+                open(leaf, "wb").write(bytes(data))
+            return orig_provider(attempt)
+
+        trainer.slice_provider = corrupting_provider
+        report = trainer.run()
+        assert report.completed
+        assert report.incarnations[1]["startStep"] == 4  # fell back to step 3
+        ref = reference_losses()
+        for s, loss in ref.items():
+            assert report.losses[s] == pytest.approx(loss, abs=1e-12), s
+
+    def test_drain_during_periodic_checkpoint_step_saves_once_more(self, tmp_path):
+        # drain lands on a step that ALSO takes a periodic checkpoint: the
+        # urgent save re-saves the same step (replace, not corrupt) and the
+        # resume starts exactly one step later
+        trainer, handlers = scripted_trainer(
+            tmp_path, widths=[8, 8], drains=[3, None], every=4)  # periodic at 3
+        report = trainer.run()
+        assert report.completed
+        assert handlers[0].acked == [3]
+        assert report.incarnations[1]["startStep"] == 4
+        ref = reference_losses()
+        for s, loss in ref.items():
+            assert report.losses[s] == pytest.approx(loss, abs=1e-12), s
+
+
+# -- chaos injectors ----------------------------------------------------------
+
+
+class TestChaos:
+    def test_seeded_schedule_is_deterministic(self):
+        targets = {"kill_node": ["n0", "n1"], "preempt_gang": ["default/g"]}
+        a = ChaosSchedule.seeded(7, 6, 30.0, targets, {"preempt_gang": 2.0})
+        b = ChaosSchedule.seeded(7, 6, 30.0, targets, {"preempt_gang": 2.0})
+        assert a.faults == b.faults
+        assert len(a.faults) == 6
+        assert a.faults == sorted(a.faults, key=lambda f: f.at)
+
+    def test_preempt_gang_is_protocol_faithful(self, client):
+        for i in range(2):
+            client.create(mkpod(f"g-{i}", chips=0, gang="job", size=2))
+        monkey = ChaosMonkey(client, ChaosSchedule([]))
+        monkey.inject(Fault(0.0, "preempt_gang", "default/job", param=10.0))
+        for i in range(2):
+            pod = client.get("v1", "Pod", f"g-{i}", "default")
+            assert DRAIN_DEADLINE_ANNOTATION in annotations_of(pod)
+        evs = client.list("v1", "Event", "default")
+        assert any(e.get("reason") == "TrainingPreempted" for e in evs)
+        assert client.get_opt("v1", "Pod", "g-0", "default") is not None
+        # ack both pods → the evict thread deletes them well before deadline
+        for i in range(2):
+            client.patch("v1", "Pod", f"g-{i}",
+                         {"metadata": {"annotations": {DRAIN_ACK_ANNOTATION: "3"}}},
+                         "default")
+        wait_for(lambda: client.get_opt("v1", "Pod", "g-0", "default") is None,
+                 desc="chaos evicted after ack")
+        assert METRICS.value("chaos_faults_injected_total",
+                             kind="preempt_gang") == 1
+        monkey.stop()
+
+    def test_kill_node_fails_pods_and_removes_node(self, client):
+        client.create(make_tpu_node("doomed", "v5e", "2x2", 4))
+        pod = mkpod("on-doomed", chips=4)
+        pod["spec"]["nodeName"] = "doomed"
+        client.create(pod)
+        ChaosMonkey(client, ChaosSchedule([])).inject(
+            Fault(0.0, "kill_node", "doomed"))
+        assert client.get_opt("v1", "Node", "doomed") is None
+        assert (client.get("v1", "Pod", "on-doomed", "default")
+                .get("status") or {}).get("phase") == "Failed"
+
+    def test_delay_apiserver_stalls_calls(self, store, client):
+        monkey = ChaosMonkey(client, ChaosSchedule([]), store=store)
+        monkey.inject(Fault(0.0, "delay_apiserver", param=0.4))
+        time.sleep(0.05)  # let the holder thread grab the lock
+        t0 = time.perf_counter()
+        client.list("v1", "Pod")
+        assert time.perf_counter() - t0 > 0.15
+        monkey.stop()
+
+    def test_drop_informer_watch_closes_stream(self, client):
+        class FakeWatcher:
+            closed = False
+
+            def close(self):
+                self.closed = True
+
+        class FakeInformer:
+            kind = "Pod"
+            _watcher = FakeWatcher()
+
+        inf = FakeInformer()
+        ChaosMonkey(client, ChaosSchedule([]), informers=[inf]).inject(
+            Fault(0.0, "drop_informer_watch", "Pod"))
+        assert inf._watcher.closed
+        assert METRICS.value("chaos_faults_injected_total",
+                             kind="drop_informer_watch") == 1
+
+
+# -- fleet watcher crash-restart ----------------------------------------------
+
+
+class CrashOnceEngine:
+    def __init__(self, engine_id):
+        self.engine_id = engine_id
+
+    def drain(self):
+        return []
+
+    def close(self):
+        pass
+
+
+class TestFleetWatcherRestart:
+    def test_watcher_restarts_after_crash(self):
+        fleet = EngineFleet(replicas=1, engine_factory=CrashOnceEngine,
+                            register_debug=False, poll_interval=0.01)
+        calls = []
+
+        def loop():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("boom")  # previously: thread dies silently
+
+        fleet._watch_pods_loop = loop
+        fleet._watch_pods()  # run the wrapper synchronously
+        assert len(calls) == 2  # crashed once, restarted, exited cleanly
+        assert METRICS.total("fleet_watcher_restarts_total") == 1

@@ -324,7 +324,14 @@ class TestServing:
             assert c["resources"]["limits"]["google.com/tpu"] == "4"
             assert dep["spec"]["template"]["spec"]["nodeSelector"][
                 "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
-            isvc = mgr.client.get(SERVING_API, "InferenceService", "bert", "team-a")
+            # Ready rollup can land just after wait_idle's settle window
+            # (informer dispatch latency); give it a bounded grace.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                isvc = mgr.client.get(SERVING_API, "InferenceService", "bert", "team-a")
+                if isvc["status"].get("conditions", [{}])[0].get("status") == "True":
+                    break
+                time.sleep(0.05)
             assert isvc["status"]["conditions"][0]["status"] == "True"
             assert "bert-base" in isvc["status"]["url"]
             # multi-host topology rejected terminally
