@@ -103,6 +103,25 @@ def _step_breakdown(clock, timed_steps: int) -> dict:
     }
 
 
+def _attribution_row(make_costs, clock, timed_steps: int, generation: str):
+    """Per-module attribution for a bench row (BENCH_ATTRIBUTION=0 skips).
+    ``make_costs`` prices the model walk — compile-time only, nothing
+    executes — and the report decomposes the clock's measured window into
+    data-wait / fused-compute / un-fused-compute / other step fractions.
+    Guarded: attribution failing must never fail the bench."""
+    if os.environ.get("BENCH_ATTRIBUTION", "1") != "1":
+        return None
+    try:
+        from kubeflow_tpu.training.attribution import attribution_report
+
+        report = attribution_report(make_costs(), clock=clock,
+                                    steps_per_record=timed_steps,
+                                    generation=generation)
+        return report.to_dict(top_n=5)
+    except Exception as e:
+        return {"error": str(e)[:160]}
+
+
 def _bench(batch: int):
     from kubeflow_tpu.models import ResNet50
     from kubeflow_tpu.training import ClassifierTask, mfu
@@ -236,6 +255,25 @@ def _bench(batch: int):
     dt = total / timed_steps
 
     gen = detect_generation()
+    # HBM telemetry from the window executable's memory_analysis (the loop
+    # reuses temps, so the window's resident bytes ARE the step's peak);
+    # published as the training_step_peak_hbm_bytes gauge and the bench row.
+    mem = None
+    try:
+        from kubeflow_tpu.training.attribution import record_step_peak_hbm
+        from kubeflow_tpu.training.flops import memory_stats
+
+        mem = memory_stats(run_steps)
+        record_step_peak_hbm(mem)
+    except Exception:
+        mem = None
+    def _resnet_costs():
+        from kubeflow_tpu.training.attribution import attribute_resnet
+
+        return attribute_resnet(batch=batch, image=224, stem=stem,
+                                fused_blocks=use_fused, generation=gen)
+
+    attribution = _attribution_row(_resnet_costs, clock, timed_steps, gen)
     return {
         "images_per_sec_per_chip": batch / dt,
         "step_seconds": dt,
@@ -248,6 +286,9 @@ def _bench(batch: int):
         "fused_blocks": use_fused,
         "fused_calibration": calibration,
         "step_breakdown": _step_breakdown(clock, timed_steps),
+        "peak_hbm_bytes": (mem or {}).get("peak_hbm_bytes"),
+        "memory": mem,
+        "attribution": attribution,
     }
 
 
@@ -373,6 +414,23 @@ def _bench_gpt(batch: int, seq: int):
     total, window_times = _timed_windows(window, _repeats())
     dt = total / timed_steps
     gen = detect_generation()
+    mem = None
+    try:
+        from kubeflow_tpu.training.attribution import record_step_peak_hbm
+        from kubeflow_tpu.training.flops import memory_stats
+
+        mem = memory_stats(run_steps)
+        record_step_peak_hbm(mem)
+    except Exception:
+        mem = None
+
+    def _gpt_costs():
+        from kubeflow_tpu.training.attribution import attribute_gpt
+
+        return attribute_gpt(cfg, batch=batch, seq=seq,
+                             fused_loss=fused_loss, generation=gen)
+
+    attribution = _attribution_row(_gpt_costs, clock, timed_steps, gen)
     return {
         "tokens_per_sec_per_chip": batch * seq / dt,
         "step_seconds": dt,
@@ -385,6 +443,9 @@ def _bench_gpt(batch: int, seq: int):
         "scan_blocks": scan_blocks,
         "fused_loss": fused_loss,
         "step_breakdown": _step_breakdown(clock, timed_steps),
+        "peak_hbm_bytes": (mem or {}).get("peak_hbm_bytes"),
+        "memory": mem,
+        "attribution": attribution,
     }
 
 
@@ -451,29 +512,38 @@ def _bench_multichip():
 
     clock = StepClock(metrics=METRICS.namespace("multichip"), tracer=TRACER)
 
-    def timed_run(use_mesh, use_v, use_gather, use_ids, label):
+    def timed_run(use_mesh, use_v, use_gather, use_ids, label, use_clock):
         """Compile + warm one train step on ``use_mesh``, then time
         ``timed_steps`` chained steps per window (param updates chain, so
-        no step is dead code; windows restart from the same init)."""
+        no step is dead code; windows restart from the same init). Each run
+        gets its OWN clock: the 1-chip reference must not pollute the
+        multichip row's step_breakdown."""
         params0 = composite_mod.init_params(rng, cfg, use_mesh,
                                             virtual_stages=use_v)
-        with clock.compile():
+        with use_clock.compile():
             step = composite_mod.make_train_step(
                 cfg, use_mesh, virtual_stages=use_v, gather_mode=use_gather)
             p, loss = step(params0, use_ids)  # first call compiles
             jax.block_until_ready(loss)
-        clock.mark()
+        mem = None
+        try:  # jit cache is warm; this only re-runs the (cached) AOT path
+            from kubeflow_tpu.training.flops import memory_stats
+
+            mem = memory_stats(step.lower(params0, use_ids).compile())
+        except Exception:
+            mem = None
+        use_clock.mark()
         results = {}
 
         def window():
-            with clock.compute():
+            with use_clock.compute():
                 p, loss = params0, None
                 for _ in range(timed_steps):
                     p, loss = step(p, use_ids)
                 jax.block_until_ready(loss)
-            with clock.fetch():
+            with use_clock.fetch():
                 results["loss"] = float(loss)
-            clock.end_step()
+            use_clock.end_step()
 
         def check():
             import math
@@ -482,9 +552,10 @@ def _bench_multichip():
 
         window.check = check
         total, _times = _timed_windows(window, _repeats())
-        return total / timed_steps, results["loss"]
+        return total / timed_steps, results["loss"], mem
 
-    dt, loss = timed_run(mesh, virtual_stages, gather_mode, ids, "multichip")
+    dt, loss, mem = timed_run(mesh, virtual_stages, gather_mode, ids,
+                              "multichip", clock)
     tokens_per_step = num_micro * mb * cfg.seq
     tok_per_chip = tokens_per_step / dt / n_dev
 
@@ -498,7 +569,9 @@ def _bench_multichip():
             jax.random.randint(jax.random.PRNGKey(1),
                                (num_micro, mb1, cfg.seq), 0, cfg.vocab_size),
             composite_mod.batch_sharding(mesh1))
-        dt1, _ = timed_run(mesh1, 1, "eager", ids1, "1chip")
+        clock_ref = StepClock(metrics=METRICS.namespace("multichip_ref"),
+                              tracer=TRACER, span_name="bench.1chip_ref")
+        dt1, _, _ = timed_run(mesh1, 1, "eager", ids1, "1chip", clock_ref)
         tok_1chip = num_micro * mb1 * cfg.seq / dt1
         scaling_efficiency = tok_per_chip / tok_1chip
 
@@ -515,6 +588,20 @@ def _bench_multichip():
         clock.note(f"comm_bytes_{axis}", b)
 
     flops = composite_step_flops(cfg, tokens_per_step)
+    from kubeflow_tpu.training.flops import detect_generation
+
+    gen = detect_generation()
+    if mem:
+        try:
+            from kubeflow_tpu.training.attribution import record_step_peak_hbm
+
+            record_step_peak_hbm(mem, metrics=METRICS.namespace("multichip"))
+        except Exception:
+            pass
+    # fractions-only attribution: no per-module walk for the composite
+    # (pipeline stages aren't flax blocks), but the step decomposition
+    # still rides along so the row explains its own wall clock
+    attribution = _attribution_row(lambda: [], clock, timed_steps, gen)
     return {
         "tokens_per_sec_per_chip": tok_per_chip,
         "tokens_per_sec_1chip": tok_1chip,
@@ -536,6 +623,9 @@ def _bench_multichip():
         "step_seconds": dt,
         "loss": loss,
         "step_breakdown": _step_breakdown(clock, timed_steps),
+        "peak_hbm_bytes": (mem or {}).get("peak_hbm_bytes"),
+        "memory": mem,
+        "attribution": attribution,
     }
 
 
@@ -562,6 +652,8 @@ def _run_multichip(platform: str) -> dict:
             "comm_bytes_per_step": r["comm_bytes_per_step"],
             "loss": round(r["loss"], 4),
             "step_breakdown": r["step_breakdown"],
+            "peak_hbm_bytes": r.get("peak_hbm_bytes"),
+            "attribution": r.get("attribution"),
             "platform": platform,
         })
     except Exception as e:
@@ -591,6 +683,8 @@ def _run_resnet(platform: str) -> dict:
                 "fused_blocks": r.get("fused_blocks"),
                 "fused_calibration": r.get("fused_calibration"),
                 "step_breakdown": r.get("step_breakdown"),
+                "peak_hbm_bytes": r.get("peak_hbm_bytes"),
+                "attribution": r.get("attribution"),
                 "platform": platform,
             })
         except Exception as e:  # OOM at this batch -> try smaller
@@ -618,6 +712,8 @@ def _run_gpt(platform: str, allow_legacy_batch: bool = False) -> dict:
             "scan_blocks": r.get("scan_blocks"),
             "fused_loss": r.get("fused_loss"),
             "step_breakdown": r.get("step_breakdown"),
+            "peak_hbm_bytes": r.get("peak_hbm_bytes"),
+            "attribution": r.get("attribution"),
             "platform": platform,
         })
     except Exception as e:

@@ -176,6 +176,41 @@ def elastic_e2e() -> Dict:
     return b.build()
 
 
+def bench_regression() -> Dict:
+    """The bench-gate job: tools/bench_gate.py compares the newest committed
+    bench round against the best earlier round per metric and fails on any
+    regression past tolerance. The two known r05 serving regressions
+    (decode throughput, BERT HTTP p50 — ROADMAP item 2) are carried as
+    explicit waivers so the gate is green on known-and-tracked state but
+    trips on anything NEW; the waivers die with the next round. Plus the
+    gate's and attribution plane's unit suite."""
+    b = WorkflowBuilder("bench-regression")
+    b.run("bench-gate", [
+        "python", "tools/bench_gate.py", "--history-dir", ".",
+        "--waive", "serving_bert_p50_ms_b8@r05",
+        "--waive", "serving_decode_tokens_per_sec_b8@r05",
+        "--waive", "serving_gpt_kv_decode_tokens_per_sec_b8@r05",
+    ])
+    b.pytest("attribution-unit", "tests/test_attribution.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
+def attribution_e2e() -> Dict:
+    """The attribution-plane job: a live StepClock train loop served over
+    real HTTP — /debug/profile must return Perfetto-loadable Chrome-trace
+    JSON with a complete event per step phase, capture-on-demand must wait
+    for fresh steps, and the /metrics scrape must carry the compiled step's
+    peak-HBM gauge (e2e/attribution_driver.py asserts all of it) — plus
+    the profiling unit suite."""
+    b = WorkflowBuilder("attribution-e2e")
+    b.run("attribution-profile-dryrun", ["python", "-m", "e2e.attribution_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("profiling-unit", "tests/test_profiling.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
@@ -185,6 +220,8 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "control-plane-e2e": control_plane_e2e,
     "serving-fleet-e2e": serving_fleet_e2e,
     "elastic-e2e": elastic_e2e,
+    "bench-regression": bench_regression,
+    "attribution-e2e": attribution_e2e,
 }
 
 

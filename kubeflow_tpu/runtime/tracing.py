@@ -224,9 +224,58 @@ class Tracer:
             tree.setdefault(s.parent_span_id or "", []).append(s)
         return tree
 
+    def to_chrome_trace(self, name: Optional[str] = None,
+                        trace_id: Optional[str] = None,
+                        limit: int = 4096) -> Dict[str, Any]:
+        """The ring buffer's tail as a Chrome-trace-event document (the
+        ``trace.json`` format Perfetto / chrome://tracing load directly) —
+        the offline-visualization counterpart to the OTLP-shaped
+        ``/debug/traces``. Span events become instant events on the same
+        track, so a ``train.step`` span shows its phase marks inline."""
+        spans = self.finished_spans(name=name, trace_id=trace_id)[-max(0, limit):]
+        return {"traceEvents": spans_to_chrome_trace(spans),
+                "displayTimeUnit": "ms"}
+
     def reset(self) -> None:
         with self._lock:
             self._spans.clear()
+
+
+# -- Chrome trace events (the Perfetto-loadable export) -----------------------
+
+def spans_to_chrome_trace(spans: List[Span]) -> List[Dict[str, Any]]:
+    """Spans → Chrome trace events: one complete ("ph": "X") event per span
+    (ts/dur in microseconds, as the format requires) plus one instant
+    ("ph": "i") event per span event. Spans of one trace share a ``tid`` so
+    a request's hops stack on one track; ``pid`` is the real process."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+    for span in spans:
+        tid = tids.setdefault(span.trace_id, len(tids) + 1)
+        events.append({
+            "name": span.name,
+            "cat": str(span.attributes.get("service.name", "span")),
+            "ph": "X",
+            "ts": span.start_ns / 1e3,
+            "dur": max(0.0, (span.end_ns - span.start_ns) / 1e3),
+            "pid": pid,
+            "tid": tid,
+            "args": {k: v for k, v in span.attributes.items()
+                     if k != "service.name"},
+        })
+        for ev in span.events:
+            events.append({
+                "name": ev.get("name", "event"),
+                "cat": "event",
+                "ph": "i",
+                "s": "t",
+                "ts": ev.get("timeUnixNano", span.end_ns) / 1e3,
+                "pid": pid,
+                "tid": tid,
+                "args": dict(ev.get("attributes", {})),
+            })
+    return events
 
 
 # -- W3C traceparent codec (the cross-service hop) ---------------------------

@@ -10,12 +10,13 @@ back where the time went.
 
 from __future__ import annotations
 
+import collections
 import glob
 import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 PROFILE_PORT = 9999
 
@@ -87,11 +88,19 @@ class StepClock:
     additionally emits one ``span_name`` span covering the step, its phases
     attached as events — so a bench/dryrun's training timeline shows up in
     ``/debug/traces`` next to the serving requests.
+
+    Phase events are always retained per step in a bounded ring
+    (``keep_steps``, default 512) so the timeline survives without a
+    tracer: ``to_chrome_trace()`` renders the recorded steps as a
+    Chrome-trace-event document (the ``trace.json`` Perfetto and
+    chrome://tracing load), and ``register_profile_clock()`` publishes it
+    at ``GET /debug/profile`` on every observability-mounted server.
     """
 
     def __init__(self, metrics: Optional[Any] = None,
                  tracer: Optional[Any] = None,
-                 span_name: str = "train.step") -> None:
+                 span_name: str = "train.step",
+                 keep_steps: int = 512) -> None:
         self._metrics = metrics
         self._tracer = tracer
         self._span_name = span_name
@@ -102,6 +111,10 @@ class StepClock:
         self._anchor = time.perf_counter()
         self._step_start_ns = time.time_ns()
         self._events: List[Dict[str, Any]] = []
+        #: per-step phase-event history for to_chrome_trace(): bounded so a
+        #: long training run can't grow host memory without limit
+        self._step_records: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=keep_steps)
 
     def note(self, key: str, value: float) -> None:
         """Attach a derived scalar (analytic comm bytes, bubble fraction —
@@ -121,10 +134,11 @@ class StepClock:
             self._current[name] = self._current.get(name, 0.0) + dt
             if self._metrics is not None:
                 self._metrics.histogram(f"step_{name}_seconds").observe(dt)
-            if self._tracer is not None:
-                self._events.append({"name": name,
-                                     "timeUnixNano": time.time_ns(),
-                                     "attributes": {"seconds": dt}})
+            # always recorded (span-event shape; start derives from end −
+            # seconds): the chrome-trace timeline must not require a tracer
+            self._events.append({"name": name,
+                                 "timeUnixNano": time.time_ns(),
+                                 "attributes": {"seconds": dt}})
 
     # The canonical phases as methods so call sites stay greppable.
     def data_wait(self):
@@ -149,36 +163,90 @@ class StepClock:
             self.compile_s += time.perf_counter() - start
             if self._metrics is not None:
                 self._metrics.gauge("compile_seconds").set(self.compile_s)
+            # Reset the anchors ONLY. Clearing self._events here silently
+            # dropped phase events recorded earlier in the same step (a
+            # data_wait timed before a mid-loop recompile vanished from the
+            # step span); already-recorded events must survive.
             self._anchor = time.perf_counter()
-            self._step_start_ns = time.time_ns()
-            self._events = []
+            if not self._events:
+                self._step_start_ns = time.time_ns()
 
     def mark(self) -> None:
         """Reset the wall anchor without recording — call after untimed
         work between steps (warmup executions, logging) so the next step's
-        ``total``/``other`` doesn't absorb it."""
+        ``total``/``other`` doesn't absorb it. Phase events already recorded
+        in the open step are preserved (see ``compile()``)."""
         self._anchor = time.perf_counter()
-        self._step_start_ns = time.time_ns()
-        self._events = []
+        if not self._events:
+            self._step_start_ns = time.time_ns()
 
     def end_step(self) -> Dict[str, float]:
         now = time.perf_counter()
+        now_ns = time.time_ns()
         rec = dict(self._current)
         rec["total"] = now - self._anchor
         rec["other"] = max(0.0, rec["total"] - sum(self._current.values()))
         self.steps.append(rec)
+        if self._metrics is not None:
+            for k, v in rec.items():
+                self._metrics.gauge("step_phase_seconds", phase=k).set(v)
+        self._step_records.append({
+            "step": len(self.steps),
+            "start_ns": self._step_start_ns,
+            "end_ns": now_ns,
+            "phases": list(self._events),
+            "rec": rec,
+        })
         if self._tracer is not None:
-            now_ns = time.time_ns()
             self._tracer.emit_span(
                 self._span_name, self._step_start_ns, now_ns,
                 events=self._events,
                 **{"step": len(self.steps),
                    **{f"phase.{k}": round(v, 6) for k, v in rec.items()}})
-            self._step_start_ns = now_ns
-            self._events = []
+        self._step_start_ns = now_ns
+        self._events = []
         self._current = {}
         self._anchor = now
         return rec
+
+    def to_chrome_trace(self, steps: Optional[int] = None,
+                        tid: int = 1) -> Dict[str, Any]:
+        """The last ``steps`` recorded steps (all retained when None) as a
+        Chrome-trace-event document: one complete ("ph": "X") event per
+        step named ``span_name`` with its phase means in ``args``, plus one
+        complete event per measured phase (start derived from the phase
+        event's end − duration). ``json.dumps`` of the return value is a
+        ``trace.json`` Perfetto and chrome://tracing open directly."""
+        records = list(self._step_records)
+        if steps is not None:
+            records = records[-max(0, steps):]
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = []
+        for r in records:
+            events.append({
+                "name": self._span_name,
+                "cat": "step",
+                "ph": "X",
+                "ts": r["start_ns"] / 1e3,
+                "dur": max(0.0, (r["end_ns"] - r["start_ns"]) / 1e3),
+                "pid": pid,
+                "tid": tid,
+                "args": {"step": r["step"],
+                         **{k: round(v, 6) for k, v in r["rec"].items()}},
+            })
+            for ev in r["phases"]:
+                dur_us = float(ev["attributes"].get("seconds", 0.0)) * 1e6
+                events.append({
+                    "name": ev["name"],
+                    "cat": "phase",
+                    "ph": "X",
+                    "ts": ev["timeUnixNano"] / 1e3 - dur_us,
+                    "dur": dur_us,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"step": r["step"]},
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def summary(self) -> Dict[str, float]:
         """Per-phase mean seconds across recorded steps, plus ``compile_s``
@@ -213,3 +281,57 @@ def profile_step(
         glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True)
     )
     return {"result": result, "trace_files": traces}
+
+
+# -- /debug/profile: on-demand step capture over HTTP -------------------------
+#
+# A training/bench loop registers its StepClock once; every server that
+# mounts observability (ops server, apiserver, ModelServer) then serves the
+# loop's live timeline as Perfetto-loadable Chrome-trace JSON — the
+# "download trace.json from the running job" workflow without a TensorBoard
+# deployment in the loop.
+
+#: registered clocks by name; last registration per name wins (what
+#: per-incarnation ElasticTrainer restarts and per-test clocks need)
+_PROFILE_CLOCKS: Dict[str, "StepClock"] = {}
+
+
+def register_profile_clock(clock: "StepClock", name: str = "train") -> "StepClock":
+    """Publish ``clock`` at ``GET /debug/profile`` (query: ``?steps=N`` last
+    N steps, ``?clock=<name>`` one clock, ``?timeout=S`` wait up to S
+    seconds for N *fresh* steps — the on-demand capture). Returns the clock
+    so call sites can register at construction."""
+    from kubeflow_tpu.runtime import obs  # lazy: profiling must not drag HTTP in
+
+    _PROFILE_CLOCKS[name] = clock
+    obs.register_debug_source("profile", _profile_debug_source)
+    return clock
+
+
+def _profile_debug_source(req: Any) -> Dict[str, Any]:
+    from kubeflow_tpu.web.http import HttpError
+
+    try:
+        steps = int(req.query1("steps", "16"))
+        timeout = float(req.query1("timeout", "0"))
+    except ValueError:
+        raise HttpError(400, "steps/timeout must be numeric") from None
+    name = req.query1("clock") or None
+    if name is not None and name not in _PROFILE_CLOCKS:
+        raise HttpError(
+            404, f"unknown clock {name!r}; registered: {sorted(_PROFILE_CLOCKS)}")
+    selected = {name: _PROFILE_CLOCKS[name]} if name else dict(_PROFILE_CLOCKS)
+    if timeout > 0:
+        # capture-on-demand: wait for `steps` steps recorded AFTER the
+        # request, so the trace answers "what is the loop doing right now"
+        deadline = time.monotonic() + timeout
+        baselines = {n: len(c.steps) for n, c in selected.items()}
+        while time.monotonic() < deadline:
+            if all(len(c.steps) >= baselines[n] + steps
+                   for n, c in selected.items()):
+                break
+            time.sleep(0.02)
+    events: List[Dict[str, Any]] = []
+    for tid, (_n, clock) in enumerate(sorted(selected.items()), start=1):
+        events.extend(clock.to_chrome_trace(steps=steps, tid=tid)["traceEvents"])
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

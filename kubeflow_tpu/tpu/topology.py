@@ -35,6 +35,7 @@ class AcceleratorType:
     bf16_tflops_per_chip: float   # peak dense bf16 TFLOP/s (MFU denominators)
     hbm_gib_per_chip: int
     max_chips: int            # largest slice
+    hbm_gbps_per_chip: float = 0.0  # peak HBM bandwidth GB/s (roofline denominators)
 
     def topologies(self) -> List["SliceTopology"]:
         return [t for t in _KNOWN_TOPOLOGIES.get(self.generation, [])]
@@ -43,10 +44,10 @@ class AcceleratorType:
 ACCELERATORS: Dict[str, AcceleratorType] = {
     a.generation: a
     for a in [
-        AcceleratorType("v4", "tpu-v4-podslice", 3, 4, 275.0, 32, 4096),
-        AcceleratorType("v5e", "tpu-v5-lite-podslice", 2, 4, 197.0, 16, 256),
-        AcceleratorType("v5p", "tpu-v5p-slice", 3, 4, 459.0, 95, 8960),
-        AcceleratorType("v6e", "tpu-v6e-slice", 2, 4, 918.0, 32, 256),
+        AcceleratorType("v4", "tpu-v4-podslice", 3, 4, 275.0, 32, 4096, 1228.0),
+        AcceleratorType("v5e", "tpu-v5-lite-podslice", 2, 4, 197.0, 16, 256, 819.0),
+        AcceleratorType("v5p", "tpu-v5p-slice", 3, 4, 459.0, 95, 8960, 2765.0),
+        AcceleratorType("v6e", "tpu-v6e-slice", 2, 4, 918.0, 32, 256, 1640.0),
     ]
 }
 

@@ -20,4 +20,19 @@ from kubeflow_tpu.training.classifier import (  # noqa: F401
     TrainState,
     cross_entropy_loss,
 )
-from kubeflow_tpu.training.flops import compiled_flops, compiled_with_cost, mfu  # noqa: F401
+from kubeflow_tpu.training.flops import (  # noqa: F401
+    compiled_flops,
+    compiled_with_cost,
+    memory_stats,
+    mfu,
+    peak_hbm_bandwidth,
+)
+from kubeflow_tpu.training.attribution import (  # noqa: F401
+    AttributionReport,
+    ModuleCost,
+    attribute_gpt,
+    attribute_resnet,
+    attribution_report,
+    price_callable,
+    record_step_peak_hbm,
+)

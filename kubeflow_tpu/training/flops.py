@@ -51,8 +51,37 @@ def compiled_with_cost(
     return compiled, _flops_of(compiled), compile_s
 
 
+def memory_stats(compiled: Any) -> Optional[dict]:
+    """HBM footprint of a compiled executable, from the compiler's
+    ``memory_analysis`` (the honest counterpart to cost-analysis FLOPs):
+    argument/output/temp bytes plus their sum as ``peak_hbm_bytes`` — the
+    live-bytes bound the executable needs resident, the number the
+    ``training_step_peak_hbm_bytes`` gauge and bench rows report. Returns
+    None when the backend doesn't implement the analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "generated_code_size_in_bytes")
+    try:
+        out = {f.replace("_size_in_bytes", "_bytes"): int(getattr(ma, f))
+               for f in fields}
+    except (AttributeError, TypeError):
+        return None
+    out["peak_hbm_bytes"] = sum(out.values())
+    return out
+
+
 def peak_flops_per_chip(generation: str = "v5e") -> float:
     return ACCELERATORS[generation].bf16_tflops_per_chip * 1e12
+
+
+def peak_hbm_bandwidth(generation: str = "v5e") -> float:
+    """Peak HBM bytes/second per chip — the roofline's memory ceiling."""
+    return ACCELERATORS[generation].hbm_gbps_per_chip * 1e9
 
 
 def mfu(

@@ -13,7 +13,7 @@ from pathlib import Path
 import pytest
 
 ROOT = Path(__file__).resolve().parent.parent
-SCOPES = ["kubeflow_tpu", "e2e", "ci", "bench.py", "__graft_entry__.py"]
+SCOPES = ["kubeflow_tpu", "e2e", "ci", "tools", "bench.py", "__graft_entry__.py"]
 
 
 def python_sources():

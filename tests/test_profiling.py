@@ -1,0 +1,238 @@
+"""tpu/profiling.py: profiler-server wiring, trace capture helpers, and the
+StepClock timeline (phase-event retention, Chrome-trace export, the
+/debug/profile source)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.tpu import profiling
+from kubeflow_tpu.tpu.profiling import (
+    StepClock,
+    annotate,
+    profile_step,
+    register_profile_clock,
+    start_profile_server,
+    step_trace,
+)
+
+
+# -- profiler server ----------------------------------------------------------
+
+class TestProfileServer:
+    @pytest.fixture(autouse=True)
+    def _fresh_server_state(self, monkeypatch):
+        # the real jax.profiler.start_server binds a gRPC port for the
+        # process's lifetime — spy it out so tests stay hermetic
+        self.calls = []
+        monkeypatch.setattr(jax.profiler, "start_server",
+                            lambda port: self.calls.append(port))
+        monkeypatch.setattr(profiling, "_server_started_port", None)
+
+    def test_starts_once_and_is_idempotent(self):
+        assert start_profile_server(9876) == 9876
+        assert start_profile_server(9876) == 9876
+        assert self.calls == [9876], "second call must not start a second server"
+
+    def test_conflicting_port_is_an_error(self):
+        start_profile_server(9876)
+        with pytest.raises(RuntimeError, match="already on port 9876"):
+            start_profile_server(9877)
+        assert self.calls == [9876]
+
+
+# -- trace capture helpers on CPU ---------------------------------------------
+
+def test_step_trace_and_annotate_run_on_cpu(tmp_path):
+    # the helpers must be safe to leave in code that also runs off-TPU
+    with step_trace(str(tmp_path), name="unit"):
+        with annotate("inner"):
+            x = jnp.arange(8).sum()
+            jax.block_until_ready(x)
+
+
+def test_annotate_is_reentrant():
+    with annotate("outer"):
+        with annotate("inner"):
+            pass
+
+
+def test_profile_step_returns_result_and_trace_files(tmp_path):
+    doubled = jax.jit(lambda x: x * 2)
+    out = profile_step(doubled, jnp.arange(4), logdir=str(tmp_path), iters=2)
+    assert jnp.array_equal(out["result"], jnp.arange(4) * 2)
+    assert isinstance(out["trace_files"], list)
+    for path in out["trace_files"]:
+        assert path.endswith(".xplane.pb")
+
+
+# -- StepClock: phase events survive compile()/mark() -------------------------
+
+class TestStepClockEventRetention:
+    def test_compile_preserves_earlier_phase_events(self):
+        # regression: compile() used to clear the phase-event list, so a
+        # data_wait timed before a mid-loop recompile vanished from the step
+        clock = StepClock()
+        with clock.data_wait():
+            time.sleep(0.001)
+        with clock.compile():
+            time.sleep(0.001)
+        with clock.compute():
+            time.sleep(0.001)
+        rec = clock.end_step()
+        names = [e["name"] for e in clock._step_records[-1]["phases"]]
+        assert names == ["data_wait", "compute"]
+        assert rec["data_wait"] > 0 and rec["compute"] > 0
+
+    def test_mark_preserves_earlier_phase_events(self):
+        clock = StepClock()
+        with clock.data_wait():
+            time.sleep(0.001)
+        clock.mark()
+        with clock.compute():
+            time.sleep(0.001)
+        clock.end_step()
+        names = [e["name"] for e in clock._step_records[-1]["phases"]]
+        assert names == ["data_wait", "compute"]
+
+    def test_events_do_not_leak_across_steps(self):
+        clock = StepClock()
+        with clock.compute():
+            pass
+        clock.end_step()
+        with clock.fetch():
+            pass
+        clock.end_step()
+        assert [e["name"] for e in clock._step_records[-1]["phases"]] == ["fetch"]
+
+    def test_step_phase_gauges_land_in_the_registry(self):
+        from kubeflow_tpu.runtime.metrics import METRICS
+
+        clock = StepClock(metrics=METRICS.namespace("train"))
+        with clock.compute():
+            time.sleep(0.001)
+        clock.end_step()
+        text = METRICS.render()
+        assert "train_step_phase_seconds" in text
+        assert 'phase="compute"' in text and 'phase="total"' in text
+
+
+# -- Chrome-trace export ------------------------------------------------------
+
+def _run_steps(clock: StepClock, n: int) -> None:
+    for _ in range(n):
+        with clock.data_wait():
+            time.sleep(0.001)
+        with clock.compute():
+            time.sleep(0.001)
+        clock.end_step()
+
+
+class TestChromeTrace:
+    def test_document_shape_and_json_roundtrip(self):
+        clock = StepClock()
+        _run_steps(clock, 3)
+        doc = json.loads(json.dumps(clock.to_chrome_trace()))
+        assert doc["displayTimeUnit"] == "ms"
+        complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len([e for e in complete if e["cat"] == "step"]) == 3
+        for e in complete:
+            assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+            assert e["dur"] >= 0
+
+    def test_steps_limit_takes_the_tail(self):
+        clock = StepClock()
+        _run_steps(clock, 4)
+        doc = clock.to_chrome_trace(steps=2)
+        steps = [e for e in doc["traceEvents"] if e["cat"] == "step"]
+        assert [e["args"]["step"] for e in steps] == [3, 4]
+
+    def test_phase_events_cover_every_step(self):
+        clock = StepClock()
+        _run_steps(clock, 2)
+        phases = [e for e in clock.to_chrome_trace()["traceEvents"]
+                  if e["cat"] == "phase"]
+        for name in ("data_wait", "compute"):
+            assert sum(1 for e in phases if e["name"] == name) == 2
+
+    def test_retention_is_bounded(self):
+        clock = StepClock(keep_steps=2)
+        _run_steps(clock, 5)
+        assert len(clock._step_records) == 2
+        assert len(clock.steps) == 5, "summary history is not truncated"
+
+    def test_tracer_chrome_export_includes_step_spans(self):
+        from kubeflow_tpu.runtime.tracing import Tracer
+
+        tracer = Tracer(service="unit")
+        clock = StepClock(tracer=tracer)
+        _run_steps(clock, 2)
+        doc = tracer.to_chrome_trace(name="train.step")
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == 2
+        instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert {e["name"] for e in instants} == {"data_wait", "compute"}
+
+
+# -- /debug/profile source ----------------------------------------------------
+
+class _Req:
+    def __init__(self, **query):
+        self.query = query
+
+    def query1(self, name, default=""):
+        return self.query.get(name, default)
+
+
+class TestProfileDebugSource:
+    @pytest.fixture(autouse=True)
+    def _own_clock(self):
+        self.clock = register_profile_clock(StepClock(), name="unit")
+        yield
+        profiling._PROFILE_CLOCKS.pop("unit", None)
+
+    def test_snapshot_returns_selected_clock(self):
+        _run_steps(self.clock, 3)
+        doc = profiling._profile_debug_source(_Req(clock="unit", steps="2"))
+        steps = [e for e in doc["traceEvents"] if e["cat"] == "step"]
+        assert len(steps) == 2
+        assert doc["displayTimeUnit"] == "ms"
+
+    def test_unknown_clock_404s(self):
+        from kubeflow_tpu.web.http import HttpError
+
+        with pytest.raises(HttpError) as err:
+            profiling._profile_debug_source(_Req(clock="nope"))
+        assert err.value.status == 404
+
+    def test_bad_steps_400s(self):
+        from kubeflow_tpu.web.http import HttpError
+
+        with pytest.raises(HttpError) as err:
+            profiling._profile_debug_source(_Req(steps="many"))
+        assert err.value.status == 400
+
+    def test_on_demand_capture_waits_for_fresh_steps(self):
+        import threading
+
+        _run_steps(self.clock, 1)  # stale step that must NOT satisfy the wait
+        box = {}
+
+        def capture():
+            box["doc"] = profiling._profile_debug_source(
+                _Req(clock="unit", steps="2", timeout="10"))
+
+        t = threading.Thread(target=capture)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive(), "capture returned before fresh steps existed"
+        _run_steps(self.clock, 2)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        steps = [e for e in box["doc"]["traceEvents"] if e["cat"] == "step"]
+        assert [e["args"]["step"] for e in steps] == [2, 3]

@@ -1,0 +1,242 @@
+"""Bench regression gate: compare the newest committed bench round against
+the best round before it, per metric, with direction- and noise-aware
+tolerances.
+
+The repo commits its bench history as ``BENCH_r*.json`` / ``MULTICHIP_r*.json``
+(one file per round: the driver's command, exit code, stdout tail of JSON
+metric lines, and the parsed summary line). Until now nothing *read* that
+history — the r04→r05 serving decode drop (2605→2309 tok/s, −11.4%) and the
+BERT HTTP p50 drift (96.1→105.1 ms, +9.4%) landed silently. This gate makes
+the history load-bearing:
+
+    python tools/bench_gate.py                  # gate HEAD's history
+    python tools/bench_gate.py --exclude r05    # what would r04 have said?
+    python tools/bench_gate.py --waive serving_bert_p50_ms_b8@r05 ...
+
+Verdicts per metric: ``OK`` (within tolerance of the best earlier round),
+``IMPROVED`` (new best), ``BASELINE`` (first round carrying the metric),
+``WAIVED`` (explicitly acknowledged regression — a ROADMAP item, not an
+accident), ``FAIL``. Any FAIL exits non-zero with a human-readable table.
+
+Tolerances are per-metric, calibrated from the committed history's own
+round-to-round noise: single-chip training MFU wobbles ~±8% across driver
+runs (r01-r04 band), HPO trials/hour depends on early-stopping luck (±15%),
+serving microbenches repeat within a couple percent (tight 5%).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+#: metric -> (direction, relative tolerance). Direction "higher" = bigger is
+#: better; "lower" = latency-like. Anything not listed falls back to
+#: _default_spec's name heuristic.
+SPECS: Dict[str, Tuple[str, float]] = {
+    "resnet50_train_mfu": ("higher", 0.10),
+    "images_per_sec_per_chip": ("higher", 0.10),
+    "gpt2_medium_train_mfu": ("higher", 0.05),
+    "gpt2_medium_mfu_pct": ("higher", 0.05),
+    "gpt2_medium_tokens_per_sec": ("higher", 0.05),
+    "serving_gpt_kv_decode_tokens_per_sec_b8": ("higher", 0.05),
+    "serving_decode_tokens_per_sec_b8": ("higher", 0.05),
+    "serving_bert_p50_ms_b8": ("lower", 0.05),
+    "hpo_trials_per_hour": ("higher", 0.15),
+    "hpo_mnist_trials_per_hour": ("higher", 0.15),
+    "multichip_tokens_per_sec_per_chip": ("higher", 0.10),
+    "multichip_composite_tokens_per_sec_per_chip": ("higher", 0.10),
+    "multichip_scaling_efficiency": ("higher", 0.10),
+}
+
+#: summary-line keys lifted into standalone metrics (the final bench line
+#: carries every flagship number; "value" itself arrives via metric/value)
+SUMMARY_KEYS = (
+    "images_per_sec_per_chip",
+    "gpt2_medium_mfu_pct",
+    "gpt2_medium_tokens_per_sec",
+    "serving_decode_tokens_per_sec_b8",
+    "serving_bert_p50_ms_b8",
+    "hpo_trials_per_hour",
+    "multichip_tokens_per_sec_per_chip",
+    "multichip_scaling_efficiency",
+)
+
+
+def _default_spec(name: str) -> Tuple[str, float]:
+    lower = any(t in name for t in ("_ms", "latency", "p50", "p99", "seconds", "bubble"))
+    return ("lower" if lower else "higher", 0.10)
+
+
+def spec_for(name: str) -> Tuple[str, float]:
+    return SPECS.get(name, _default_spec(name))
+
+
+def canon(metric: str) -> str:
+    """Strip per-run decorations so rounds compare: the generation/chip
+    suffix (``resnet50_train_mfu_v5e_1chip``) and the device count
+    (``..._tokens_per_sec_per_chip_8dev``)."""
+    metric = re.sub(r"_v\d+\w*_1chip$", "", metric)
+    metric = re.sub(r"_\d+dev$", "", metric)
+    return metric
+
+
+def extract_metrics(doc: dict) -> Dict[str, float]:
+    """One history file -> {metric: value}. Sources, in trust order: every
+    JSON line in the stdout tail with a ``metric``/``value`` pair (per-bench
+    rows; the first tail line may be truncated mid-object — skipped), then
+    the driver-parsed summary line, whose flagship keys are promoted to
+    standalone metrics. Error rows (bench crashed, value is a filler 0)
+    never count."""
+    rows: List[dict] = []
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        rows.append(parsed)
+
+    out: Dict[str, float] = {}
+    for row in rows:
+        if row.get("error") or row.get("errors"):
+            continue
+        name, value = row.get("metric"), row.get("value")
+        if isinstance(name, str) and isinstance(value, (int, float)):
+            out.setdefault(canon(name), float(value))
+    if isinstance(parsed, dict) and not (parsed.get("error") or parsed.get("errors")):
+        for key in SUMMARY_KEYS:
+            value = parsed.get(key)
+            if isinstance(value, (int, float)):
+                out.setdefault(key, float(value))
+    return out
+
+
+def load_history(history_dir: Path, exclude: List[str]) -> Dict[int, Dict[str, float]]:
+    """All rounds' metrics, keyed by round number, BENCH_* and MULTICHIP_*
+    files of the same round merged. ``exclude`` drops rounds by "rNN"."""
+    skip = {int(e.lstrip("rR")) for e in exclude}
+    rounds: Dict[int, Dict[str, float]] = {}
+    for path in sorted(history_dir.glob("*.json")):
+        m = re.fullmatch(r"(?:BENCH|MULTICHIP)_r(\d+)\.json", path.name)
+        if not m or int(m.group(1)) in skip:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (ValueError, OSError):
+            continue
+        rounds.setdefault(int(m.group(1)), {}).update(extract_metrics(doc))
+    return rounds
+
+
+def gate(rounds: Dict[int, Dict[str, float]],
+         waivers: Optional[List[str]] = None) -> Tuple[List[dict], int]:
+    """Newest round vs best-of-earlier, per metric. Returns (verdict rows,
+    exit code). ``waivers`` entries are ``metric@rNN``: that metric is
+    allowed to regress in that specific round (tracked regressions — the
+    waiver dies with the next round, so it can't hide a second slide)."""
+    if not rounds:
+        return [], 0
+    newest = max(rounds)
+    waived = set(waivers or [])
+    results: List[dict] = []
+    rc = 0
+    for metric, value in sorted(rounds[newest].items()):
+        direction, tol = spec_for(metric)
+        history = [(n, vals[metric]) for n, vals in sorted(rounds.items())
+                   if n < newest and metric in vals]
+        if not history:
+            results.append({"metric": metric, "round": newest, "value": value,
+                            "verdict": "BASELINE", "direction": direction,
+                            "tolerance": tol})
+            continue
+        if direction == "higher":
+            best_round, best = max(history, key=lambda t: t[1])
+            regressed = value < best * (1.0 - tol)
+            improved = value > best
+        else:
+            best_round, best = min(history, key=lambda t: t[1])
+            regressed = value > best * (1.0 + tol)
+            improved = value < best
+        delta = (value - best) / best if best else 0.0
+        verdict = "OK"
+        if improved:
+            verdict = "IMPROVED"
+        elif regressed:
+            verdict = "WAIVED" if f"{metric}@r{newest:02d}" in waived else "FAIL"
+        if verdict == "FAIL":
+            rc = 1
+        results.append({"metric": metric, "round": newest, "value": value,
+                        "best": best, "best_round": best_round,
+                        "delta_pct": round(delta * 100, 2),
+                        "direction": direction, "tolerance": tol,
+                        "verdict": verdict})
+    return results, rc
+
+
+def render(results: List[dict], newest: Optional[int]) -> str:
+    if not results:
+        return "bench gate: no bench history found — nothing to gate"
+    head = (f"{'metric':<44}{'value':>12}{'best':>12}{'best@':>7}"
+            f"{'delta':>9}{'tol':>7}  verdict")
+    lines = [f"bench gate: round r{newest:02d} vs best of earlier rounds",
+             head, "-" * len(head)]
+    for r in results:
+        if r["verdict"] == "BASELINE":
+            lines.append(f"{r['metric']:<44}{r['value']:>12.2f}{'—':>12}{'—':>7}"
+                         f"{'—':>9}{r['tolerance']:>7.0%}  BASELINE (first round"
+                         " with this metric)")
+            continue
+        arrow = "+" if r["delta_pct"] >= 0 else ""
+        lines.append(
+            f"{r['metric']:<44}{r['value']:>12.2f}{r['best']:>12.2f}"
+            f"{'r%02d' % r['best_round']:>7}{arrow}{r['delta_pct']:>7.2f}%"
+            f"{r['tolerance']:>7.0%}  {r['verdict']}")
+    fails = [r["metric"] for r in results if r["verdict"] == "FAIL"]
+    if fails:
+        lines.append("")
+        lines.append(f"REGRESSION: {len(fails)} metric(s) past tolerance: "
+                     + ", ".join(fails))
+        lines.append("(fix it, or record it deliberately: "
+                     "--waive <metric>@r{:02d} + a ROADMAP note)".format(
+                         results[0]["round"]))
+    else:
+        lines.append("")
+        lines.append("gate PASSED: no metric regressed past tolerance")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history-dir", default=".",
+                    help="directory holding BENCH_r*.json / MULTICHIP_r*.json")
+    ap.add_argument("--exclude", action="append", default=[], metavar="rNN",
+                    help="drop a round from history (repeatable)")
+    ap.add_argument("--waive", action="append", default=[], metavar="METRIC@rNN",
+                    help="allow a named metric to regress in a named round")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable verdicts instead of the table")
+    args = ap.parse_args(argv)
+
+    rounds = load_history(Path(args.history_dir), args.exclude)
+    results, rc = gate(rounds, args.waive)
+    newest = max(rounds) if rounds else None
+    if args.as_json:
+        print(json.dumps({"round": newest, "results": results,
+                          "exit_code": rc}, indent=2))
+    else:
+        print(render(results, newest))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
