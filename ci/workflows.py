@@ -160,6 +160,22 @@ def serving_fleet_e2e() -> Dict:
     return b.build()
 
 
+def serving_overload_e2e() -> Dict:
+    """The serving overload-protection job: a 3-replica fleet over real
+    HTTP flooded past saturation with mixed-priority traffic while chaos
+    slows one replica — batch sheds (503 + Retry-After) while interactive
+    stays admitted, queued deadline expiries 504 fast, abandoned and
+    expired slots are reclaimed, and the slowed replica's breaker opens
+    and re-closes (e2e/overload_driver.py asserts all of it), plus the
+    deadline / priority / breaker / retry-budget / chaos unit suite."""
+    b = WorkflowBuilder("serving-overload-e2e")
+    b.run("overload-shed-breaker", ["python", "-m", "e2e.overload_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("overload-unit", "tests/test_overload.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 def elastic_e2e() -> Dict:
     """The elastic-training job: the chaos dryrun — an ElasticTrainer on
     the 8-virtual-device topology surviving an organic scheduler drain plus
@@ -219,6 +235,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "observability-e2e": observability_e2e,
     "control-plane-e2e": control_plane_e2e,
     "serving-fleet-e2e": serving_fleet_e2e,
+    "serving-overload-e2e": serving_overload_e2e,
     "elastic-e2e": elastic_e2e,
     "bench-regression": bench_regression,
     "attribution-e2e": attribution_e2e,

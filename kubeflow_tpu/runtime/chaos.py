@@ -18,6 +18,20 @@ a TPU pool, driven by a seeded schedule so CI runs are reproducible:
 - ``delay_apiserver``     — hold the store's global lock for N seconds so
   every API call in the process stalls (etcd brown-out).
 
+ISSUE 9 extends the harness to the SERVING fleet (pass ``fleet=``, an
+EngineFleet or anything exposing ``live_handles()``):
+
+- ``slow_replica``            — add ``param`` seconds of latency to every
+  engine iteration of one replica for ``duration`` seconds (a thermally
+  throttled / noisy-neighbor chip): deadlines expire, the fleet breaker
+  opens;
+- ``crash_replica_mid_decode`` — poison one replica's next engine
+  iteration so it dies exactly like a device/RPC failure, in-flight
+  futures failing with ``EngineClosed``;
+- ``client_abandon``          — cancel up to ``param`` in-flight/queued
+  requests on a replica (clients disconnecting mid-generation); the
+  engine must reap the slots.
+
 Every firing bumps ``chaos_faults_injected_total{kind}``.
 """
 
@@ -35,7 +49,8 @@ from .metrics import METRICS
 
 LOG = logging.getLogger(__name__)
 
-KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver")
+KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver",
+         "slow_replica", "crash_replica_mid_decode", "client_abandon")
 
 #: chaos components stamp Events under this source
 COMPONENT = "chaos-monkey"
@@ -45,12 +60,16 @@ COMPONENT = "chaos-monkey"
 class Fault:
     """One scheduled failure: fire ``kind`` against ``target`` at ``at``
     seconds after the monkey starts. ``param`` is kind-specific: drain
-    grace seconds for preempt_gang, stall seconds for delay_apiserver."""
+    grace seconds for preempt_gang, stall seconds for delay_apiserver,
+    per-iteration delay seconds for slow_replica, request count for
+    client_abandon. ``duration`` bounds how long a persistent fault
+    (slow_replica) stays applied; 0 = until the monkey stops."""
 
     at: float
     kind: str
-    target: Optional[str] = None  # node name | "ns/gang" | informer kind
+    target: Optional[str] = None  # node | "ns/gang" | informer kind | replica
     param: float = 0.0
+    duration: float = 0.0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -110,13 +129,20 @@ class ChaosMonkey:
         *,
         store=None,
         informers: Sequence[Any] = (),
+        fleet: Any = None,
     ) -> None:
         self._client = client
         self._schedule = schedule
         self._store = store
         self._informers = list(informers)
+        #: EngineFleet (or anything with ``live_handles()``) — the target
+        #: set for the serving fault kinds
+        self._fleet = fleet
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        #: engines slowed by slow_replica, reset on stop() so a finished
+        #: chaos run never leaves a replica degraded
+        self._slowed: List[Any] = []
         self.fired: List[Fault] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -128,6 +154,8 @@ class ChaosMonkey:
 
     def stop(self) -> None:
         self._stop.set()
+        for eng in self._slowed:
+            eng.step_delay_s = 0.0
         for t in self._threads:
             t.join(timeout=5.0)
 
@@ -265,3 +293,65 @@ class ChaosMonkey:
         t = threading.Thread(target=hold, name="chaos-apiserver-delay", daemon=True)
         self._threads.append(t)
         t.start()
+
+    # -- serving injectors ---------------------------------------------------
+    def _find_replica(self, target: Optional[str]):
+        """Resolve ``target`` against the fleet's live replicas by gauge id
+        or replica id; None picks the first live replica."""
+        if self._fleet is None:
+            raise RuntimeError("serving faults need a fleet")
+        handles = list(self._fleet.live_handles())
+        if not handles:
+            raise RuntimeError("no live replica to target")
+        if target is None:
+            return handles[0]
+        for h in handles:
+            if target in (getattr(h, "gauge_id", None), getattr(h, "id", None)):
+                return h
+        raise RuntimeError(f"no live replica matches {target!r}")
+
+    def _slow_replica(self, fault: Fault) -> None:
+        """Thermal throttle / noisy neighbor: every engine iteration on the
+        replica gains ``param`` seconds. Deadlines expire, the fleet marks
+        the replica failing, its breaker opens; after ``duration`` seconds
+        (or stop()) the replica recovers and the breaker re-closes."""
+        eng = self._find_replica(fault.target).engine
+        eng.step_delay_s = max(0.0, fault.param)
+        self._slowed.append(eng)
+        if fault.duration > 0:
+
+            def recover():
+                self._stop.wait(fault.duration)
+                eng.step_delay_s = 0.0
+
+            t = threading.Thread(target=recover, name="chaos-slow-recover", daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _crash_replica_mid_decode(self, fault: Fault) -> None:
+        """Poison the replica's next engine iteration: it raises mid-decode
+        exactly like a device/RPC failure, the engine shuts down, and every
+        in-flight future fails with EngineClosed."""
+        self._find_replica(fault.target).engine.fail_next_step = True
+
+    def _client_abandon(self, fault: Fault) -> None:
+        """Clients disconnect mid-generation: cancel up to ``param``
+        in-flight/queued requests on the target replica (all replicas if
+        the target has none). The engine must reap the freed slots."""
+        want = max(1, int(fault.param or 1))
+        if self._fleet is None:
+            raise RuntimeError("serving faults need a fleet")
+        handles = list(self._fleet.live_handles())
+        if fault.target is not None:
+            handles = [self._find_replica(fault.target)] + [
+                h for h in handles
+                if fault.target not in (getattr(h, "gauge_id", None),
+                                        getattr(h, "id", None))
+            ]
+        cancelled = 0
+        for h in handles:
+            cancelled += h.engine.cancel_requests(want - cancelled)
+            if cancelled >= want:
+                break
+        if cancelled == 0:
+            raise RuntimeError("no in-flight request to abandon")

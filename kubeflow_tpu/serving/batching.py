@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence
 
 from ..runtime.metrics import METRICS
+from .errors import DeadlineExceeded
 
 
 class BatcherClosed(RuntimeError):
@@ -51,6 +52,7 @@ class _Pending:
     error: Optional[BaseException] = None
     waited: bool = False  # sat through a full coalescing window already
     enqueued_at: float = field(default_factory=time.perf_counter)
+    deadline: Optional[float] = None  # absolute time.monotonic(); None = none
 
 
 class DynamicBatcher:
@@ -98,7 +100,12 @@ class DynamicBatcher:
             return None
         return arr.shape[1:], str(arr.dtype)
 
-    def predict(self, instances: Sequence[Any]) -> List[Any]:
+    def predict(self, instances: Sequence[Any],
+                deadline: Optional[float] = None) -> List[Any]:
+        """``deadline`` (absolute ``time.monotonic()``): an expired pending
+        is shed from the queue without ever joining a forward, and the
+        caller's wait is bounded by the deadline instead of being
+        indefinite."""
         if len(instances) >= self.max_batch:
             # Oversized requests run alone — no point queueing behind them
             # (and no point paying for a signature they won't use).
@@ -107,13 +114,20 @@ class DynamicBatcher:
         if sig is None:
             # Unsignaturable (object-dtype) requests also run alone.
             return self.predict_fn(instances)
-        pending = _Pending(instances, sig)
+        pending = _Pending(instances, sig, deadline=deadline)
         with self._lock:
             if self._closed:
                 raise BatcherClosed("batcher closed")
             self._queue.append(pending)
             self._lock.notify()
-        pending.done.wait()
+        timeout = None
+        if deadline is not None:
+            # grace past the deadline: an in-forward batch finishes and
+            # returns real results rather than racing the shed
+            timeout = max(0.0, deadline - time.monotonic()) + 1.0
+        if not pending.done.wait(timeout):
+            raise DeadlineExceeded("request missed its deadline in the "
+                                   "batching queue")
         if pending.error is not None:
             raise pending.error
         return pending.result  # type: ignore[return-value]
@@ -157,12 +171,32 @@ class DynamicBatcher:
                 p.done.set()
 
     # -- worker side ---------------------------------------------------------
+    def _shed_expired_locked(self) -> None:
+        """Fail queued pendings whose deadline passed — they never join a
+        forward (fail fast, keep the batch for live requests). Caller
+        holds the lock."""
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in self._queue:
+            if p.deadline is not None and now >= p.deadline:
+                METRICS.counter("serving_deadline_expired_total",
+                                stage="queued").inc()
+                p.error = DeadlineExceeded(
+                    "deadline expired while queued for batching")
+                p.done.set()
+            else:
+                live.append(p)
+        self._queue = live
+
     def _take_batch(self) -> List[_Pending]:
         with self._lock:
-            while not self._queue and not self._closed:
+            while True:
+                self._shed_expired_locked()
+                if self._queue:
+                    break
+                if self._closed:
+                    return []
                 self._lock.wait()
-            if self._closed and not self._queue:
-                return []
             # A head pending that already sat through a full window (left
             # over from a mixed-shape round) serves immediately; fresh
             # arrivals get the normal coalescing window.

@@ -39,7 +39,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +48,11 @@ import numpy as np
 from ..models.gpt import GptConfig, GptLM
 from ..runtime.metrics import METRICS
 from ..runtime.tracing import TRACER, Span
+from .errors import (DeadlineExceeded, EngineClosed, FleetSaturated,
+                     RequestCancelled)
+
+#: admission priority classes; batch is shed first under saturation
+PRIORITIES = ("interactive", "batch")
 
 #: prompt-length buckets — one prefill compilation each (static shapes)
 PREFILL_BUCKETS = (16, 32, 64, 128, 256)
@@ -87,7 +92,7 @@ def _bucket_for(n: int) -> int:
     raise ValueError(f"prompt length {n} exceeds the largest prefill bucket")
 
 
-@dataclass
+@dataclass(eq=False)  # identity equality: field eq would compare ndarrays
 class _Request:
     prompt: np.ndarray  # [prompt_len] int32
     max_new_tokens: int
@@ -97,6 +102,18 @@ class _Request:
     eos_id: Optional[int] = None
     temperature: float = 0.0  # 0 = greedy; >0 samples with a per-slot key
     done_at: Optional[float] = None  # perf_counter at retirement (latency acct)
+    # overload-protection state (ISSUE 9):
+    deadline: Optional[float] = None  # absolute time.monotonic(); None = no deadline
+    priority: str = "interactive"     # "interactive" | "batch"
+    cancel_requested: bool = False    # client abandoned; worker reaps the slot
+    #: how the request ended: "ok" (budget/EOS), "deadline" (expired
+    #: mid-decode, partial tokens), "cancelled" (abandoned mid-decode),
+    #: "error" (failed) — the fleet's breaker feedback keys off this
+    finish_reason: Optional[str] = None
+    #: fired exactly once when ``done`` is set, from whichever thread
+    #: finished the request — the fleet hangs replica-outcome accounting
+    #: (circuit breakers) here
+    on_done: Optional[Callable[["_Request"], None]] = None
     # observability (None on internal requests, e.g. prewarm's dummies):
     # one span covers submit()→_retire(), crossing the caller thread into
     # the engine worker — hence start_span/end_span, not the contextmanager
@@ -111,6 +128,34 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.tokens
+
+    def remaining(self, default: Optional[float] = None) -> Optional[float]:
+        """Seconds until the deadline (negative once past); ``default``
+        when no deadline is set."""
+        if self.deadline is None:
+            return default
+        return self.deadline - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() >= self.deadline
+
+    def cancel(self) -> bool:
+        """Abandon the request (client disconnect / explicit cancel). A
+        queued request fails fast with :class:`RequestCancelled`; an
+        in-flight one frees its slot within ~one decode chunk and
+        completes with the partial tokens. False if already finished."""
+        if self.done.is_set():
+            return False
+        self.cancel_requested = True
+        return True
+
+    def _notify(self) -> None:
+        cb, self.on_done = self.on_done, None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
 
 
 def _ev(req: _Request, name: str, **attrs: Any) -> None:
@@ -127,10 +172,13 @@ def _fail(req: _Request, error: BaseException) -> None:
     branch that drops a request (bad bucket, prefill/adopt failure,
     shutdown) must leave its trace ERROR-terminated, not dangling."""
     req.error = error
+    if req.finish_reason is None:
+        req.finish_reason = "error"
     if req.span is not None:
         TRACER.end_span(req.span, error=error)
         req.span = None
     req.done.set()
+    req._notify()
 
 
 class ContinuousBatcher:
@@ -169,7 +217,9 @@ class ContinuousBatcher:
     def __init__(self, cfg: GptConfig, params: Any, slots: int = 8,
                  chunk: int = 16, pipeline: int = 3,
                  kv_kernel: Optional[bool] = None,
-                 engine_id: str = "0"):
+                 engine_id: str = "0",
+                 max_pending: int = 0,
+                 interactive_reserve: float = 0.25):
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -179,6 +229,17 @@ class ContinuousBatcher:
         self.engine_id = str(engine_id)
         self.chunk = max(1, int(chunk))
         self.pipeline = max(1, int(pipeline))
+        # admission-queue cap (0 = unbounded): when the queue is full,
+        # batch requests shed at (1 - interactive_reserve) * max_pending
+        # while interactive keeps the full depth — a batch flood cannot
+        # starve interactive admission (ISSUE 9)
+        self.max_pending = max(0, int(max_pending))
+        self.interactive_reserve = min(max(float(interactive_reserve), 0.0), 1.0)
+        #: chaos hooks (runtime/chaos.py slow_replica /
+        #: crash_replica_mid_decode): added latency per engine iteration,
+        #: and a one-shot poison that fails the next iteration
+        self.step_delay_s = 0.0
+        self.fail_next_step = False
         # fixed admission-group pad: one prefill program + one zero
         # template per prompt bucket; waves larger than this are chunked
         self._group_pad = min(slots, MAX_GROUP)
@@ -361,41 +422,91 @@ class ContinuousBatcher:
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_id: Optional[int] = None,
                temperature: float = 0.0,
-               traceparent: Optional[str] = None) -> _Request:
+               traceparent: Optional[str] = None,
+               deadline: Optional[float] = None,
+               priority: str = "interactive",
+               on_done: Optional[Callable[[_Request], None]] = None) -> _Request:
         """``traceparent`` (W3C header value) parents the request's span to
         the caller's trace — the HTTP predict handler passes its own so a
-        scraped trace shows the handler as root over submit→retire."""
+        scraped trace shows the handler as root over submit→retire.
+
+        ``deadline`` is an ABSOLUTE ``time.monotonic()`` instant: a request
+        whose deadline passes while queued fails fast with
+        :class:`DeadlineExceeded` (never occupies a slot); one that expires
+        mid-decode frees its slot within ~one decode chunk and completes
+        with the partial tokens. An already-expired deadline fails the
+        returned future immediately — no exception from submit itself, so
+        the fleet's retry path can't mistake it for a dead replica."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority {priority!r}; expected one of {PRIORITIES}")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + budget exceeds max_seq")
         req = _Request(prompt, max_new_tokens, eos_id=eos_id,
-                       temperature=float(temperature))
+                       temperature=float(temperature),
+                       deadline=deadline, priority=priority, on_done=on_done)
         req.span = TRACER.start_span(
             "serving.request", traceparent=traceparent,
             **{"prompt_tokens": int(len(prompt)),
-               "max_new_tokens": int(max_new_tokens)})
+               "max_new_tokens": int(max_new_tokens),
+               "priority": priority})
         req.submit_at = time.perf_counter()
         _ev(req, "enqueued")
         METRICS.counter("serving_tokens_in_total").inc(len(prompt))
+        if req.expired():  # dead on arrival: shed before it costs anything
+            METRICS.counter("serving_deadline_expired_total",
+                            stage="queued").inc()
+            _ev(req, "deadline_expired", stage="queued")
+            req.finish_reason = "deadline"
+            # pre-admission expiry says nothing about THIS replica's health:
+            # suppress the fleet's breaker callback
+            req.on_done = None
+            _fail(req, DeadlineExceeded("deadline already expired at submit"))
+            return req
         # closed-check and enqueue under one lock: a put racing close()
         # could otherwise land AFTER the shutdown sentinel and hang its
         # caller forever (the worker stops at the sentinel)
         with self._lock:
             if self._closed:
-                _fail(req, RuntimeError("batcher closed"))
-                raise RuntimeError("batcher closed")
+                _fail(req, EngineClosed("batcher closed"))
+                raise EngineClosed("batcher closed")
             self._queue.put([req])
         return req
 
+    def cancel_requests(self, n: int = 1) -> int:
+        """Abandon up to ``n`` in-flight or queued requests (the chaos
+        harness's client-disconnect simulation; also the ops hook for
+        evicting stuck work). Returns how many were marked — the worker
+        reaps each within ~one decode chunk."""
+        marked = 0
+        for _ in range(3):
+            try:
+                reqs = list(self._active.values()) + list(self._pending)
+                break
+            except RuntimeError:
+                continue  # worker resized a container mid-copy; retry
+        else:
+            return 0
+        for req in reqs:
+            if marked >= n:
+                break
+            if req.cancel():
+                marked += 1
+        return marked
+
     def prewarm(self, prompt_len: int,
-                group_sizes: Optional[Sequence[int]] = None) -> None:
+                group_sizes: Optional[Sequence[int]] = None,
+                timeout: float = 600.0) -> None:
         """Compile the engine's programs outside any latency-sensitive
         window: for each admission-group size, a wave of dummy requests is
         pushed as ONE queue item so the worker admits them together —
         exercising the (prompt-bucket, group-bucket) prefill, the exact-n
         adopt, and (for the largest wave) the chunked decode step, all
         through the production path. Compilations land in the persistent
-        JAX cache when one is configured."""
+        JAX cache when one is configured. ``timeout`` becomes each dummy
+        request's deadline, so a wedged compile surfaces as
+        :class:`DeadlineExceeded` instead of an 1800 s magic wait."""
+        deadline = time.monotonic() + timeout
         # default: EVERY group size 1.._group_pad — the adopt program is
         # traced per exact group size (admission chunks larger waves to
         # _group_pad), so a size first seen mid-run would compile inside
@@ -408,14 +519,18 @@ class ContinuousBatcher:
             # enqueued) so the worker sees exactly one n-sized admission —
             # concurrent waves would coalesce in the pending queue
             budget = self.chunk + 1 if idx == len(sizes) - 1 else 1
-            wave = [_Request(np.zeros((prompt_len,), np.int32), budget)
+            wave = [_Request(np.zeros((prompt_len,), np.int32), budget,
+                             deadline=deadline)
                     for _ in range(n)]
             with self._lock:
                 if self._closed:
-                    raise RuntimeError("batcher closed")
+                    raise EngineClosed("batcher closed")
                 self._queue.put(wave)
             for req in wave:
-                req.result(timeout=1800)
+                # the wait derives from the request's own deadline (plus a
+                # grace period for the worker to reap+fail it) — the worker
+                # raises DeadlineExceeded through result() at expiry
+                req.result(timeout=max(0.0, deadline - time.monotonic()) + 5.0)
 
     def close(self) -> None:
         with self._lock:
@@ -537,27 +652,124 @@ class ContinuousBatcher:
         req = self._active.pop(slot)
         self._free.append(slot)
         req.done_at = time.perf_counter()
+        if req.finish_reason is None:
+            req.finish_reason = "ok"
         if req.submit_at is not None:
             METRICS.histogram("serving_request_seconds").observe(
                 req.done_at - req.submit_at, trace_id=_trace_id(req))
         if req.span is not None:
             _ev(req, "retired", slot=slot)
             req.span.set("generated_tokens", len(req.tokens))
+            req.span.set("finish_reason", req.finish_reason)
             TRACER.end_span(req.span)
             req.span = None
         req.done.set()
+        req._notify()
         METRICS.counter("serving_continuous_requests_total").inc()
         self._set_occupancy()
+
+    def _set_queue_gauge(self) -> None:
+        # every _pending mutation must republish the depth: the router's
+        # least-loaded policy reads this gauge, and a stale value after a
+        # reap leaves a healthy replica advertising phantom load (so no
+        # breaker probe ever routes back to it)
+        METRICS.gauge("serving_queue_depth",
+                      replica=self.engine_id).set(len(self._pending))
+
+    def _reap_pending(self) -> None:
+        """Shed queued requests that will never need a slot: expired
+        deadlines fail fast with DeadlineExceeded, abandoned clients with
+        RequestCancelled — neither ever occupies a decode row."""
+        if not self._pending:
+            return
+        kept: "collections.deque[_Request]" = collections.deque()
+        for req in self._pending:
+            if req.cancel_requested:
+                METRICS.counter("serving_cancelled_total").inc()
+                _ev(req, "cancelled", stage="queued")
+                req.finish_reason = "cancelled"
+                _fail(req, RequestCancelled("cancelled while queued"))
+            elif req.expired():
+                METRICS.counter("serving_deadline_expired_total",
+                                stage="queued").inc()
+                _ev(req, "deadline_expired", stage="queued")
+                req.finish_reason = "deadline"
+                _fail(req, DeadlineExceeded(
+                    "deadline expired while queued (never admitted)"))
+            else:
+                kept.append(req)
+        self._pending = kept
+        self._set_queue_gauge()
+
+    def _reap_active(self) -> None:
+        """Free the slot of any in-flight request whose deadline expired
+        or whose future was abandoned — within ONE loop iteration (≤ one
+        decode chunk) of the event. The request completes with its partial
+        tokens (done, no error); tokens the pipeline already dispatched
+        for the row are counted as wasted when their events surface."""
+        for slot, req in list(self._active.items()):
+            if req.cancel_requested:
+                req.finish_reason = "cancelled"
+                METRICS.counter("serving_cancelled_total").inc()
+                _ev(req, "cancelled", stage="decoding",
+                    partial_tokens=len(req.tokens))
+                self._retire(slot)
+            elif req.expired():
+                req.finish_reason = "deadline"
+                METRICS.counter("serving_deadline_expired_total",
+                                stage="decoding").inc()
+                _ev(req, "deadline_expired", stage="decoding",
+                    partial_tokens=len(req.tokens))
+                self._retire(slot)
+
+    @property
+    def _batch_cap(self) -> int:
+        """Queue depth at which BATCH requests shed; interactive keeps the
+        full ``max_pending`` — the reserved fraction."""
+        return max(1, int(self.max_pending * (1.0 - self.interactive_reserve)))
+
+    def _enqueue_pendings(self, reqs: List[_Request]) -> None:
+        for req in reqs:
+            if self.max_pending:
+                depth = len(self._pending)
+                cap = (self._batch_cap if req.priority == "batch"
+                       else self.max_pending)
+                if depth >= cap:
+                    METRICS.counter("serving_shed_total",
+                                    priority=req.priority).inc()
+                    _ev(req, "shed", priority=req.priority, depth=depth)
+                    _fail(req, FleetSaturated(
+                        f"engine queue full ({depth} >= {cap} "
+                        f"for priority={req.priority})"))
+                    continue
+            self._pending.append(req)
+
+    def _next_wave(self, n: int) -> List[_Request]:
+        """Interactive-first admission: fill up to ``n`` free slots from
+        the interactive pendings before any batch request is considered,
+        so a batch backlog cannot starve interactive TTFT."""
+        if len(self._pending) <= n:
+            wave = list(self._pending)
+            self._pending.clear()
+            return wave
+        wave = [r for r in self._pending if r.priority != "batch"][:n]
+        if len(wave) < n:
+            wave.extend([r for r in self._pending
+                         if r.priority == "batch"][: n - len(wave)])
+        for r in wave:
+            self._pending.remove(r)
+        return wave
 
     def _shutdown(self, cause: str) -> None:
         """Fail everything in flight, pending, and still queued — all with
         the SAME cause, so a device failure is debuggable from any failed
         caller, not only the in-flight ones."""
         for req in self._active.values():
-            _fail(req, RuntimeError(cause))
+            _fail(req, EngineClosed(cause))
         self._active.clear()
         while self._pending:
-            _fail(self._pending.popleft(), RuntimeError(cause))
+            _fail(self._pending.popleft(), EngineClosed(cause))
+        self._set_queue_gauge()
         while True:
             try:
                 rest = self._queue.get_nowait()
@@ -565,7 +777,7 @@ class ContinuousBatcher:
                 return
             if rest is not None and rest is not _DRAIN:
                 for req in rest:
-                    _fail(req, RuntimeError(cause))
+                    _fail(req, EngineClosed(cause))
 
     def _process_event(self, event: Tuple[str, Any, Any, float]) -> None:
         """Consume one pipelined event in dispatch order. ``first``: fetch
@@ -579,6 +791,13 @@ class ContinuousBatcher:
         now = time.perf_counter()
         if kind == "first":
             for (req, slot), tok in zip(meta, block):
+                if req.done.is_set():
+                    # reaped (deadline/cancel) between admission and this
+                    # event — its prefill token was computed for nobody
+                    if req.finish_reason in ("deadline", "cancelled"):
+                        METRICS.counter(
+                            "serving_wasted_decode_tokens_total").inc()
+                    continue
                 req.tokens.append(int(tok))
                 req.first_token_at = req.last_token_at = now
                 METRICS.counter("serving_tokens_out_total").inc()
@@ -603,6 +822,11 @@ class ContinuousBatcher:
                 # computed for nobody — the engine's "preempted work" cost
                 METRICS.counter("serving_discarded_tail_tokens_total").inc(
                     block.shape[1])
+                if req.finish_reason in ("deadline", "cancelled"):
+                    # tokens generated past an expired deadline / abandoned
+                    # future — the goodput-loss counter (ISSUE 9)
+                    METRICS.counter("serving_wasted_decode_tokens_total").inc(
+                        block.shape[1])
                 continue
             appended = 0
             for j in range(block.shape[1]):
@@ -659,18 +883,32 @@ class ContinuousBatcher:
                         # part of the handoff set
                         self._draining = True
                     else:
-                        self._pending.extend(item)
+                        self._enqueue_pendings(item)
                     timeout = 0.0
             except queue.Empty:
                 pass
-            METRICS.gauge("serving_queue_depth",
-                          replica=self.engine_id).set(len(self._pending))
+            self._set_queue_gauge()
             try:
+                if self.fail_next_step:
+                    # chaos crash_replica_mid_decode: poison the iteration;
+                    # the handler below fails everything and closes the
+                    # engine, exactly like a real device/RPC death
+                    self.fail_next_step = False
+                    raise RuntimeError("chaos: replica crashed mid-decode")
+                if self.step_delay_s > 0:
+                    # chaos slow_replica: stall the dispatch loop so
+                    # deadlines expire and the fleet's breaker sees a
+                    # slow replica
+                    time.sleep(min(self.step_delay_s, 5.0))
+                # reap BEFORE admission: an expired queued request must
+                # never take a slot, and an expired/abandoned in-flight
+                # one frees its slot for this very wave
+                self._reap_pending()
+                self._reap_active()
                 dispatched = False
                 if self._free and self._pending and not self._draining:
-                    wave = [self._pending.popleft()
-                            for _ in range(min(len(self._free),
-                                               len(self._pending)))]
+                    wave = self._next_wave(len(self._free))
+                    self._set_queue_gauge()
                     events.extend(self._admit_wave(wave))
                     dispatched = True
                 if self._active:
@@ -701,8 +939,7 @@ class ContinuousBatcher:
                     # open) for the caller and zero this replica's gauges
                     self._handoff.extend(self._pending)
                     self._pending.clear()
-                    METRICS.gauge("serving_queue_depth",
-                                  replica=self.engine_id).set(0)
+                    self._set_queue_gauge()
                     self._set_occupancy()
                     return
             except Exception as e:

@@ -43,21 +43,132 @@ from typing import Any, Callable, Dict, List, Optional
 from ..runtime.metrics import METRICS
 from ..runtime.obs import register_debug_source
 from ..runtime.tracing import TRACER
-from .router import FleetSaturated, PrefixRouter
+from .errors import DeadlineExceeded, FleetSaturated
+from .router import PrefixRouter
 
 #: drain wall time is dominated by the slowest in-flight request — seconds
 #: scale, with headroom for a replica finishing a long budget
 DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                  120.0)
 
-#: how long a handoff bridge waits on the survivor before failing the
-#: original future (matches the HTTP layer's result() ceiling)
+#: how long a handoff bridge waits on the survivor when the request
+#: carries NO deadline (deadline-bearing requests wait out their own
+#: remaining budget instead)
 BRIDGE_TIMEOUT_S = 600.0
 
 #: ceiling for the pod watcher's crash-restart backoff
 WATCHER_BACKOFF_CAP_S = 5.0
 
+#: breaker gauge encoding for ``fleet_breaker_state{replica}``
+BREAKER_STATE_CODES = {"closed": 0, "open": 1, "half_open": 2}
+
 LOG = logging.getLogger(__name__)
+
+
+class ReplicaBreaker:
+    """Per-replica circuit breaker (closed → open → half_open → closed).
+
+    ``record_failure`` counts CONSECUTIVE bad outcomes (errors, deadline
+    expiries — a slow replica shows up as deadline expiries, so slowness
+    trips the breaker the same way crashes do); at ``failure_threshold``
+    the breaker opens and ``allow()`` refuses the replica for ``open_s``
+    seconds. The first ``allow()`` after that window admits exactly ONE
+    probe (half_open); the probe's outcome closes or re-opens it.
+    ``clock`` is injectable so tests drive the state machine without
+    sleeping.
+    """
+
+    def __init__(self, failure_threshold: int = 3, open_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.open_s = float(open_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        return BREAKER_STATE_CODES[self.state]
+
+    def allow(self) -> bool:
+        """May a request route to this replica right now? Transitions
+        open → half_open (admitting the single probe) once ``open_s`` has
+        elapsed; half_open refuses everything while the probe is out. A
+        probe whose outcome never arrives (the admitting caller routed
+        elsewhere, or the request vanished) is presumed lost after another
+        ``open_s`` and a fresh probe is admitted — the breaker must never
+        wedge half_open forever."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self.open_s:
+                    self._state = "half_open"
+                    self._probe_at = self._clock()
+                    return True  # this caller IS the probe
+                return False
+            # half_open: one probe at a time, re-issued if presumed lost
+            if self._clock() - self._probe_at >= self.open_s:
+                self._probe_at = self._clock()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._state = "closed"
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == "half_open":
+                # the probe failed — straight back to open, fresh window
+                self._state = "open"
+                self._opened_at = self._clock()
+                return
+            self._consecutive_failures += 1
+            if (self._state == "closed"
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._state = "open"
+                self._opened_at = self._clock()
+
+
+class RetryBudget:
+    """Token bucket bounding fleet-level retries: every first submission
+    deposits ``ratio`` tokens (capped), every retry withdraws one — so the
+    sustained retry rate can't exceed ``ratio`` × the request rate and a
+    sick fleet can't retry-storm itself into the ground. Starts full so a
+    cold fleet can still absorb its first hiccups."""
+
+    def __init__(self, ratio: float = 0.1, cap: float = 10.0):
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._tokens = float(cap)
+        self._lock = threading.Lock()
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self.cap, self._tokens + self.ratio)
+
+    def try_withdraw(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+        METRICS.counter("fleet_retry_budget_exhausted_total").inc()
+        return False
 
 
 @dataclass
@@ -74,6 +185,7 @@ class ReplicaHandle:
     pod_name: Optional[str] = None
     node: Optional[str] = None
     started_at: float = field(default_factory=time.monotonic)
+    breaker: ReplicaBreaker = field(default_factory=ReplicaBreaker)
 
 
 class EngineFleet:
@@ -96,8 +208,12 @@ class EngineFleet:
                  engine_factory: Optional[Callable[[str], Any]] = None,
                  client: Any = None, namespace: str = "default",
                  replica_chips: int = 0, priority_class: str = "default",
-                 poll_interval: float = 0.2, register_debug: bool = True):
+                 poll_interval: float = 0.2, register_debug: bool = True,
+                 breaker_factory: Optional[Callable[[], "ReplicaBreaker"]] = None,
+                 retry_budget: Optional[RetryBudget] = None):
         self.name = name
+        self._breaker_factory = breaker_factory or ReplicaBreaker
+        self.retry_budget = retry_budget or RetryBudget()
         self.min_replicas = max(1, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
         self.router = router or PrefixRouter()
@@ -182,7 +298,10 @@ class EngineFleet:
         self._next_id += 1
         gauge_id = f"{self.name}-{rid}"
         engine = self._factory(gauge_id)
-        handle = ReplicaHandle(id=rid, engine=engine, gauge_id=gauge_id)
+        handle = ReplicaHandle(id=rid, engine=engine, gauge_id=gauge_id,
+                               breaker=self._breaker_factory())
+        METRICS.gauge("fleet_breaker_state", replica=gauge_id).set(
+            handle.breaker.state_code)
         if self._client is not None:
             handle.pod_name = gauge_id
             self._create_pod(handle)
@@ -270,28 +389,87 @@ class EngineFleet:
                         h.node = node
 
     # -- request path --------------------------------------------------------
+    #: attempts per submit (first + retries); each RETRY also needs a
+    #: retry-budget token, so the real bound under sustained failure is
+    #: the budget's refill ratio, not this constant
+    MAX_ATTEMPTS = 3
+
+    def _record_outcome(self, handle: ReplicaHandle, ok: bool) -> None:
+        """Breaker feedback: every finished request reports its replica's
+        health. Deadline expiries count as failures (a slow replica IS a
+        failing replica from the SLO's point of view); client-side
+        cancellations are nobody's fault and are not reported here."""
+        (handle.breaker.record_success if ok
+         else handle.breaker.record_failure)()
+        METRICS.gauge("fleet_breaker_state", replica=handle.gauge_id).set(
+            handle.breaker.state_code)
+
+    def _outcome_cb(self, handle: ReplicaHandle) -> Callable[[Any], None]:
+        def on_done(req: Any) -> None:
+            reason = getattr(req, "finish_reason", None)
+            if reason == "cancelled":
+                return  # client walked away; says nothing about the replica
+            if isinstance(getattr(req, "error", None), FleetSaturated):
+                return  # queue-full shed is backpressure, not ill-health
+            self._record_outcome(
+                handle, ok=req.error is None and reason != "deadline")
+        return on_done
+
+    def _admissible(self) -> List[ReplicaHandle]:
+        """Live handles whose breaker admits traffic right now. Calling
+        ``allow()`` here is what flips an expired open breaker to
+        half_open — the admitted request is the probe."""
+        out = []
+        for h in self.live_handles():
+            allowed = h.breaker.allow()
+            METRICS.gauge("fleet_breaker_state", replica=h.gauge_id).set(
+                h.breaker.state_code)
+            if allowed:
+                out.append(h)
+        return out
+
     def submit(self, prompt_ids, max_new_tokens: int,
                eos_id: Optional[int] = None, temperature: float = 0.0,
-               traceparent: Optional[str] = None):
+               traceparent: Optional[str] = None,
+               deadline: Optional[float] = None,
+               priority: str = "interactive"):
         """Route and submit; same signature/return as
         ``ContinuousBatcher.submit`` so GenerativeModel can't tell the
         difference. Raises :class:`FleetSaturated` (a RuntimeError → the
-        HTTP layer's 503) when no replica can take the request."""
+        HTTP layer's 503) when no replica can take the request.
+
+        Replicas whose circuit breaker is open are excluded from routing;
+        retries beyond the first attempt draw from the fleet-wide
+        :class:`RetryBudget` so a dying fleet fails fast instead of
+        retry-storming."""
+        self.retry_budget.deposit()
         last_err: Optional[BaseException] = None
-        for _ in range(2):  # one retry if the routed engine died underneath us
+        for attempt in range(self.MAX_ATTEMPTS):
+            if attempt > 0 and not self.retry_budget.try_withdraw():
+                raise FleetSaturated(
+                    f"retry budget exhausted after replica failure: {last_err}")
             with self._lock:
                 if self._closed:
                     raise RuntimeError("fleet closed")
-                handle, _policy = self.router.route(self.live_handles(),
-                                                    prompt_ids)
+                live = self.live_handles()
+                admissible = self._admissible()
+                if live and not admissible:
+                    raise FleetSaturated(
+                        f"all {len(live)} replica breakers open",
+                        retry_after_s=self.router.retry_after_hint(live))
+                handle, _policy = self.router.route(admissible, prompt_ids,
+                                                    priority=priority)
                 try:
                     return handle.engine.submit(
                         prompt_ids, max_new_tokens, eos_id=eos_id,
-                        temperature=temperature, traceparent=traceparent)
+                        temperature=temperature, traceparent=traceparent,
+                        deadline=deadline, priority=priority,
+                        on_done=self._outcome_cb(handle))
                 except RuntimeError as e:
                     # engine wedged/closed outside our control: retire the
                     # handle and retry the route against the survivors
                     handle.state = "stopped"
+                    self._record_outcome(handle, ok=False)
                     last_err = e
         raise FleetSaturated(f"no replica accepted the request: {last_err}")
 
@@ -338,13 +516,22 @@ class EngineFleet:
         thread that copies the survivor's outcome back into the original."""
         requeued = 0
         for req in unserved:
+            # detach the drained replica's breaker callback: the outcome
+            # about to be bridged belongs to the SURVIVOR, which gets its
+            # own callback on the shadow submission below
+            if hasattr(req, "on_done"):
+                req.on_done = None
             try:
                 with self._lock:
                     handle, _policy = self.router.route(
-                        self.live_handles(), req.prompt, exclude=exclude)
+                        self.live_handles(), req.prompt, exclude=exclude,
+                        priority=getattr(req, "priority", "interactive"))
                     shadow = handle.engine.submit(
                         req.prompt, req.max_new_tokens, eos_id=req.eos_id,
-                        temperature=req.temperature)
+                        temperature=req.temperature,
+                        deadline=getattr(req, "deadline", None),
+                        priority=getattr(req, "priority", "interactive"),
+                        on_done=self._outcome_cb(handle))
             except Exception as e:
                 self._fail_request(req, e)
                 continue
@@ -356,10 +543,23 @@ class EngineFleet:
 
     @staticmethod
     def _bridge(original: Any, shadow: Any) -> None:
-        done = shadow.done.wait(timeout=BRIDGE_TIMEOUT_S)
+        # the wait derives from the shadow's remaining deadline (plus a
+        # grace period for the survivor to reap+complete it at expiry);
+        # only deadline-less requests fall back to the fixed ceiling
+        deadline = getattr(shadow, "deadline", None)
+        if deadline is not None:
+            timeout = max(0.0, deadline - time.monotonic()) + 5.0
+        else:
+            timeout = BRIDGE_TIMEOUT_S
+        done = shadow.done.wait(timeout=timeout)
         original.tokens = list(shadow.tokens)
-        error = shadow.error if done else TimeoutError(
-            "handoff request not finished")
+        original.finish_reason = getattr(shadow, "finish_reason", None)
+        if done:
+            error = shadow.error
+        elif deadline is not None:
+            error = DeadlineExceeded("handoff request missed its deadline")
+        else:
+            error = TimeoutError("handoff request not finished")
         span = getattr(original, "span", None)
         if span is not None:
             span.add_event("requeued")
@@ -426,6 +626,7 @@ class EngineFleet:
                 "slot_occupancy": reg.value("serving_slot_occupancy",
                                             replica=h.gauge_id),
                 "warm_prefixes": len(h.prefixes),
+                "breaker": h.breaker.state,
                 "pod": h.pod_name,
                 "node": h.node,
             } for h in self._replicas.values()]
@@ -437,6 +638,7 @@ class EngineFleet:
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
             "replicas": replicas,
+            "retry_budget_tokens": round(self.retry_budget.tokens, 3),
             "router": {
                 "max_queue_depth": self.router.max_queue_depth,
                 "prefix_len": self.router.prefix_len,

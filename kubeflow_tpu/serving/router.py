@@ -31,6 +31,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from ..runtime.metrics import METRICS
+from .errors import FleetSaturated  # noqa: F401 — re-export, historic home
 
 #: tokens hashed into the affinity key — long enough to separate real
 #: system prompts, short enough that "same instruction, different tail"
@@ -41,9 +42,10 @@ DEFAULT_PREFIX_LEN = 16
 #: replica doesn't accrete an unbounded claim on every prefix ever seen
 PREFIX_CACHE_SIZE = 512
 
-
-class FleetSaturated(RuntimeError):
-    """Every ready replica's queue is at max_queue_depth — shed load."""
+#: Retry-After hint bounds: never tell a client "0" (it would hammer) and
+#: never more than a minute (the fleet autoscaler acts well before that)
+RETRY_AFTER_MIN_S = 0.5
+RETRY_AFTER_MAX_S = 60.0
 
 
 def prefix_key(prompt_ids: Sequence[int], prefix_len: int = DEFAULT_PREFIX_LEN) -> int:
@@ -65,11 +67,34 @@ class PrefixRouter:
     def __init__(self, prefix_len: int = DEFAULT_PREFIX_LEN,
                  max_queue_depth: int = 32,
                  prefix_cache_size: int = PREFIX_CACHE_SIZE,
+                 interactive_reserve: float = 0.25,
                  registry=METRICS):
         self.prefix_len = int(prefix_len)
         self.max_queue_depth = int(max_queue_depth)
         self.prefix_cache_size = int(prefix_cache_size)
+        # batch requests saturate at (1 - reserve) * max_queue_depth so
+        # interactive always has queue headroom a batch flood can't take
+        self.interactive_reserve = min(max(float(interactive_reserve), 0.0), 1.0)
         self._registry = registry
+
+    def depth_limit(self, priority: str) -> int:
+        if priority == "batch":
+            return max(1, int(self.max_queue_depth
+                              * (1.0 - self.interactive_reserve)))
+        return self.max_queue_depth
+
+    def retry_after_hint(self, handles: Sequence) -> float:
+        """Seconds until the least-loaded queue plausibly drains: its depth
+        times the observed mean request latency (the queue-drain rate the
+        engines actually sustain), clamped to a sane window. Emitted as the
+        ``Retry-After`` header on saturation 503s."""
+        depth = min((self.queue_depth(h) for h in handles),
+                    default=float(self.max_queue_depth))
+        mean_s = self._registry.histogram("serving_request_seconds").mean
+        if mean_s <= 0.0:
+            mean_s = 0.5  # no completions observed yet — guess, don't say 0
+        return min(RETRY_AFTER_MAX_S,
+                   max(RETRY_AFTER_MIN_S, depth * mean_s))
 
     # -- live load, straight from the gauges --------------------------------
     def queue_depth(self, handle) -> float:
@@ -84,29 +109,35 @@ class PrefixRouter:
             "serving_slot_occupancy", replica=handle.gauge_id)
 
     def route(self, handles: Sequence, prompt_ids: Sequence[int],
-              exclude: Optional[str] = None) -> Tuple[object, str]:
+              exclude: Optional[str] = None,
+              priority: str = "interactive") -> Tuple[object, str]:
         """Pick a replica for ``prompt_ids``; returns ``(handle, policy)``.
 
         ``exclude`` drops one replica id from consideration (re-queueing a
-        drained replica's pendings must not route them back to it)."""
+        drained replica's pendings must not route them back to it).
+        ``priority`` shapes the saturation threshold: batch requests shed
+        at the reserved-fraction depth, interactive at the full depth."""
         ready = [h for h in handles
                  if h.state == "ready" and h.id != exclude]
         if not ready:
             raise FleetSaturated("no ready replicas in the fleet")
+        limit = self.depth_limit(priority)
         key = prefix_key(prompt_ids, self.prefix_len)
         owner = next((h for h in ready if key in h.prefixes), None)
-        if owner is not None and self.queue_depth(owner) < self.max_queue_depth:
+        if owner is not None and self.queue_depth(owner) < limit:
             policy = "prefix"
             chosen = owner
             METRICS.counter("fleet_prefix_hits_total").inc()
         else:
             candidates = [h for h in ready
-                          if self.queue_depth(h) < self.max_queue_depth]
+                          if self.queue_depth(h) < limit]
             if not candidates:
                 METRICS.counter("fleet_saturated_total").inc()
+                METRICS.counter("serving_shed_total", priority=priority).inc()
                 raise FleetSaturated(
                     f"all {len(ready)} ready replicas at max queue depth "
-                    f"{self.max_queue_depth}")
+                    f"{limit} for priority={priority}",
+                    retry_after_s=self.retry_after_hint(ready))
             # owner existed but was saturated → distinct policy label so
             # the miss is visible next to the hit counter
             policy = "prefix_spill" if owner is not None else "least_loaded"

@@ -15,10 +15,12 @@ TPU-first serving decisions:
 
 from __future__ import annotations
 
+import math
 import os
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,8 +28,53 @@ import numpy as np
 
 from ..runtime.metrics import METRICS
 from ..web.http import App, HttpError, Request
+from .errors import DeadlineExceeded, FleetSaturated
 
 BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+#: per-request budget when the client sends neither the
+#: ``X-Request-Deadline-Ms`` header nor a ``timeout_ms`` body field —
+#: matches the old hard-coded ``result(timeout=600)`` ceiling
+DEFAULT_DEADLINE_MS = 600_000.0
+
+#: extra wait past the deadline for the engine to reap an expired slot
+#: and hand back the partial tokens (reaping happens within ~one decode
+#: chunk; the grace also covers event-pipeline fetch latency)
+DEADLINE_GRACE_S = 5.0
+
+
+def request_deadline_opts(req: Request, body: Any) -> Tuple[float, str]:
+    """(absolute monotonic deadline, priority) for one predict request.
+
+    The ``X-Request-Deadline-Ms`` header wins over the body's
+    ``timeout_ms`` field; both express a RELATIVE budget in milliseconds
+    from arrival. Zero/negative budgets are legal and expire immediately
+    (an upstream that already blew its own deadline should get the 504
+    without costing this server a slot). Priority comes from the body's
+    ``priority`` field or the ``X-Request-Priority`` header."""
+    raw: Any = req.header("x-request-deadline-ms") or None
+    if raw is None and isinstance(body, dict):
+        raw = body.get("timeout_ms")
+    try:
+        ms = float(raw) if raw is not None else DEFAULT_DEADLINE_MS
+    except (TypeError, ValueError):
+        raise HttpError(400, f"bad deadline {raw!r}: expected milliseconds") \
+            from None
+    priority = ""
+    if isinstance(body, dict):
+        priority = str(body.get("priority") or "")
+    priority = priority or req.header("x-request-priority") or "interactive"
+    if priority not in ("interactive", "batch"):
+        raise HttpError(
+            400, f"priority {priority!r}: expected 'interactive' or 'batch'")
+    return time.monotonic() + ms / 1000.0, priority
+
+
+def retry_after_headers(e: FleetSaturated) -> Dict[str, str]:
+    """``Retry-After`` from the router's queue-drain hint (whole seconds,
+    minimum 1 — the header's unit)."""
+    hint = e.retry_after_s if e.retry_after_s else 1.0
+    return {"Retry-After": str(max(1, int(math.ceil(hint))))}
 
 
 @dataclass
@@ -111,18 +158,23 @@ class ModelServer:
             )
         return self
 
-    def _predict(self, model: ServedModel, instances) -> List[Any]:
+    def _predict(self, model: ServedModel, instances,
+                 deadline: Optional[float] = None,
+                 priority: str = "interactive") -> List[Any]:
         from .batching import BatcherClosed
 
         batcher = self._batchers.get(model.name)
         if batcher is not None:
             try:
-                return batcher.predict(instances)
+                return batcher.predict(instances, deadline=deadline)
             except BatcherClosed:
                 # Model hot-reload raced this request: the batcher we fetched
                 # was closed by add(). Serve directly — correctness over
                 # coalescing for the handful of in-flight requests.
                 pass
+        if isinstance(model, GenerativeModel):
+            return model.predict(instances, deadline=deadline,
+                                 priority=priority)
         return model.predict(instances)
 
     def close(self) -> None:
@@ -158,13 +210,18 @@ class ModelServer:
             instances = body.get("instances")
             if instances is None:
                 raise HttpError(400, "body must carry 'instances'")
-            import time
+            deadline, priority = request_deadline_opts(req, body)
 
             t0 = time.perf_counter()
             try:
-                predictions = self._predict(model, instances)
+                predictions = self._predict(model, instances,
+                                            deadline=deadline,
+                                            priority=priority)
             except HttpError:
                 raise
+            except DeadlineExceeded as e:
+                METRICS.counter("serving_predict_total", model=model.name, result="error").inc()
+                raise HttpError(504, f"deadline exceeded: {e}") from None
             except Exception as e:
                 METRICS.counter("serving_predict_total", model=model.name, result="error").inc()
                 raise HttpError(400, f"inference failed: {e}") from None
@@ -244,11 +301,16 @@ class GenerativeModel(ServedModel):
             self._engine.close()
             self._engine = None
 
-    def predict(self, instances: Sequence[Any]) -> List[Any]:
+    def predict(self, instances: Sequence[Any],
+                deadline: Optional[float] = None,
+                priority: str = "interactive") -> List[Any]:
         from kubeflow_tpu.models.gpt import generate
 
         if not instances:
             return []
+        if deadline is None:
+            # direct callers (tests, DynamicBatcher) get the server default
+            deadline = time.monotonic() + DEFAULT_DEADLINE_MS / 1000.0
         prompts = np.asarray(instances, dtype=np.int32)
         if prompts.ndim != 2:
             raise HttpError(400, "instances must be equal-length token-id lists")
@@ -272,14 +334,41 @@ class GenerativeModel(ServedModel):
             # (continuing the client's traceparent if one came in)
             cur = TRACER.current_span()
             tp = format_traceparent(cur) if cur is not None else None
-            futs = [eng.submit(row, self.max_new_tokens,
-                               temperature=self.temperature,
-                               traceparent=tp) for row in prompts]
+            futs: List[Any] = []
             try:
-                return [row.tolist() + f.result(timeout=600.0)
-                        for row, f in zip(prompts, futs)]
+                for row in prompts:
+                    futs.append(eng.submit(row, self.max_new_tokens,
+                                           temperature=self.temperature,
+                                           traceparent=tp,
+                                           deadline=deadline,
+                                           priority=priority))
+                out = []
+                for row, f in zip(prompts, futs):
+                    # the wait derives from the request's own deadline: at
+                    # expiry the engine reaps the slot and completes the
+                    # future with the partial tokens (grace covers the reap)
+                    remaining = max(0.0, deadline - time.monotonic())
+                    out.append(row.tolist()
+                               + f.result(timeout=remaining + DEADLINE_GRACE_S))
+                return out
+            except FleetSaturated as e:
+                raise HttpError(503, f"fleet saturated: {e}",
+                                headers=retry_after_headers(e)) from e
+            except DeadlineExceeded as e:
+                raise HttpError(504, f"deadline exceeded: {e}") from e
+            except TimeoutError as e:
+                # engine wedged past deadline + grace — same contract as a
+                # deadline miss, the slot reap just never surfaced
+                raise HttpError(504, f"deadline exceeded: {e}") from e
             except RuntimeError as e:
                 raise HttpError(503, f"decode engine unavailable: {e}") from e
+            finally:
+                # this handler is the requests' only consumer: anything not
+                # finished when we unwind is abandoned — cancel so the
+                # engine frees the slots instead of decoding for nobody
+                for f in futs:
+                    if not f.done.is_set():
+                        f.cancel()
         # Batch-bucket like ServedModel.predict: arbitrary client batch
         # sizes must not mint unbounded XLA compilations.
         n = prompts.shape[0]

@@ -22,10 +22,15 @@ log = logging.getLogger("kubeflow_tpu.web")
 
 
 class HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    """``headers`` ride onto the error response — e.g. ``Retry-After`` on
+    an overload 503, so shedding tells clients WHEN to come back."""
+
+    def __init__(self, status: int, message: str,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = dict(headers or {})
 
 
 @dataclass
@@ -193,7 +198,8 @@ class App:
                 raise HttpError(405, f"method {req.method} not allowed")
             raise HttpError(404, f"no route for {req.path}")
         except HttpError as e:
-            return JsonResponse({"error": e.message, "status": e.status}, status=e.status)
+            return JsonResponse({"error": e.message, "status": e.status},
+                                status=e.status, headers=dict(e.headers))
         except Exception:
             log.exception("%s: handler error %s %s", self.name, req.method, req.path)
             return JsonResponse({"error": "internal error", "status": 500}, status=500)
@@ -286,7 +292,13 @@ class AppServer:
 
             do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _handle
 
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            # an overloaded server must answer 503/504, not RST at the
+            # TCP layer: the default socketserver backlog of 5 resets
+            # connection bursts before the shedding logic sees them
+            request_queue_size = 128
+
+        self.httpd = _Server((host, port), _Handler)
         if ssl_context is not None:
             # Wrap BEFORE the accept thread starts: the port must never
             # serve a plaintext connection on a TLS-configured server.

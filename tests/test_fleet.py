@@ -67,6 +67,10 @@ class FakeRequest:
         self.tokens = []
         self.error = None
         self.span = None
+        self.finish_reason = "ok"
+        self.deadline = None
+        self.priority = "interactive"
+        self.on_done = None
         self.done = threading.Event()
 
 
@@ -80,10 +84,16 @@ class FakeEngine:
         self.closed = False
 
     def submit(self, prompt_ids, max_new_tokens, eos_id=None,
-               temperature=0.0, traceparent=None):
+               temperature=0.0, traceparent=None, deadline=None,
+               priority="interactive", on_done=None):
         req = FakeRequest(prompt_ids, max_new_tokens, eos_id, temperature)
+        req.deadline = deadline
+        req.priority = priority
+        req.on_done = on_done
         req.tokens = [7] * max_new_tokens
         req.done.set()
+        if on_done is not None:
+            on_done(req)
         self.submitted.append(req)
         return req
 

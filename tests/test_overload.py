@@ -1,0 +1,594 @@
+"""Serving-path overload protection (ISSUE 9): deadline propagation and
+fast-fail, mid-decode cancellation freeing slots, priority load shedding
+with an interactive reserve, per-replica circuit breakers, the fleet
+retry budget, serving chaos injectors, and the HTTP plumbing
+(X-Request-Deadline-Ms → 504, FleetSaturated → 503 + Retry-After)."""
+
+import threading
+import time
+from collections import OrderedDict
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.gpt import GptConfig, GptLM
+from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.serving.continuous import ContinuousBatcher
+from kubeflow_tpu.serving.errors import (DeadlineExceeded, EngineClosed,
+                                         FleetSaturated, RequestCancelled)
+from kubeflow_tpu.serving.fleet import EngineFleet, ReplicaBreaker, RetryBudget
+from kubeflow_tpu.serving.router import PrefixRouter
+from kubeflow_tpu.serving.server import (GenerativeModel, ModelServer,
+                                         request_deadline_opts,
+                                         retry_after_headers)
+from kubeflow_tpu.web.http import App, HttpError, Request
+
+CFG = GptConfig(d_model=32, n_layers=2, n_heads=2, d_ff=64, max_seq=128,
+                vocab_size=101)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GptLM(CFG).init(jax.random.PRNGKey(0),
+                           np.zeros((1, 8), np.int32))["params"]
+
+
+def prompt(seed: int, n: int = 6) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, CFG.vocab_size, size=(n,)).astype(np.int32)
+
+
+def wait_for(predicate, timeout=15.0, interval=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), f"timed out waiting for {desc}"
+
+
+# -- deadlines + cancellation on the engine -----------------------------------
+
+
+class TestEngineDeadlines:
+    def test_expired_at_submit_fails_future_without_raising(self, params):
+        """A dead-on-arrival deadline must fail the RETURNED future, not
+        raise — the fleet's retry path treats a raising engine.submit as a
+        dead replica. And it must not feed the breaker (the client blew
+        its own budget before this replica saw the request)."""
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="doa")
+        outcomes = []
+        try:
+            f = eng.submit(prompt(0), 4, deadline=time.monotonic() - 1.0,
+                           on_done=outcomes.append)
+            assert f.done.is_set(), "DOA future must complete immediately"
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=1)
+            assert f.finish_reason == "deadline"
+            assert outcomes == [], \
+                "pre-admission expiry says nothing about the replica"
+            assert METRICS.value("serving_deadline_expired_total",
+                                 stage="queued") == 1.0
+        finally:
+            eng.close()
+
+    def test_queued_expiry_fails_fast_and_never_takes_a_slot(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="qx")
+        eng.step_delay_s = 0.1  # ~1.5s for the blocker's 30-token budget
+        try:
+            blocker = eng.submit(prompt(1), 30)
+            wait_for(lambda: blocker.tokens, desc="blocker admitted")
+            t0 = time.monotonic()
+            starved = eng.submit(prompt(2), 4,
+                                 deadline=time.monotonic() + 0.25)
+            with pytest.raises(DeadlineExceeded):
+                starved.result(timeout=10)
+            assert time.monotonic() - t0 < 5.0, \
+                "queued expiry must fail fast, not wait out the blocker"
+            assert starved.tokens == [], "expired request never got a slot"
+            assert METRICS.value("serving_deadline_expired_total",
+                                 stage="queued") >= 1.0
+            assert blocker.result(timeout=30), "blocker must still finish"
+        finally:
+            eng.close()
+
+    def test_mid_decode_expiry_returns_partial_tokens_and_frees_slot(
+            self, params):
+        eng = ContinuousBatcher(CFG, params, slots=2, chunk=2, pipeline=2,
+                                engine_id="md")
+        try:
+            # warm the compile caches first: the deadline below must race
+            # decode throughput, not a cold XLA compilation
+            eng.submit(prompt(2), 4).result(timeout=60)
+            eng.step_delay_s = 0.05
+            f = eng.submit(prompt(3), 100, deadline=time.monotonic() + 0.6)
+            toks = f.result(timeout=20)  # no error: partial result
+            assert f.finish_reason == "deadline"
+            assert 0 < len(toks) < 100, \
+                f"expected a partial completion, got {len(toks)} tokens"
+            assert METRICS.value("serving_deadline_expired_total",
+                                 stage="decoding") >= 1.0
+            wait_for(lambda: len(eng._free) == 2, desc="slot reclaimed")
+        finally:
+            eng.close()
+
+    def test_cancel_frees_slot_and_counts_wasted_tokens(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=2, chunk=2, pipeline=3,
+                                engine_id="cx")
+        eng.step_delay_s = 0.05
+        outcomes = []
+        try:
+            f = eng.submit(prompt(4), 100, on_done=outcomes.append)
+            wait_for(lambda: f.tokens, desc="first token")
+            assert f.cancel() is True
+            toks = f.result(timeout=20)
+            assert f.finish_reason == "cancelled"
+            assert len(toks) < 100
+            assert f.cancel() is False, "cancel after completion is a no-op"
+            assert outcomes == [f], "on_done fires exactly once"
+            assert METRICS.value("serving_cancelled_total") >= 1.0
+            wait_for(lambda: len(eng._free) == 2, desc="slot reclaimed")
+            # chunks dispatched before the reap surface as goodput loss
+            wait_for(lambda: METRICS.value(
+                "serving_wasted_decode_tokens_total") > 0,
+                desc="wasted-token accounting")
+        finally:
+            eng.close()
+
+    def test_cancel_requests_reaps_queued_work(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="ab")
+        eng.step_delay_s = 0.1
+        try:
+            blocker = eng.submit(prompt(5), 30)
+            wait_for(lambda: blocker.tokens, desc="blocker admitted")
+            queued = eng.submit(prompt(6), 4)
+            # the worker moves arrivals to the pending deque at its next
+            # iteration; cancel_requests only sees pendings once there
+            wait_for(lambda: len(eng._pending) == 1, desc="request queued")
+            assert eng.cancel_requests(2) == 2
+            with pytest.raises(RequestCancelled):
+                queued.result(timeout=10)
+            assert queued.finish_reason == "cancelled"
+        finally:
+            eng.close()
+
+    def test_submit_after_close_raises_engine_closed(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="cl")
+        eng.close()
+        with pytest.raises(EngineClosed, match="closed"):
+            eng.submit(prompt(7), 4)
+        # EngineClosed must stay a RuntimeError: the HTTP layer's 503
+        # mapping and existing except-RuntimeError callers depend on it
+        assert issubclass(EngineClosed, RuntimeError)
+
+
+# -- priority admission -------------------------------------------------------
+
+
+class TestPriorityShedding:
+    def test_batch_sheds_first_interactive_keeps_reserve(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="pr", max_pending=4,
+                                interactive_reserve=0.5)
+        eng.step_delay_s = 0.1
+        try:
+            blocker = eng.submit(prompt(8), 40)
+            wait_for(lambda: blocker.tokens, desc="blocker admitted")
+            batch = [eng.submit(prompt(10 + i), 2, priority="batch")
+                     for i in range(6)]
+            # batch cap = (1 - 0.5) * 4 = 2: four of six must shed
+            wait_for(lambda: METRICS.value("serving_shed_total",
+                                           priority="batch") >= 4.0,
+                     desc="batch shedding")
+            inter = eng.submit(prompt(20), 2, priority="interactive")
+            shed = [f for f in batch if f.done.is_set()
+                    and isinstance(f.error, FleetSaturated)]
+            assert len(shed) == 4, f"expected 4 shed batch requests, got {len(shed)}"
+            # everyone still admitted finishes once the blocker retires
+            inter_toks = inter.result(timeout=30)
+            assert inter_toks and inter.error is None
+            assert METRICS.value("serving_shed_total",
+                                 priority="interactive") == 0.0, \
+                "interactive must never shed while batch holds queue slots"
+            survivors = [f for f in batch if not isinstance(f.error,
+                                                            FleetSaturated)]
+            for f in survivors:
+                f.result(timeout=30)
+            # interactive-first admission: the interactive request jumped
+            # the earlier-queued batch requests
+            assert inter.done_at <= min(f.done_at for f in survivors), \
+                "interactive must be admitted before queued batch work"
+        finally:
+            eng.close()
+
+    def test_bad_priority_rejected(self, params):
+        eng = ContinuousBatcher(CFG, params, slots=1, chunk=2, pipeline=1,
+                                engine_id="bp")
+        try:
+            with pytest.raises(ValueError, match="priority"):
+                eng.submit(prompt(9), 4, priority="urgent")
+        finally:
+            eng.close()
+
+
+class TestRouterPriority:
+    @staticmethod
+    def _handle(rid: str):
+        return SimpleNamespace(id=rid, gauge_id=rid, state="ready",
+                               prefixes=OrderedDict())
+
+    def test_depth_limit_reserves_interactive_headroom(self):
+        r = PrefixRouter(max_queue_depth=8, interactive_reserve=0.25)
+        assert r.depth_limit("interactive") == 8
+        assert r.depth_limit("batch") == 6
+
+    def test_batch_sheds_while_interactive_routes(self):
+        r = PrefixRouter(max_queue_depth=8, interactive_reserve=0.25)
+        h = self._handle("rp-0")
+        METRICS.gauge("serving_queue_depth", replica="rp-0").set(6)
+        with pytest.raises(FleetSaturated) as ei:
+            r.route([h], prompt(0), priority="batch")
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s >= 0.5
+        assert METRICS.value("serving_shed_total", priority="batch") == 1.0
+        chosen, _policy = r.route([h], prompt(0), priority="interactive")
+        assert chosen is h
+
+    def test_retry_after_hint_tracks_queue_drain_rate(self):
+        r = PrefixRouter(max_queue_depth=32)
+        h = self._handle("rh-0")
+        METRICS.gauge("serving_queue_depth", replica="rh-0").set(4)
+        # no completions yet: depth × the 0.5s guess
+        assert r.retry_after_hint([h]) == pytest.approx(2.0)
+        METRICS.histogram("serving_request_seconds").observe(2.0)
+        METRICS.histogram("serving_request_seconds").observe(4.0)
+        assert r.retry_after_hint([h]) == pytest.approx(12.0)  # 4 × mean 3s
+        METRICS.gauge("serving_queue_depth", replica="rh-0").set(1000)
+        assert r.retry_after_hint([h]) == 60.0, "hint must clamp at the max"
+
+
+# -- breaker + retry budget ---------------------------------------------------
+
+
+class TestReplicaBreaker:
+    def test_full_cycle_with_fake_clock(self):
+        clk = [0.0]
+        b = ReplicaBreaker(failure_threshold=3, open_s=5.0,
+                           clock=lambda: clk[0])
+        assert b.state == "closed" and b.state_code == 0
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed", "below threshold stays closed"
+        b.record_failure()
+        assert b.state == "open" and b.state_code == 1
+        assert not b.allow(), "open refuses traffic inside the window"
+        clk[0] += 5.0
+        assert b.allow(), "the first caller after the window is the probe"
+        assert b.state == "half_open" and b.state_code == 2
+        assert not b.allow(), "one probe at a time"
+        b.record_failure()
+        assert b.state == "open", "failed probe reopens with a fresh window"
+        clk[0] += 5.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_success_resets_consecutive_failures(self):
+        b = ReplicaBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed", "non-consecutive failures must not trip"
+
+    def test_lost_probe_re_probes_after_window(self):
+        """_admissible() may consume the half_open probe for a replica the
+        router then doesn't pick; the breaker must re-admit a probe after
+        another window instead of sticking half_open forever."""
+        clk = [0.0]
+        b = ReplicaBreaker(failure_threshold=1, open_s=5.0,
+                           clock=lambda: clk[0])
+        b.record_failure()
+        clk[0] += 5.0
+        assert b.allow()  # probe handed out, outcome never reported
+        clk[0] += 5.0
+        assert b.allow(), "a lost probe must not wedge the breaker"
+
+
+class TestRetryBudget:
+    def test_starts_full_and_refuses_when_drained(self):
+        rb = RetryBudget(ratio=0.5, cap=2.0)
+        assert rb.try_withdraw() and rb.try_withdraw()
+        assert not rb.try_withdraw()
+        assert METRICS.value("fleet_retry_budget_exhausted_total") == 1.0
+
+    def test_deposits_refill_to_cap(self):
+        rb = RetryBudget(ratio=0.5, cap=2.0)
+        for _ in range(10):
+            rb.deposit()
+        assert rb.tokens == 2.0
+        assert rb.try_withdraw()
+        rb.deposit()
+        assert rb.tokens == pytest.approx(1.5)
+
+
+class _ScriptedEngine:
+    """Duck-typed engine whose submissions fail until ``healthy``."""
+
+    def __init__(self, engine_id: str):
+        self.engine_id = engine_id
+        self.healthy = False
+        self.submitted = []
+
+    def submit(self, prompt_ids, max_new_tokens, eos_id=None,
+               temperature=0.0, traceparent=None, deadline=None,
+               priority="interactive", on_done=None):
+        req = SimpleNamespace(
+            prompt=np.asarray(prompt_ids, np.int32),
+            max_new_tokens=max_new_tokens, eos_id=eos_id,
+            temperature=temperature, deadline=deadline, priority=priority,
+            tokens=[7] * max_new_tokens if self.healthy else [],
+            error=None if self.healthy else RuntimeError("replica sick"),
+            finish_reason="ok" if self.healthy else "error",
+            on_done=on_done, done=threading.Event())
+        req.done.set()
+        if on_done is not None:
+            on_done(req)
+        self.submitted.append(req)
+        return req
+
+    def drain(self):
+        return []
+
+    def close(self):
+        pass
+
+
+class TestFleetBreakers:
+    def test_breakers_open_then_probe_recloses(self):
+        clk = [0.0]
+        fleet = EngineFleet(
+            replicas=2, min_replicas=1, max_replicas=4, name="brk",
+            engine_factory=_ScriptedEngine, register_debug=False,
+            breaker_factory=lambda: ReplicaBreaker(
+                failure_threshold=2, open_s=5.0, clock=lambda: clk[0]))
+        try:
+            p = prompt(0)
+            # prefix affinity pins the prompt to one replica; two failed
+            # outcomes open its breaker, the next two open the other's
+            for _ in range(4):
+                fleet.submit(p, 4)
+            handles = fleet.live_handles()
+            assert all(h.breaker.state == "open" for h in handles), \
+                [h.breaker.state for h in handles]
+            for h in handles:
+                assert METRICS.value("fleet_breaker_state",
+                                     replica=h.gauge_id) == 1.0
+            with pytest.raises(FleetSaturated, match="breakers open") as ei:
+                fleet.submit(p, 4)
+            assert ei.value.retry_after_s is not None
+            snap = fleet.debug_snapshot()
+            assert {r["breaker"] for r in snap["replicas"]} == {"open"}
+            # window elapses; the probe succeeds and re-closes a breaker
+            clk[0] += 5.0
+            for h in handles:
+                h.engine.healthy = True
+            req = fleet.submit(p, 4)
+            assert req.error is None
+            assert any(h.breaker.state == "closed"
+                       for h in fleet.live_handles())
+            assert any(METRICS.value("fleet_breaker_state",
+                                     replica=h.gauge_id) == 0.0
+                       for h in fleet.live_handles())
+        finally:
+            fleet.close()
+
+    def test_raising_engine_exhausts_retry_budget(self):
+        class _Raising(_ScriptedEngine):
+            def submit(self, *a, **kw):
+                raise RuntimeError("engine wedged")
+
+        fleet = EngineFleet(
+            replicas=3, min_replicas=1, max_replicas=4, name="rb",
+            engine_factory=_Raising, register_debug=False,
+            retry_budget=RetryBudget(ratio=0.0, cap=1.0))
+        try:
+            with pytest.raises(FleetSaturated, match="retry budget"):
+                fleet.submit(prompt(1), 4)
+            assert METRICS.value("fleet_retry_budget_exhausted_total") >= 1.0
+        finally:
+            fleet.close()
+
+
+# -- chaos --------------------------------------------------------------------
+
+
+class _ChaosEngine:
+    def __init__(self, inflight: int = 2):
+        self.step_delay_s = 0.0
+        self.fail_next_step = False
+        self._inflight = inflight
+        self.cancelled = 0
+
+    def cancel_requests(self, n: int) -> int:
+        got = min(n, self._inflight)
+        self._inflight -= got
+        self.cancelled += got
+        return got
+
+
+class _ChaosFleet:
+    def __init__(self, handles):
+        self._handles = handles
+
+    def live_handles(self):
+        return list(self._handles)
+
+
+def _chaos_fleet(n: int = 2, inflight: int = 2):
+    handles = [SimpleNamespace(id=str(i), gauge_id=f"cf-{i}",
+                               engine=_ChaosEngine(inflight))
+               for i in range(n)]
+    return _ChaosFleet(handles), handles
+
+
+class TestServingChaos:
+    def test_seeded_schedule_is_deterministic(self):
+        targets = {"slow_replica": ["cf-0", "cf-1"],
+                   "client_abandon": ["cf-0"],
+                   "crash_replica_mid_decode": ["cf-1"]}
+        a = ChaosSchedule.seeded(7, 6, 10.0, targets,
+                                 param={"slow_replica": 0.3})
+        b = ChaosSchedule.seeded(7, 6, 10.0, targets,
+                                 param={"slow_replica": 0.3})
+        assert a.faults == b.faults
+        assert all(f.kind in targets for f in a.faults)
+
+    def test_slow_replica_sets_and_stop_resets_delay(self):
+        ff, handles = _chaos_fleet()
+        monkey = ChaosMonkey(None, ChaosSchedule([]), fleet=ff)
+        monkey.inject(Fault(at=0.0, kind="slow_replica", target="cf-1",
+                            param=0.3))
+        assert handles[1].engine.step_delay_s == 0.3
+        assert handles[0].engine.step_delay_s == 0.0
+        assert len(monkey.fired) == 1
+        assert METRICS.value("chaos_faults_injected_total",
+                             kind="slow_replica") == 1.0
+        monkey.stop()
+        assert handles[1].engine.step_delay_s == 0.0, \
+            "a finished chaos run must not leave a replica degraded"
+
+    def test_slow_replica_duration_recovers_on_its_own(self):
+        ff, handles = _chaos_fleet()
+        monkey = ChaosMonkey(None, ChaosSchedule([]), fleet=ff)
+        monkey.inject(Fault(at=0.0, kind="slow_replica", target="cf-0",
+                            param=0.5, duration=0.1))
+        assert handles[0].engine.step_delay_s == 0.5
+        wait_for(lambda: handles[0].engine.step_delay_s == 0.0,
+                 timeout=5.0, desc="bounded fault recovery")
+
+    def test_crash_poisons_next_step(self):
+        ff, handles = _chaos_fleet()
+        monkey = ChaosMonkey(None, ChaosSchedule([]), fleet=ff)
+        monkey.inject(Fault(at=0.0, kind="crash_replica_mid_decode",
+                            target="cf-0"))
+        assert handles[0].engine.fail_next_step is True
+        assert handles[1].engine.fail_next_step is False
+
+    def test_client_abandon_cancels_across_replicas(self):
+        ff, handles = _chaos_fleet(n=2, inflight=1)
+        monkey = ChaosMonkey(None, ChaosSchedule([]), fleet=ff)
+        monkey.inject(Fault(at=0.0, kind="client_abandon", target="cf-0",
+                            param=2))
+        assert handles[0].engine.cancelled == 1
+        assert handles[1].engine.cancelled == 1, \
+            "the overflow cancels on the next replica"
+
+    def test_client_abandon_with_nothing_in_flight_is_skipped(self):
+        ff, _handles = _chaos_fleet(n=1, inflight=0)
+        monkey = ChaosMonkey(None, ChaosSchedule([]), fleet=ff)
+        monkey.inject(Fault(at=0.0, kind="client_abandon", param=1))
+        assert monkey.fired == [], "a no-op injection must not count as fired"
+
+    def test_serving_faults_without_a_fleet_are_skipped(self):
+        monkey = ChaosMonkey(None, ChaosSchedule([]))
+        monkey.inject(Fault(at=0.0, kind="slow_replica"))
+        assert monkey.fired == []
+
+
+# -- HTTP plumbing ------------------------------------------------------------
+
+
+def _req(headers=None):
+    return Request(method="POST", path="/", query={},
+                   headers={k.lower(): v for k, v in (headers or {}).items()},
+                   body=b"")
+
+
+class TestHttpPlumbing:
+    def test_header_beats_body_deadline(self):
+        t0 = time.monotonic()
+        deadline, priority = request_deadline_opts(
+            _req({"X-Request-Deadline-Ms": "250"}), {"timeout_ms": 99999})
+        assert 0.1 <= deadline - t0 <= 0.4
+        assert priority == "interactive"
+
+    def test_body_timeout_and_priority(self):
+        t0 = time.monotonic()
+        deadline, priority = request_deadline_opts(
+            _req(), {"timeout_ms": 1500, "priority": "batch"})
+        assert 1.3 <= deadline - t0 <= 1.7
+        assert priority == "batch"
+
+    def test_priority_header_fallback(self):
+        _deadline, priority = request_deadline_opts(
+            _req({"X-Request-Priority": "batch"}), {})
+        assert priority == "batch"
+
+    def test_bad_deadline_and_priority_are_400(self):
+        with pytest.raises(HttpError) as ei:
+            request_deadline_opts(_req({"X-Request-Deadline-Ms": "soon"}), {})
+        assert ei.value.status == 400
+        with pytest.raises(HttpError) as ei:
+            request_deadline_opts(_req(), {"priority": "urgent"})
+        assert ei.value.status == 400
+
+    def test_retry_after_headers_round_up(self):
+        assert retry_after_headers(
+            FleetSaturated("x", retry_after_s=2.3)) == {"Retry-After": "3"}
+        assert retry_after_headers(
+            FleetSaturated("x")) == {"Retry-After": "1"}
+
+    def test_http_error_headers_reach_the_response(self):
+        app = App("t")
+
+        @app.route("/boom")
+        def boom(req):
+            raise HttpError(503, "overloaded",
+                            headers={"Retry-After": "7"})
+
+        resp = app.call("GET", "/boom")
+        assert resp.status == 503
+        assert resp.headers["Retry-After"] == "7"
+
+    def test_expired_deadline_maps_to_504(self, params):
+        model = GenerativeModel(name="gen", apply_fn=None, params=params,
+                                cfg=CFG, max_new_tokens=4, slots=2)
+        server = ModelServer()
+        server.add(model)
+        try:
+            resp = server.app.call(
+                "POST", "/v1/models/gen:predict",
+                body={"instances": [[1, 2, 3]], "timeout_ms": -5})
+            assert resp.status == 504, resp.body
+            assert "deadline" in resp.body["error"]
+        finally:
+            model.close()
+
+    def test_saturated_fleet_maps_to_503_with_retry_after(self, params):
+        class _Saturated:
+            def submit(self, *a, **kw):
+                raise FleetSaturated("every replica full",
+                                     retry_after_s=7.2)
+
+            def close(self):
+                pass
+
+        model = GenerativeModel(name="gen", apply_fn=None, params=params,
+                                cfg=CFG, max_new_tokens=4)
+        model._engine = _Saturated()
+        server = ModelServer()
+        server.add(model)
+        try:
+            resp = server.app.call("POST", "/v1/models/gen:predict",
+                                   body={"instances": [[1, 2, 3]]})
+            assert resp.status == 503, resp.body
+            assert resp.headers["Retry-After"] == "8"
+        finally:
+            model.close()
