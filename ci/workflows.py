@@ -227,6 +227,22 @@ def attribution_e2e() -> Dict:
     return b.build()
 
 
+def monitoring_e2e() -> Dict:
+    """The monitoring-plane job: three real processes federated through one
+    scraper/TSDB, a slow-replica fault driving a burn-rate alert through
+    pending → firing (ONE deduplicated Warning Event) → resolved, a
+    FederatedWindowSource autoscaler scaling the fleet from scraped — not
+    in-process — histograms, and the dashboard's platform endpoint reading
+    federated data (e2e/monitoring_driver.py asserts all of it), plus the
+    parser / TSDB / scraper / rules / staleness unit suite."""
+    b = WorkflowBuilder("monitoring-e2e")
+    b.run("monitoring-federation-dryrun", ["python", "-m", "e2e.monitoring_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("monitoring-unit", "tests/test_monitoring.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 #: registry of buildable workflows (prow_config.yaml names resolve here)
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
@@ -239,6 +255,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "elastic-e2e": elastic_e2e,
     "bench-regression": bench_regression,
     "attribution-e2e": attribution_e2e,
+    "monitoring-e2e": monitoring_e2e,
 }
 
 

@@ -196,11 +196,14 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     # prewarm() runs uninstrumented, so only the timed requests count.
     from kubeflow_tpu.runtime.metrics import METRICS
 
+    def _q(name: str, q: float) -> float:
+        v = METRICS.quantile(name, q)  # None = no observations (not 0.0)
+        return round(v, 4) if v is not None else 0.0
+
     return {
-        "ttft_p50": round(METRICS.quantile("serving_ttft_seconds", 0.5), 4),
-        "ttft_p99": round(METRICS.quantile("serving_ttft_seconds", 0.99), 4),
-        "queue_wait_p99": round(
-            METRICS.quantile("serving_queue_wait_seconds", 0.99), 4),
+        "ttft_p50": _q("serving_ttft_seconds", 0.5),
+        "ttft_p99": _q("serving_ttft_seconds", 0.99),
+        "queue_wait_p99": _q("serving_queue_wait_seconds", 0.99),
         "slots": slots, "requests": n_requests, "budgets": "32/64/128/224",
         "useful_tokens": total_tokens,
         "static_wall_s": round(static_s, 2),

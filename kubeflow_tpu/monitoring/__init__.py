@@ -1,0 +1,40 @@
+"""Platform monitoring plane: a mini-Prometheus for the control plane.
+
+Nine PRs of instrumentation gave every process a rich ``/metrics`` page and
+nothing that could read one. This package closes the loop:
+
+- ``scrape``  — strict OpenMetrics parser for our own exposition plus a
+  ``Scraper`` that pulls ``/metrics`` from a target set (static list + live
+  discovery of annotated Pods through the apiserver) and writes samples,
+  ``up`` and ``scrape_duration_seconds`` into the TSDB,
+- ``tsdb``    — bounded in-memory time-series store (per-series ring
+  buffers, label matchers) with ``rate()``, ``increase()`` and windowed
+  ``histogram_quantile()`` — enough query power for rules, no more,
+- ``rules``   — recording rules and multi-window multi-burn-rate SLO
+  alerts (SRE-workbook 5m/1h + 30m/6h pairs) with a pending→firing→resolved
+  lifecycle, emitted as deduplicated K8s Warning Events,
+- ``plane``   — ``MonitoringPlane`` composing the three, serving
+  ``/federate`` and ``/debug/alerts``.
+"""
+
+from .tsdb import TSDB, Matchers  # noqa: F401
+from .scrape import (  # noqa: F401
+    ParseError,
+    Sample,
+    Family,
+    parse_exposition,
+    render_exposition,
+    Scraper,
+    Target,
+    SCRAPE_ANNOTATION,
+    SCRAPE_URL_ANNOTATION,
+    SCRAPE_JOB_ANNOTATION,
+)
+from .rules import (  # noqa: F401
+    BurnRateWindow,
+    DEFAULT_BURN_RATE_WINDOWS,
+    RecordingRule,
+    RuleEngine,
+    SLOBurnRateAlert,
+)
+from .plane import MonitoringPlane, install_cluster_collector  # noqa: F401

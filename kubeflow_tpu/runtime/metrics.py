@@ -147,7 +147,7 @@ class NamespacedRegistry:
     def value(self, name: str, **labels: str) -> float:
         return self._registry.value(self._name(name), **labels)
 
-    def quantile(self, name: str, q: float) -> float:
+    def quantile(self, name: str, q: float) -> Optional[float]:
         return self._registry.quantile(self._name(name), q)
 
 
@@ -253,17 +253,19 @@ class MetricsRegistry:
                 total += h.total
             return buckets, counts, total
 
-    def quantile(self, name: str, q: float) -> float:
+    def quantile(self, name: str, q: float) -> Optional[float]:
         """Estimate the q-quantile (0..1) of histogram ``name`` across every
         label series: find the bucket holding rank q*total and interpolate
         linearly inside it (exactly what PromQL's histogram_quantile does
         server-side). Observations above the largest finite bucket clamp to
-        that bound. Returns 0.0 with no observations."""
+        that bound. Returns None for a missing or never-observed histogram —
+        "no data" must stay distinguishable from "zero latency" or the SLO
+        burn-rate rules would read an outage as a perfect quantile."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile q={q} outside [0, 1]")
         snap = self.histogram_counts(name)
         if snap is None:
-            return 0.0
+            return None
         buckets, counts, total = snap
         return quantile_from_counts(buckets, counts, total, q)
 
@@ -276,8 +278,10 @@ class MetricsRegistry:
             self._collectors[key] = fn
 
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4, with OpenMetrics-style
-        exemplars on histogram bucket lines when a trace was active."""
+        """OpenMetrics-flavored text exposition: Prometheus 0.0.4 sample
+        lines, OpenMetrics exemplars on histogram buckets when a trace was
+        active, and a terminating ``# EOF`` so the monitoring plane's strict
+        parser (kubeflow_tpu/monitoring/scrape.py) round-trips it."""
         for fn in list(self._collectors.values()):
             try:
                 fn()  # outside self._lock — collectors call gauge()/counter()
@@ -309,7 +313,8 @@ class MetricsRegistry:
                         lines.append(f"{name}_count{suffix} {m.total}")
                     else:
                         lines.append(f"{name}{suffix} {m.value}")
-        return "\n".join(lines) + "\n"
+        lines.append("# EOF")  # OpenMetrics terminator: consumers can tell
+        return "\n".join(lines) + "\n"  # a complete scrape from a truncated one
 
     def namespace(self, prefix: str) -> NamespacedRegistry:
         return NamespacedRegistry(self, prefix)
@@ -322,13 +327,15 @@ class MetricsRegistry:
 
 
 def quantile_from_counts(buckets: Sequence[float], counts: Sequence[int],
-                         total: int, q: float) -> float:
+                         total: int, q: float) -> Optional[float]:
     """The histogram_quantile() interpolation over an explicit bucket-count
     vector (len(counts) == len(buckets)+1, last slot = +Inf). Shared by the
     registry's cumulative ``quantile`` and windowed consumers quantiling
-    per-interval count deltas."""
+    per-interval count deltas. Returns None on an empty vector (agreeing
+    with ``MetricsRegistry.quantile``): no observations is "no data", never
+    a 0.0 that could masquerade as a great latency."""
     if total <= 0:
-        return 0.0
+        return None
     rank = q * total
     cum = 0
     for i, bound in enumerate(buckets):

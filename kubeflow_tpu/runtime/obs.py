@@ -37,9 +37,10 @@ from .metrics import (
 )
 from .tracing import TRACER, Tracer
 
-#: exposition content type (Prometheus text 0.0.4; exemplar suffixes are
-#: OpenMetrics-style and ignored by 0.0.4-only parsers of our own make)
-EXPOSITION_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+#: exposition content type — OpenMetrics, since render() emits exemplar
+#: suffixes and the ``# EOF`` terminator (a 0.0.4 content type would make
+#: spec-compliant scrapers reject both)
+EXPOSITION_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 #: hard ceiling on one /debug/traces response (the ring holds 4096 spans)
 MAX_TRACE_SPANS = 4096

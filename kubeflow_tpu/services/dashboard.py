@@ -44,10 +44,13 @@ DEFAULT_LINKS = {
 
 class TpuMetricsService:
     """MetricsService impl (interface: metrics_service.ts:20-41) reporting
-    TPU chip allocation — the platform's duty-cycle stand-in until node
-    agents export real utilization."""
+    TPU chip allocation. With a monitoring plane wired, node utilization is
+    read from federated ``node_tpu_*_chips`` gauges (published by whichever
+    process runs ``monitoring.install_cluster_collector``) and the raw pod
+    math becomes the fallback for clusters without a monitor running."""
 
-    def __init__(self, client: Client, cache: Optional["InformerCache"] = None):
+    def __init__(self, client: Client, cache: Optional["InformerCache"] = None,
+                 monitoring=None):
         from ..runtime.informer import InformerCache
 
         self.client = client
@@ -55,11 +58,17 @@ class TpuMetricsService:
         # the cluster per request (the reference reads through a shared
         # informer — kfam/api_default.go:71-75).
         self.cache = cache or InformerCache(client)
+        #: MonitoringPlane or bare TSDB (both expose the read surface)
+        self.monitoring = monitoring
+        self.tsdb = getattr(monitoring, "tsdb", monitoring)
 
     def _list(self, api_version: str, kind: str, namespace: Optional[str] = None):
         return self.cache.list(api_version, kind, namespace)
 
     def node_tpu_utilization(self) -> List[Dict[str, Any]]:
+        federated = self._federated_node_utilization()
+        if federated is not None:
+            return federated
         out = []
         pods = self._list("v1", "Pod")
         for node in self._list("v1", "Node"):
@@ -74,9 +83,73 @@ class TpuMetricsService:
                         "utilization": used / capacity})
         return out
 
+    def _federated_node_utilization(self) -> Optional[List[Dict[str, Any]]]:
+        """Node rows from the TSDB's fresh ``node_tpu_*_chips`` series, or
+        None when nothing federated is available (fall back to pod math).
+        Stale series are excluded by the TSDB read path, so a dead monitor
+        degrades to the fallback instead of pinning old numbers."""
+        if self.tsdb is None:
+            return None
+        caps = self.tsdb.latest("node_tpu_capacity_chips")
+        if not caps:
+            return None
+        alloc = {
+            labels.get("node"): value
+            for labels, _ts, value in self.tsdb.latest("node_tpu_allocated_chips")
+        }
+        out = []
+        for labels, _ts, capacity in caps:
+            node = labels.get("node")
+            if node is None or capacity <= 0:
+                continue
+            used = alloc.get(node, 0.0)
+            out.append({"node": node, "capacityChips": int(capacity),
+                        "allocatedChips": int(used),
+                        "utilization": used / capacity, "source": "federated"})
+        return sorted(out, key=lambda r: r["node"]) or None
+
     def namespace_tpu_usage(self, namespace: str) -> Dict[str, Any]:
         used = sum(pod_tpu_chips(p) for p in self._list("v1", "Pod", namespace))
         return {"namespace": namespace, "allocatedChips": used}
+
+    def platform_overview(self, window_s: float = 300.0) -> Dict[str, Any]:
+        """The monitoring plane's aggregate view: per-target health, the
+        fleet-wide serving tail over ``window_s``, and the live alert table
+        — 503 without a plane (there is nothing honest to show)."""
+        if self.tsdb is None:
+            raise HttpError(503, "monitoring plane not wired")
+        import time as _time
+
+        now = _time.time()
+        targets = []
+        durations = {
+            tuple(sorted(labels.items())): value
+            for labels, _ts, value in self.tsdb.latest("scrape_duration_seconds",
+                                                       include_stale=True)
+        }
+        for labels, ts, value in self.tsdb.latest("up", include_stale=True):
+            targets.append({
+                "instance": labels.get("instance", ""),
+                "job": labels.get("job", ""),
+                "up": value,
+                "lastScrapeAgoSeconds": round(max(0.0, now - ts), 3),
+                "scrapeDurationSeconds": durations.get(tuple(sorted(labels.items()))),
+            })
+        serving = {
+            "ttftP99": self.tsdb.histogram_quantile(
+                "serving_ttft_seconds", 0.99, window_s, now),
+            "queueWaitP99": self.tsdb.histogram_quantile(
+                "serving_queue_wait_seconds", 0.99, window_s, now),
+            "windowSeconds": window_s,
+        }
+        rules = getattr(self.monitoring, "rules", None)
+        alerts = rules.snapshot()["alerts"] if rules is not None else []
+        return {
+            "targets": sorted(targets, key=lambda t: t["instance"]),
+            "serving": serving,
+            "alerts": alerts,
+            "series": self.tsdb.stats(),
+        }
 
 
 def make_dashboard_app(
@@ -84,10 +157,11 @@ def make_dashboard_app(
     kfam_app: Optional[App] = None,
     auth: Optional[AuthConfig] = None,
     cache: Optional["InformerCache"] = None,
+    monitoring=None,
 ) -> App:
     cfg = auth or AuthConfig()
     authorizer = Authorizer(client, cfg)
-    metrics = TpuMetricsService(client, cache=cache)
+    metrics = TpuMetricsService(client, cache=cache, monitoring=monitoring)
     app = App("centraldashboard")
     install_auth(app, authorizer, enable_csrf=False)
 
@@ -124,7 +198,13 @@ def make_dashboard_app(
                 raise HttpError(400, "namespace query param required")
             authorizer.ensure(user(req), "list", ns)
             return metrics.namespace_tpu_usage(ns)
-        raise HttpError(400, f"unknown metric {kind!r} (node|namespace)")
+        if kind == "platform":
+            try:
+                window = float(req.query1("window", "300"))
+            except ValueError:
+                raise HttpError(400, "window must be a number") from None
+            return metrics.platform_overview(window_s=window)
+        raise HttpError(400, f"unknown metric {kind!r} (node|namespace|platform)")
 
     @app.route("/api/dashboard-links")
     def links(req: Request):

@@ -12,4 +12,9 @@ from kubeflow_tpu.serving.continuous import ContinuousBatcher  # noqa: F401
 from kubeflow_tpu.serving.controller import InferenceServiceReconciler  # noqa: F401
 from kubeflow_tpu.serving.fleet import EngineFleet  # noqa: F401
 from kubeflow_tpu.serving.router import FleetSaturated, PrefixRouter  # noqa: F401
-from kubeflow_tpu.serving.autoscaler import AutoscalerConfig, SLOAutoscaler  # noqa: F401
+from kubeflow_tpu.serving.autoscaler import (  # noqa: F401
+    AutoscalerConfig,
+    FederatedWindowSource,
+    RegistryWindowSource,
+    SLOAutoscaler,
+)

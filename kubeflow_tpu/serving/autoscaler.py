@@ -51,9 +51,104 @@ class AutoscalerConfig:
 
 @dataclass
 class _Window:
-    """One tick's view of one SLO histogram."""
+    """One tick's view of one SLO histogram. ``stale`` means the source
+    could not produce a TRUSTWORTHY window — no fresh federated series, a
+    frozen timestamp (scrape gap), a counter reset — which is categorically
+    different from ``value is None`` with fresh data (genuinely no traffic):
+    stale holds the fleet, no-traffic counts toward scale-down."""
     value: Optional[float]  # windowed quantile; None with no traffic/window
     samples: int
+    stale: bool = False
+
+
+class RegistryWindowSource:
+    """The original in-process source: snapshot the registry's cumulative
+    bucket counts each tick and quantile the delta since the previous one."""
+
+    name = "registry"
+
+    def __init__(self, registry=METRICS):
+        self._registry = registry
+        self._prev: Dict[str, Tuple[List[int], int]] = {}
+
+    def window(self, metric: str, q: float) -> _Window:
+        snap = self._registry.histogram_counts(metric)
+        if snap is None:
+            return _Window(None, 0)
+        buckets, counts, total = snap
+        prev = self._prev.get(metric)
+        self._prev[metric] = (counts, total)
+        if prev is None:
+            return _Window(None, 0)  # first sight: no window yet
+        dcounts = [c - p for c, p in zip(counts, prev[0])]
+        dtotal = total - prev[1]
+        if dtotal <= 0:
+            return _Window(None, 0)
+        return _Window(quantile_from_counts(buckets, dcounts, dtotal, q), dtotal)
+
+
+class FederatedWindowSource:
+    """Scrape-backed source: quantile the FLEET-WIDE histograms out of the
+    monitoring plane's TSDB instead of whatever registry happens to share
+    the autoscaler's process. Sums the latest fresh ``<metric>_bucket``
+    value per ``le`` across instances and windows the delta between ticks.
+
+    Staleness is first-class: when the scraper stopped delivering (no fresh
+    series, or the newest sample timestamp did not advance since the last
+    tick), the window reports ``stale=True`` and the autoscaler HOLDS — a
+    scrape gap must never read as "the fleet went idle" (the no-flap
+    regression in tests/test_monitoring.py)."""
+
+    name = "federated"
+
+    def __init__(self, tsdb, matchers: Optional[Dict] = None):
+        self.tsdb = tsdb
+        self.matchers = matchers
+        #: metric → (per-le cumulative sums, newest sample ts)
+        self._prev: Dict[str, Tuple[Dict[float, float], float]] = {}
+
+    def _cumulative(self, metric: str) -> Tuple[Dict[float, float], Optional[float]]:
+        per_le: Dict[float, float] = {}
+        newest: Optional[float] = None
+        for labels, ts, value in self.tsdb.latest(f"{metric}_bucket", self.matchers):
+            le_raw = labels.get("le")
+            if le_raw is None:
+                continue
+            le = float("inf") if le_raw in ("+Inf", "inf") else float(le_raw)
+            per_le[le] = per_le.get(le, 0.0) + value
+            newest = ts if newest is None else max(newest, ts)
+        return per_le, newest
+
+    def window(self, metric: str, q: float) -> _Window:
+        per_le, newest = self._cumulative(metric)
+        prev = self._prev.get(metric)
+        if not per_le or newest is None:
+            # nothing fresh in the TSDB: scrape gap, not idleness
+            return _Window(None, 0, stale=True)
+        self._prev[metric] = (per_le, newest)
+        if prev is None:
+            return _Window(None, 0, stale=True)  # first sight: no window yet
+        prev_le, prev_ts = prev
+        if newest <= prev_ts:
+            # every series is frozen since last tick — the target set went
+            # dark between scrapes; frozen counts must not quantile to
+            # "no traffic"
+            return _Window(None, 0, stale=True)
+        deltas = {le: v - prev_le.get(le, 0.0) for le, v in per_le.items()}
+        if any(d < 0 for d in deltas.values()) or float("inf") not in deltas:
+            # counter reset (replica restart) — skip one window
+            return _Window(None, 0, stale=True)
+        finite = sorted(le for le in deltas if le != float("inf"))
+        total = int(round(deltas[float("inf")]))
+        if total <= 0:
+            return _Window(None, 0)  # fresh data, zero traffic: genuine idle
+        counts: List[int] = []
+        prev_cum = 0.0
+        for le in finite:
+            counts.append(int(round(deltas[le] - prev_cum)))
+            prev_cum = deltas[le]
+        counts.append(int(round(deltas[float("inf")] - prev_cum)))
+        return _Window(quantile_from_counts(tuple(finite), counts, total, q), total)
 
 
 class SLOAutoscaler:
@@ -61,15 +156,17 @@ class SLOAutoscaler:
 
     Deterministic by construction: ``tick()`` does one evaluation (tests
     and the e2e driver call it directly); ``start(interval)`` runs it on
-    a timer thread for real deployments.
+    a timer thread for real deployments. ``source`` selects where the
+    quantiles come from: the in-process registry (default) or a
+    :class:`FederatedWindowSource` over the monitoring plane's TSDB.
     """
 
     def __init__(self, fleet, config: Optional[AutoscalerConfig] = None,
-                 registry=METRICS):
+                 registry=METRICS, source=None):
         self.fleet = fleet
         self.config = config or AutoscalerConfig()
         self._registry = registry
-        self._prev: Dict[str, Tuple[List[int], int]] = {}
+        self._source = source if source is not None else RegistryWindowSource(registry)
         self._breach_streak = 0
         self._idle_streak = 0
         self._cooldown = 0
@@ -81,22 +178,7 @@ class SLOAutoscaler:
 
     # -- windowed quantile ---------------------------------------------------
     def _window(self, name: str) -> _Window:
-        snap = self._registry.histogram_counts(name)
-        if snap is None:
-            return _Window(None, 0)
-        buckets, counts, total = snap
-        prev = self._prev.get(name)
-        self._prev[name] = (counts, total)
-        if prev is None:
-            return _Window(None, 0)  # first sight: no window yet
-        dcounts = [c - p for c, p in zip(counts, prev[0])]
-        dtotal = total - prev[1]
-        if dtotal <= 0:
-            return _Window(None, 0)
-        return _Window(
-            quantile_from_counts(buckets, dcounts, dtotal,
-                                 self.config.quantile),
-            dtotal)
+        return self._source.window(name, self.config.quantile)
 
     # -- one evaluation ------------------------------------------------------
     def tick(self) -> Optional[str]:
@@ -112,11 +194,19 @@ class SLOAutoscaler:
         def _idle(w: _Window, slo: float) -> bool:
             return w.value is None or w.value < cfg.scale_down_margin * slo
 
-        breach = _breach(ttft, cfg.ttft_slo) or _breach(qwait, cfg.queue_wait_slo)
-        idle = (not breach
+        stale = ttft.stale or qwait.stale
+        breach = (not stale
+                  and (_breach(ttft, cfg.ttft_slo)
+                       or _breach(qwait, cfg.queue_wait_slo)))
+        idle = (not stale and not breach
                 and _idle(ttft, cfg.ttft_slo)
                 and _idle(qwait, cfg.queue_wait_slo))
-        if breach:
+        if stale:
+            # an untrustworthy window (scrape gap / frozen series) HOLDS:
+            # both streaks reset, no decision — staleness is not idleness
+            self._breach_streak = 0
+            self._idle_streak = 0
+        elif breach:
             self._breach_streak += 1
             self._idle_streak = 0
         elif idle:
@@ -150,6 +240,8 @@ class SLOAutoscaler:
 
         self.last = {
             "tick": self._ticks,
+            "source": self._source.name,
+            "stale": stale,
             "ttft_p": ttft.value, "ttft_samples": ttft.samples,
             "queue_wait_p": qwait.value, "queue_wait_samples": qwait.samples,
             "breach_streak": self._breach_streak,

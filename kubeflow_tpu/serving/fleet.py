@@ -210,8 +210,13 @@ class EngineFleet:
                  replica_chips: int = 0, priority_class: str = "default",
                  poll_interval: float = 0.2, register_debug: bool = True,
                  breaker_factory: Optional[Callable[[], "ReplicaBreaker"]] = None,
-                 retry_budget: Optional[RetryBudget] = None):
+                 retry_budget: Optional[RetryBudget] = None,
+                 metrics_url: Optional[str] = None):
         self.name = name
+        #: /metrics URL replica Pods advertise for monitoring-plane scrape
+        #: discovery (replicas share the ModelServer process, so they all
+        #: advertise ONE URL — the scraper dedups by instance)
+        self._metrics_url = metrics_url
         self._breaker_factory = breaker_factory or ReplicaBreaker
         self.retry_budget = retry_budget or RetryBudget()
         self.min_replicas = max(1, int(min_replicas))
@@ -326,11 +331,20 @@ class EngineFleet:
         if self._replica_chips > 0:
             container["resources"] = {
                 "limits": {RESOURCE_TPU: str(self._replica_chips)}}
+        annotations = {POD_GROUP_SIZE_ANNOTATION: "1"}
+        if self._metrics_url:
+            from ..monitoring.scrape import (SCRAPE_ANNOTATION,
+                                             SCRAPE_JOB_ANNOTATION,
+                                             SCRAPE_URL_ANNOTATION)
+
+            annotations[SCRAPE_ANNOTATION] = "true"
+            annotations[SCRAPE_URL_ANNOTATION] = self._metrics_url
+            annotations[SCRAPE_JOB_ANNOTATION] = self.name
         return apimeta.new_object(
             "v1", "Pod", handle.pod_name, self._namespace,
             labels={POD_GROUP_LABEL: handle.pod_name,
                     "app": "serving-fleet", "fleet": self.name},
-            annotations={POD_GROUP_SIZE_ANNOTATION: "1"},
+            annotations=annotations,
             spec={"priorityClassName": self._priority_class,
                   "containers": [container]})
 

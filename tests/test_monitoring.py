@@ -1,0 +1,615 @@
+"""Monitoring plane (ISSUE 10): OpenMetrics parse/round-trip, the bounded
+TSDB, scrape + staleness + discovery, SLO burn-rate rules with the
+pending→firing→resolved lifecycle and deduplicated Events, the federated
+autoscaler source (including the no-flap-on-scrape-gap regression), and
+the federation-backed dashboard endpoints."""
+
+import re
+import threading
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.monitoring import (
+    SCRAPE_ANNOTATION,
+    SCRAPE_JOB_ANNOTATION,
+    SCRAPE_URL_ANNOTATION,
+    BurnRateWindow,
+    MonitoringPlane,
+    ParseError,
+    RecordingRule,
+    RuleEngine,
+    Scraper,
+    SLOBurnRateAlert,
+    Target,
+    TSDB,
+    install_cluster_collector,
+    parse_exposition,
+    render_exposition,
+)
+from kubeflow_tpu.runtime.metrics import METRICS, MetricsRegistry
+from kubeflow_tpu.runtime.obs import EXPOSITION_CONTENT_TYPE, mount_observability
+from kubeflow_tpu.runtime.tracing import TRACER
+from kubeflow_tpu.serving.autoscaler import (
+    AutoscalerConfig,
+    FederatedWindowSource,
+    SLOAutoscaler,
+)
+from kubeflow_tpu.web.http import App
+
+
+# -- parser -------------------------------------------------------------------
+
+
+class TestParser:
+    def test_round_trips_own_exposition_byte_faithfully(self):
+        """parse → re-expose → parse of METRICS.render() output, exemplars
+        included (the OpenMetrics-compliance satellite)."""
+        reg = MetricsRegistry()
+        reg.counter("req_total", code="200", path="/x").inc(3)
+        reg.gauge("depth").set(2.5)
+        with TRACER.span("obs") as span:
+            reg.histogram("lat_seconds", buckets=(0.1, 0.5), model="m").observe(0.05)
+        text = reg.render()
+        assert text.endswith("# EOF\n")
+        assert f'trace_id="{span.trace_id}"' in text
+        families = parse_exposition(text)
+        assert render_exposition(families) == text
+        again = parse_exposition(render_exposition(families))
+        assert [f.name for f in again] == [f.name for f in families]
+        by_name = {f.name: f for f in families}
+        assert by_name["req_total"].kind == "counter"
+        assert by_name["lat_seconds"].kind == "histogram"
+        bucket = by_name["lat_seconds"].samples[0]
+        assert bucket.labels == {"le": "0.1", "model": "m"}
+        assert bucket.value == 1.0
+        assert span.trace_id in bucket.raw_exemplar
+
+    def test_missing_eof_rejected(self):
+        with pytest.raises(ParseError, match="EOF"):
+            parse_exposition("# TYPE a counter\na 1\n")
+
+    def test_content_after_eof_rejected(self):
+        with pytest.raises(ParseError, match="after # EOF"):
+            parse_exposition("# TYPE a counter\na 1\n# EOF\na 2\n")
+
+    def test_sample_outside_family_rejected(self):
+        with pytest.raises(ParseError, match="does not belong"):
+            parse_exposition("# TYPE a counter\nb 1\n# EOF\n")
+        with pytest.raises(ParseError, match="before any # TYPE"):
+            parse_exposition("a 1\n# EOF\n")
+
+    def test_histogram_suffixes_belong_to_family(self):
+        fams = parse_exposition(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 2\n'
+            "h_sum 0.4\n"
+            "h_count 2\n# EOF\n"
+        )
+        assert [s.name for s in fams[0].samples] == ["h_bucket", "h_sum", "h_count"]
+
+    def test_malformed_lines_rejected(self):
+        for bad in (
+            "# TYPE a counter\na{oops} 1\n# EOF\n",      # junk label set
+            "# TYPE a counter\na 1 2\n# EOF\n",          # extra token
+            "# TYPE a counter\na nope\n# EOF\n",         # bad value
+            "# TYPE a wat\na 1\n# EOF\n",                # unknown kind
+            "# weird comment\n# EOF\n",                  # not TYPE/HELP/EOF
+            "# TYPE a counter\n# TYPE a counter\n# EOF\n",  # duplicate TYPE
+            '# TYPE a counter\na{x="unterminated} 1\n# EOF\n',
+        ):
+            with pytest.raises(ParseError):
+                parse_exposition(bad)
+
+    def test_help_lines_tolerated_and_labels_unescaped(self):
+        fams = parse_exposition(
+            "# HELP a something\n"
+            "# TYPE a gauge\n"
+            'a{msg="line\\nbreak \\"q\\""} 1\n'
+            "# EOF\n"
+        )
+        assert fams[0].samples[0].labels["msg"] == 'line\nbreak "q"'
+
+    def test_must_end_with_newline(self):
+        with pytest.raises(ParseError, match="newline"):
+            parse_exposition("# TYPE a counter\na 1\n# EOF")
+
+
+# -- tsdb ---------------------------------------------------------------------
+
+
+class TestTSDB:
+    def test_ring_buffer_bounds_points(self):
+        db = TSDB(max_points=4)
+        for i in range(10):
+            db.add_sample("m", {"x": "1"}, float(i), float(i))
+        (s,) = db.series("m")
+        assert len(s.points) == 4
+        assert s.points[0] == (6.0, 6.0)
+
+    def test_max_series_evicts_oldest(self):
+        db = TSDB(max_series=3)
+        for i in range(3):
+            db.add_sample("m", {"i": str(i)}, float(i), 1.0)
+        db.add_sample("m", {"i": "new"}, 99.0, 1.0)
+        labels = {s.labels["i"] for s in db.series("m")}
+        assert labels == {"1", "2", "new"}, "oldest-written series evicted"
+
+    def test_matchers_exact_and_regex(self):
+        db = TSDB()
+        db.add_sample("up", {"instance": "a:1", "job": "x"}, 1.0, 1.0)
+        db.add_sample("up", {"instance": "b:2", "job": "y"}, 1.0, 0.0)
+        assert len(db.series("up", {"job": "x"})) == 1
+        assert len(db.series("up", {"instance": re.compile(r"[ab]:\d")})) == 2
+        assert db.series("up", {"job": "z"}) == []
+        assert db.series("up", {"missing": "v"}) == []
+
+    def test_increase_handles_counter_reset(self):
+        db = TSDB()
+        for ts, v in ((1, 10.0), (2, 15.0), (3, 2.0), (4, 5.0)):
+            db.add_sample("c", {}, float(ts), v)
+        # 10→15 (+5), reset to 2 (+2: post-reset value), 2→5 (+3)
+        assert db.increase("c", 10.0, 5.0) == pytest.approx(10.0)
+        assert db.rate("c", 10.0, 5.0) == pytest.approx(1.0)
+
+    def test_increase_windows_exclude_old_points(self):
+        db = TSDB()
+        for ts in range(10):
+            db.add_sample("c", {}, float(ts), float(ts))
+        # the last point BEFORE the window is the baseline (Prometheus
+        # would extrapolate; we anchor): [6,9] with baseline 5 → 4.0
+        assert db.increase("c", 3.0, 9.0) == pytest.approx(4.0)
+
+    def test_windowed_histogram_quantile_across_instances(self):
+        db = TSDB()
+        for inst, slow in (("a:1", 0), ("b:2", 10)):
+            lab = {"instance": inst}
+            # two scrapes: 10 fast obs, then `slow` additional slow obs
+            for le, v0, v1 in (("0.1", 10, 10), ("0.5", 10, 10),
+                               ("+Inf", 10, 10 + slow)):
+                db.add_sample("lat_bucket", {**lab, "le": le}, 1.0, float(v0))
+                db.add_sample("lat_bucket", {**lab, "le": le}, 2.0, float(v1))
+        # window covering both scrapes: 10 slow of 10 total increases, all
+        # in the +Inf bucket — the quantile clamps to the top finite bound
+        q = db.histogram_quantile("lat", 0.5, 1.5, 2.0)
+        assert q == pytest.approx(0.5), "all in-window traffic was slow"
+        # no data in a window before any increase → None, never 0.0
+        assert db.histogram_quantile("lat", 0.5, 0.5, 0.9) is None
+        assert db.histogram_quantile("missing", 0.5, 10.0, 2.0) is None
+
+    def test_mark_stale_and_fresh_write_recovers(self):
+        db = TSDB()
+        db.add_sample("up", {"instance": "a:1"}, 1.0, 1.0)
+        db.add_sample("up", {"instance": "b:2"}, 1.0, 1.0)
+        assert db.mark_stale(instance="a:1") == 1
+        assert {s.labels["instance"] for s in db.series("up")} == {"b:2"}
+        assert len(db.series("up", include_stale=True)) == 2
+        db.add_sample("up", {"instance": "a:1"}, 2.0, 1.0)
+        assert len(db.series("up")) == 2, "fresh write clears staleness"
+
+
+# -- scraper ------------------------------------------------------------------
+
+
+@pytest.fixture()
+def metrics_server():
+    """A real HTTP server exposing a private registry at /metrics."""
+    reg = MetricsRegistry()
+    app = App("scrape-target")
+    mount_observability(app, registry=reg)
+    srv = app.serve(0)
+    try:
+        yield reg, f"http://127.0.0.1:{srv.port}/metrics"
+    finally:
+        srv.close()
+
+
+class TestScraper:
+    def test_scrape_over_http_ingests_with_target_labels(self, metrics_server):
+        reg, url = metrics_server
+        reg.counter("widget_total", kind="a").inc(4)
+        db = TSDB()
+        sc = Scraper(db, targets=[Target(job="ops", url=url)])
+        assert sc.scrape_once(now=100.0) == {Target(job="ops", url=url).instance: True}
+        (labels, ts, v) = db.latest("widget_total")[0]
+        assert v == 4.0 and ts == 100.0
+        assert labels["job"] == "ops" and labels["instance"].startswith("127.0.0.1:")
+        (up_labels, _ts, up) = db.latest("up")[0]
+        assert up == 1.0 and up_labels["job"] == "ops"
+        assert db.latest("scrape_duration_seconds")[0][2] >= 0.0
+        assert db.kind("widget_total") == "counter"
+        assert METRICS.value("monitoring_scrapes_total", result="ok") == 1.0
+        assert METRICS.value("monitoring_scrape_targets") == 1.0
+
+    def test_scraped_instance_label_moves_aside(self, metrics_server):
+        reg, url = metrics_server
+        reg.gauge("g", instance="impostor").set(1.0)
+        db = TSDB()
+        Scraper(db, targets=[Target(job="j", url=url)]).scrape_once(now=1.0)
+        (labels, _ts, _v) = db.latest("g")[0]
+        assert labels["exported_instance"] == "impostor"
+        assert labels["instance"] != "impostor"
+
+    def test_dead_target_up_zero_then_stale(self):
+        reg = MetricsRegistry()
+        reg.counter("widget_total").inc()
+        app = App("mortal-target")
+        mount_observability(app, registry=reg)
+        srv = app.serve(0)
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        db = TSDB()
+        sc = Scraper(db, targets=[Target(job="ops", url=url)], stale_after=2,
+                     timeout_s=0.5)
+        sc.scrape_once(now=1.0)
+        assert db.latest("widget_total"), "first scrape lands"
+        srv.close()  # the target dies
+        sc.scrape_once(now=2.0)
+        assert db.latest("up")[0][2] == 0.0, "up flips immediately"
+        assert db.latest("widget_total"), "one miss < stale_after: still fresh"
+        sc.scrape_once(now=3.0)  # second consecutive miss reaches stale_after
+        assert db.latest("widget_total") == [], "stale after N misses"
+        assert db.latest("widget_total", include_stale=True), "data retained"
+        # up for the dead instance stays fresh (written on every attempt)
+        assert db.latest("up")[0][2] == 0.0
+        assert METRICS.value("monitoring_scrapes_total", result="error") == 2.0
+
+    def test_discovery_from_annotated_pods_dedups_by_instance(self, client, metrics_server):
+        _reg, url = metrics_server
+        for name in ("rep-0", "rep-1"):
+            pod = new_object("v1", "Pod", name, "default", annotations={
+                SCRAPE_ANNOTATION: "true",
+                SCRAPE_URL_ANNOTATION: url,
+                SCRAPE_JOB_ANNOTATION: "fleet",
+            })
+            client.create(pod)
+        client.create(new_object("v1", "Pod", "plain", "default"))
+        sc = Scraper(TSDB(), targets=[Target(job="static", url="http://127.0.0.1:9/m")],
+                     client=client)
+        targets = sc.discover()
+        assert len(targets) == 2, "two pods sharing one URL dedup to one target"
+        jobs = {t.job for t in targets}
+        assert jobs == {"static", "fleet"}
+
+    def test_fleet_pods_carry_scrape_annotations(self, client):
+        from kubeflow_tpu.serving.fleet import EngineFleet
+
+        class _Eng:
+            def __init__(self, engine_id):
+                self.engine_id = engine_id
+
+            def drain(self):
+                return []
+
+            def close(self):
+                pass
+
+        fleet = EngineFleet(replicas=2, name="mon", engine_factory=_Eng,
+                            client=client, register_debug=False,
+                            metrics_url="http://10.0.0.5:8080/metrics")
+        try:
+            pods = client.list("v1", "Pod")
+            assert len(pods) == 2
+            for pod in pods:
+                ann = pod["metadata"]["annotations"]
+                assert ann[SCRAPE_ANNOTATION] == "true"
+                assert ann[SCRAPE_URL_ANNOTATION] == "http://10.0.0.5:8080/metrics"
+                assert ann[SCRAPE_JOB_ANNOTATION] == "mon"
+            assert len({t.instance for t in
+                        Scraper(TSDB(), client=client).discover()}) == 1
+        finally:
+            fleet.close()
+
+
+# -- rules --------------------------------------------------------------------
+
+
+def _write_histogram(db, metric, now, fast, slow, instance="a:1"):
+    """Append one scrape's worth of cumulative bucket samples: ``fast``
+    observations ≤0.1s and ``slow`` ones of ~1s (land in the 2.5 bucket,
+    so a slow-heavy window quantiles to 2.5 — well past a 0.5s SLO)."""
+    lab = {"instance": instance, "job": "serving"}
+    db.set_kind(metric, "histogram",
+                (f"{metric}_bucket", f"{metric}_sum", f"{metric}_count"))
+    for le, cum in (("0.1", fast), ("0.5", fast),
+                    ("2.5", fast + slow), ("+Inf", fast + slow)):
+        db.add_sample(f"{metric}_bucket", {**lab, "le": le}, now, float(cum))
+    db.add_sample(f"{metric}_count", lab, now, float(fast + slow))
+    db.add_sample(f"{metric}_sum", lab, now, 0.05 * fast + 1.0 * slow)
+
+
+def _feed_serving(db, now, fast, slow):
+    """Both autoscaler SLO histograms from one pretend scrape."""
+    _write_histogram(db, "serving_ttft_seconds", now, fast, slow)
+    _write_histogram(db, "serving_queue_wait_seconds", now, fast, 0)
+
+
+WINDOWS = (BurnRateWindow(short_s=10.0, long_s=30.0, factor=2.0, severity="page"),)
+
+
+class TestBurnRateRules:
+    def _alert(self, **kw):
+        base = dict(name="TtftBurn", metric="lat", threshold_s=0.1,
+                    objective=0.9, windows=WINDOWS, for_s=0.0)
+        base.update(kw)
+        return SLOBurnRateAlert(**base)
+
+    def test_no_data_is_inactive_not_firing(self):
+        db = TSDB()
+        engine = RuleEngine(db)
+        engine.add(self._alert())
+        (s,) = engine.evaluate(now=100.0)
+        assert s["state"] == "inactive"
+        assert s["burn_short"] is None and s["burn_long"] is None
+        assert METRICS.value("alerts_firing", alertname="TtftBurn",
+                             severity="page") == 0.0
+
+    def test_lifecycle_pending_firing_resolved_with_dedup_event(self, client):
+        db = TSDB()
+        # repeat_s=1 so every synthetic-second eval re-emits (and the
+        # recorder must aggregate, not spam)
+        engine = RuleEngine(db, client=client, repeat_s=1.0)
+        alert = self._alert(for_s=2.0)
+        engine.add(alert)
+        # healthy baseline: all fast
+        for i, t in enumerate((0.0, 1.0)):
+            _write_histogram(db, "lat", t, fast=10 * (i + 1), slow=0)
+        (s,) = engine.evaluate(now=1.0)
+        assert s["state"] == "inactive"
+        # latency burst: everything lands above the threshold
+        fast, slow = 20, 0
+        for t in (2.0, 3.0):
+            slow += 50
+            _write_histogram(db, "lat", t, fast=fast, slow=slow)
+            (s,) = engine.evaluate(now=t)
+        assert s["state"] == "pending", "for_s not yet served"
+        for t in (4.0, 5.0):
+            slow += 50
+            _write_histogram(db, "lat", t, fast=fast, slow=slow)
+            (s,) = engine.evaluate(now=t)
+        assert s["state"] == "firing"
+        assert METRICS.value("alerts_firing", alertname="TtftBurn",
+                             severity="page") == 1.0
+        # several more firing evals: ONE Warning Event, count climbing
+        for t in (6.0, 7.0):
+            slow += 50
+            _write_histogram(db, "lat", t, fast=fast, slow=slow)
+            engine.evaluate(now=t)
+        warnings = [e for e in client.list("v1", "Event", "kubeflow-system")
+                    if e["reason"] == "TtftBurn"]
+        assert len(warnings) == 1, "firing evals must aggregate, not spam"
+        assert warnings[0]["count"] >= 3
+        assert warnings[0]["type"] == "Warning"
+        assert "burn" in warnings[0]["message"]
+        # recovery: fast traffic pushes the short window under the factor;
+        # wait out the long window too
+        for t in (40.0, 41.0, 42.0):
+            fast += 500
+            _write_histogram(db, "lat", t, fast=fast, slow=slow)
+            (s,) = engine.evaluate(now=t)
+        assert s["state"] == "resolved"
+        assert METRICS.value("alerts_firing", alertname="TtftBurn",
+                             severity="page") == 0.0
+        resolved = [e for e in client.list("v1", "Event", "kubeflow-system")
+                    if e["reason"] == "TtftBurnResolved"]
+        assert len(resolved) == 1 and resolved[0]["type"] == "Normal"
+
+    def test_scrape_gap_holds_firing_state(self):
+        """No data must not auto-resolve a page (the rules-side twin of the
+        autoscaler's no-flap hold)."""
+        db = TSDB(max_points=16)
+        engine = RuleEngine(db)
+        engine.add(self._alert())
+        _write_histogram(db, "lat", 0.0, fast=5, slow=0)
+        _write_histogram(db, "lat", 1.0, fast=5, slow=100)
+        (s,) = engine.evaluate(now=1.0)
+        assert s["state"] == "firing"
+        # windows advance past every sample: burn becomes None, state holds
+        (s,) = engine.evaluate(now=500.0)
+        assert s["burn_short"] is None
+        assert s["state"] == "firing", "scrape gap must hold, not resolve"
+
+    def test_threshold_must_sit_inside_objective_bounds(self):
+        with pytest.raises(ValueError):
+            self._alert(objective=1.5)
+
+    def test_recording_rule_writes_gauge_series(self):
+        db = TSDB()
+        engine = RuleEngine(db)
+        engine.add(RecordingRule(
+            record="job:up:count",
+            fn=lambda tsdb, now: [({}, float(len(tsdb.latest("up"))))],
+        ))
+        db.set_kind("up", "gauge")
+        db.add_sample("up", {"instance": "a:1"}, 1.0, 1.0)
+        engine.evaluate(now=2.0)
+        assert db.latest("job:up:count")[0][2] == 1.0
+        assert db.kind("job:up:count") == "gauge"
+        assert engine.snapshot()["recording_rules"] == ["job:up:count"]
+
+    def test_broken_recording_rule_counted_not_fatal(self):
+        db = TSDB()
+        engine = RuleEngine(db)
+        engine.add(RecordingRule(record="boom", fn=lambda t, n: 1 / 0))
+        engine.evaluate(now=1.0)
+        assert METRICS.value("monitoring_rule_failures_total", record="boom") == 1.0
+
+
+# -- federated autoscaler -----------------------------------------------------
+
+
+def _scaler(db, **kw):
+    from tests.test_fleet import FakeScalableFleet
+
+    cfg = dict(ttft_slo=0.5, queue_wait_slo=0.25, quantile=0.99,
+               scale_down_margin=0.5, breach_ticks=2, idle_ticks=2,
+               cooldown_ticks=0)
+    cfg.update(kw)
+    fleet = FakeScalableFleet(n=2)
+    asc = SLOAutoscaler(fleet, AutoscalerConfig(**cfg),
+                        source=FederatedWindowSource(db))
+    return fleet, asc
+
+
+class TestFederatedAutoscaler:
+    def test_scales_up_on_scraped_breach(self):
+        db = TSDB()
+        fleet, asc = _scaler(db)
+        _feed_serving(db, 0.0, fast=10, slow=0)
+        assert asc.tick() is None  # first sight: stale (no window)
+        assert asc.last["stale"] is True
+        slow = 0
+        for t in (1.0, 2.0):
+            slow += 50
+            _feed_serving(db, t, fast=10, slow=slow)
+            asc.tick()
+        assert fleet.calls == [(3, "slo_breach")]
+        assert asc.last["source"] == "federated"
+        assert asc.last["stale"] is False
+
+    def test_scrape_gap_holds_replicas_not_idle(self):
+        """THE no-flap regression: a target going dark freezes the
+        federated series; frozen must hold the fleet, not scale it down."""
+        db = TSDB()
+        fleet, asc = _scaler(db, idle_ticks=4)
+        # fast traffic: the idle streak is at 2 of 4 when the gap starts —
+        # counting stale ticks as idle would finish the streak and flap
+        for t in (0.0, 1.0, 2.0):
+            _feed_serving(db, t, fast=int(10 * (t + 1)), slow=0)
+            asc.tick()
+        assert asc.last["idle_streak"] == 2
+        # scrape gap: no new samples, many ticks — timestamps frozen
+        for _ in range(6):
+            assert asc.tick() is None
+            assert asc.last["stale"] is True
+        assert fleet.calls == [], "staleness treated as idle ⇒ flap (bug)"
+        assert asc.last["idle_streak"] == 0
+        # series formally marked stale (target dead) behave the same
+        db.mark_stale(instance="a:1")
+        for _ in range(3):
+            assert asc.tick() is None
+            assert asc.last["stale"] is True
+        assert fleet.calls == []
+
+    def test_fresh_but_quiet_series_still_scale_down(self):
+        """The contrast case: the scraper keeps delivering (timestamps
+        advance) and traffic is genuinely zero — THAT is idle."""
+        db = TSDB()
+        fleet, asc = _scaler(db, idle_ticks=2)
+        for t in range(6):
+            _feed_serving(db, float(t), fast=10, slow=0)
+            asc.tick()
+        assert (1, "idle") in fleet.calls
+
+    def test_counter_reset_skips_one_window(self):
+        db = TSDB()
+        fleet, asc = _scaler(db)
+        _feed_serving(db, 0.0, fast=100, slow=0)
+        asc.tick()
+        _feed_serving(db, 1.0, fast=200, slow=0)
+        asc.tick()
+        # replica restart: cumulative counts drop
+        _feed_serving(db, 2.0, fast=5, slow=0)
+        asc.tick()
+        assert asc.last["stale"] is True
+        assert fleet.calls == []
+
+
+# -- plane / federation / dashboard -------------------------------------------
+
+
+class TestPlaneAndFederation:
+    def test_tick_federate_and_debug_alerts(self, metrics_server):
+        reg, url = metrics_server
+        reg.counter("widget_total").inc(2)
+        plane = MonitoringPlane(targets=[Target(job="ops", url=url)])
+        plane.rules.add(SLOBurnRateAlert(
+            name="X", metric="widget", threshold_s=0.1, objective=0.9,
+            windows=WINDOWS))
+        plane.tick(now=1.0)
+        text = plane.federate_text()
+        fams = parse_exposition(text)  # federation speaks our own dialect
+        by_name = {f.name: f for f in fams}
+        assert "up" in by_name and by_name["up"].kind == "gauge"
+        sample = by_name["widget_total"].samples[0]
+        assert sample.labels["job"] == "ops" and sample.value == 2.0
+        app = App("monitor")
+        mount_observability(app)
+        plane.mount(app)
+        resp = app.call("GET", "/federate")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == EXPOSITION_CONTENT_TYPE
+        assert parse_exposition(resp.body)
+        alerts = app.call("GET", "/debug/alerts").body
+        assert alerts["evaluations"] == 1
+        assert alerts["alerts"][0]["alertname"] == "X"
+
+    def test_stale_series_excluded_from_federation(self):
+        db = TSDB()
+        db.set_kind("up", "gauge")
+        db.add_sample("up", {"instance": "a:1"}, 1.0, 1.0)
+        db.add_sample("up", {"instance": "b:2"}, 1.0, 1.0)
+        db.mark_stale(instance="a:1")
+        plane = MonitoringPlane(tsdb=db)
+        text = plane.federate_text()
+        assert 'instance="b:2"' in text and 'instance="a:1"' not in text
+
+    def test_cluster_collector_federates_node_utilization(self, client):
+        node = new_object("v1", "Node", "tpu-node", None)
+        node["status"] = {"capacity": {"google.com/tpu": "4"}}
+        client.create(node)
+        pod = new_object("v1", "Pod", "worker", "default")
+        pod["spec"] = {"nodeName": "tpu-node", "containers": [
+            {"name": "c", "resources": {"limits": {"google.com/tpu": "2"}}}]}
+        client.create(pod)
+        reg = MetricsRegistry()
+        install_cluster_collector(client, registry=reg)
+        text = reg.render()
+        assert 'node_tpu_capacity_chips{node="tpu-node"} 4' in text
+        assert 'node_tpu_allocated_chips{node="tpu-node"} 2' in text
+
+    def test_dashboard_platform_and_node_endpoints(self, client):
+        from kubeflow_tpu.web.auth import AuthConfig
+        from kubeflow_tpu.services.dashboard import make_dashboard_app
+
+        db = TSDB()
+        db.set_kind("up", "gauge")
+        db.add_sample("up", {"instance": "a:1", "job": "ops"}, 1.0, 1.0)
+        db.set_kind("scrape_duration_seconds", "gauge")
+        db.add_sample("scrape_duration_seconds",
+                      {"instance": "a:1", "job": "ops"}, 1.0, 0.01)
+        db.set_kind("node_tpu_capacity_chips", "gauge")
+        db.add_sample("node_tpu_capacity_chips", {"node": "n1"}, 1.0, 4.0)
+        db.set_kind("node_tpu_allocated_chips", "gauge")
+        db.add_sample("node_tpu_allocated_chips", {"node": "n1"}, 1.0, 1.0)
+        plane = MonitoringPlane(tsdb=db)
+        app = make_dashboard_app(client, auth=AuthConfig(disable_auth=True),
+                                 monitoring=plane)
+        hdr = {"kubeflow-userid": "alice@example.com"}
+        overview = app.call("GET", "/api/metrics/platform", None, hdr)
+        assert overview.status == 200
+        (target,) = overview.body["targets"]
+        assert target["instance"] == "a:1" and target["up"] == 1.0
+        assert target["scrapeDurationSeconds"] == 0.01
+        assert overview.body["serving"]["ttftP99"] is None  # no data ≠ 0.0
+        nodes = app.call("GET", "/api/metrics/node", None, hdr).body
+        assert nodes == [{"node": "n1", "capacityChips": 4, "allocatedChips": 1,
+                          "utilization": 0.25, "source": "federated"}]
+        # without a plane the endpoint refuses rather than lying
+        bare = make_dashboard_app(client, auth=AuthConfig(disable_auth=True))
+        assert bare.call("GET", "/api/metrics/platform", None, hdr).status == 503
+
+    def test_plane_background_loop_runs_and_stops(self, metrics_server):
+        reg, url = metrics_server
+        reg.gauge("g").set(1.0)
+        plane = MonitoringPlane(targets=[Target(job="j", url=url)])
+        plane.start(interval_s=0.02)
+        try:
+            deadline = threading.Event()
+            for _ in range(100):
+                if plane.tsdb.latest("g"):
+                    break
+                deadline.wait(0.02)
+            assert plane.tsdb.latest("g"), "background tick never scraped"
+        finally:
+            plane.stop()
+        assert plane.rules.evaluations >= 1

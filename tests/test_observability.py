@@ -68,7 +68,19 @@ class TestRegistry:
         reg.histogram("lat", buckets=(0.1,), model="a").observe(0.05)
         reg.histogram("lat", buckets=(0.1,), model="b").observe(99.0)  # +Inf bucket
         assert reg.quantile("lat", 0.99) == 0.1  # clamped to largest finite bound
-        assert reg.quantile("missing", 0.5) == 0.0
+
+    def test_quantile_no_data_is_none_not_zero(self):
+        """The boundary the SLO rules depend on: a missing or never-observed
+        histogram quantiles to None — 0.0 would read as 'perfect latency'."""
+        from kubeflow_tpu.runtime.metrics import quantile_from_counts
+
+        reg = MetricsRegistry()
+        assert reg.quantile("missing", 0.5) is None
+        reg.histogram("empty", buckets=(0.1, 0.5))  # registered, never observed
+        assert reg.quantile("empty", 0.99) is None
+        assert quantile_from_counts((0.1, 0.5), [0, 0, 0], 0, 0.99) is None
+        ns = reg.namespace("sub")
+        assert ns.quantile("missing_too", 0.5) is None
 
     def test_exemplar_from_current_span(self):
         reg = MetricsRegistry()
@@ -106,14 +118,18 @@ SAMPLE_RE = re.compile(
 
 def assert_valid_exposition(text: str) -> None:
     """Line-by-line exposition check: every line is a TYPE line or a sample,
-    histogram buckets are cumulative-monotone, and _count equals +Inf."""
+    histogram buckets are cumulative-monotone, _count equals +Inf, and the
+    document ends with the OpenMetrics ``# EOF`` terminator."""
     assert text.endswith("\n")
+    lines = text.splitlines()
+    assert lines and lines[-1] == "# EOF", "missing # EOF terminator"
     buckets = {}  # series key -> [(le, count)]
     counts = {}
-    for line in text.splitlines():
+    for line in lines[:-1]:
         if not line:
             continue
         if line.startswith("#"):
+            assert line != "# EOF", "# EOF before end of document"
             assert TYPE_RE.match(line), f"bad TYPE line: {line!r}"
             continue
         m = SAMPLE_RE.match(line)
@@ -146,7 +162,8 @@ class TestExpositionSurface:
         try:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{srv.port}/metrics", timeout=30) as resp:
-                assert resp.headers["Content-Type"].startswith("text/plain; version=0.0.4")
+                assert resp.headers["Content-Type"].startswith(
+                    "application/openmetrics-text; version=1.0.0")
                 text = resp.read().decode()
             assert_valid_exposition(text)
             assert "# TYPE controller_reconcile_seconds histogram" in text
