@@ -1,0 +1,204 @@
+"""Control-plane scale e2e: a seeded synthetic topology driven over REAL
+HTTP, asserting the ISSUE 11 observability surface end to end (CI job
+controlplane-scale-e2e; periodic run sets SCALE_NODES=5000).
+
+Boots Store + apiserver App on a real listener with the gang scheduler +
+podlet reconciling in-process, then via :class:`~kubeflow_tpu.scale.loadgen.
+LoadGenerator`:
+
+1. registers a seeded ``synthesize(SCALE_NODES)`` topology and submits two
+   gang-arrival waves, waiting for every pod to bind,
+2. submits one DOOMED gang (chips/pod beyond any node) into the largest
+   pool and asserts the flight recorder's verdict list is truncated: at
+   most ``verdict_top_k`` exact rows plus aggregated ``...and N more
+   nodes: reason`` summaries, never one row per node,
+3. runs a watch storm (concurrent NDJSON streams + mass relists) and pod
+   churn / node kills between two monitoring-plane scrapes,
+4. scrapes ``/metrics`` directly (bind-latency histogram populated, watch
+   fanout counter moved, cycles/sec gauge live) AND through the PR 10
+   monitoring plane (Scraper -> TSDB), asserting the new SLIs are
+   queryable: ``scheduler_cycles_per_sec`` latest, windowed
+   ``histogram_quantile`` over ``scheduler_bind_latency_seconds`` and the
+   storm's ``apiserver_request_seconds{verb="list"}``, and
+   ``workqueue_saturation`` per queue.
+
+Exit 0 on success, 1 with a JSON failure report otherwise. CPU-only; the
+presubmit topology (500 nodes) keeps the whole run in tens of seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+SEED = 11
+SCALE_NODES = int(os.environ.get("SCALE_NODES", "500"))
+WAVE_GANGS = int(os.environ.get("SCALE_GANGS", "6"))
+VERDICT_TOP_K = 8
+
+
+def _metric_value(text: str, name: str, **labels) -> float:
+    """Sum of series for ``name`` whose label set includes ``labels``."""
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue  # e.g. name_bucket / name_count suffixes
+        if all(f'{k}="{v}"' in rest for k, v in labels.items()):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _poll(fn, timeout: float = 30.0, interval: float = 0.1, desc: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+
+
+def run() -> dict:
+    from kubeflow_tpu.apiserver.server import make_apiserver_app
+    from kubeflow_tpu.apiserver.store import Store
+    from kubeflow_tpu.controllers.builtin import PodletReconciler
+    from kubeflow_tpu.monitoring.scrape import Scraper, Target
+    from kubeflow_tpu.monitoring.tsdb import TSDB
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.scale.loadgen import LoadGenerator
+    from kubeflow_tpu.scale.topology import GangShape, synth_gangs, synthesize
+    from kubeflow_tpu.scheduler import SchedulerReconciler
+
+    topo = synthesize(SCALE_NODES, seed=SEED)
+    store = Store()
+    mgr = Manager(store)
+    mgr.add(SchedulerReconciler(
+        assembly_timeout=10.0, reservation_ttl=5.0,
+        backoff_base=0.05, backoff_cap=0.5, verdict_top_k=VERDICT_TOP_K))
+    mgr.add(PodletReconciler())
+    app = make_apiserver_app(store)
+    httpd = app.serve(0)
+    base = f"http://127.0.0.1:{httpd.port}"
+    mgr.start()
+    try:
+        gen = LoadGenerator(base, topo, seed=SEED)
+        registered = gen.register_nodes()
+        assert registered == topo.total_nodes, (registered, topo.total_nodes)
+
+        tsdb = TSDB()
+        scraper = Scraper(tsdb, targets=[Target(job="apiserver", url=f"{base}/metrics")])
+
+        # -- wave 1: seeded gang arrivals, all must bind ---------------------
+        shapes = synth_gangs(topo, WAVE_GANGS, seed=SEED, prefix="wave1", max_size=6)
+        gen.gang_wave(shapes)
+        gen.wait_gangs_bound([s.name for s in shapes], timeout_s=90.0)
+
+        up = scraper.scrape_once()  # baseline points: windowed increase needs two
+        assert all(up.values()), f"monitoring scrape must reach the apiserver: {up}"
+
+        # -- wave 2 + storm between the two scrapes --------------------------
+        wave2 = synth_gangs(topo, WAVE_GANGS, seed=SEED + 1, prefix="wave2", max_size=6)
+        gen.gang_wave(wave2)
+        gen.wait_gangs_bound([s.name for s in wave2], timeout_s=90.0)
+
+        storm = gen.watch_storm(streams=8, relists=24, duration_s=1.5)
+        assert storm["lists"] >= 24 and storm["watch_events"] > 0, storm
+        churned = gen.churn_pods(0.25)
+        killed = gen.kill_nodes(max(1, topo.total_nodes // 100))
+
+        # -- doomed gang: force verdict truncation over a big pool -----------
+        big_pool = max(topo.pools, key=lambda p: p.nodes)
+        assert big_pool.nodes > VERDICT_TOP_K, "need a pool larger than top_k"
+        doomed = GangShape(name="doomed", size=2,
+                           chips_per_pod=big_pool.chips_per_node * 4,
+                           selector=big_pool.selector())
+        gen.submit_gang(doomed)
+
+        def truncated_decision():
+            doc = gen._get("/debug/scheduler?gang=default/doomed&limit=64")
+            hits = [d for d in doc["decisions"] if d["outcome"] == "unschedulable"]
+            return hits[-1] if hits else None
+
+        decision = _poll(truncated_decision, timeout=30.0,
+                         desc="unschedulable decision for default/doomed")
+        nodes = decision.get("nodes") or []
+        summaries = [v for v in nodes if v.get("truncated")]
+        exact = [v for v in nodes if not v.get("truncated")]
+        assert summaries, f"verdicts must carry an aggregated tail: {nodes[:3]}"
+        assert len(exact) <= VERDICT_TOP_K, \
+            f"flight recorder kept {len(exact)} exact verdicts (top_k={VERDICT_TOP_K})"
+        truncated_total = sum(v["truncated"] for v in summaries)
+        assert len(exact) + truncated_total >= big_pool.nodes - 1, \
+            "summary counts must cover the whole candidate pool"
+        # dominant reason and message were derived from the FULL verdict
+        # list before truncation — they stay exact
+        assert decision.get("reason") and decision.get("message"), decision
+
+        # -- /metrics direct: the new SLIs exist at the source ---------------
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        bind_count = _metric_value(text, "scheduler_bind_latency_seconds_count")
+        assert bind_count >= WAVE_GANGS * 2, \
+            f"bind-latency histogram must cover both waves (count={bind_count})"
+        cycles = _metric_value(text, "scheduler_cycles_per_sec")
+        assert cycles > 0, "cycles/sec gauge must be live while reconciling"
+        assert _metric_value(text, "apiserver_watch_events_sent_total") > 0
+        assert _metric_value(text, "workqueue_saturation", queue="SchedulerReconciler") >= 0
+        assert _metric_value(
+            text, "apiserver_request_seconds_count", verb="list", resource="pods") > 0
+
+        # -- monitoring plane: the SLIs are queryable after federation -------
+        up = scraper.scrape_once()
+        assert all(up.values()), f"second scrape must succeed: {up}"
+        now = time.time()
+        cycles_latest = tsdb.latest("scheduler_cycles_per_sec")
+        assert cycles_latest, "TSDB must hold the cycles/sec gauge"
+        bind_p99 = tsdb.histogram_quantile(
+            "scheduler_bind_latency_seconds", 0.99, 600.0, now)
+        assert bind_p99 is not None and bind_p99 >= 0.0, bind_p99
+        list_p99 = tsdb.histogram_quantile(
+            "apiserver_request_seconds", 0.99, 600.0, now, matchers={"verb": "list"})
+        assert list_p99 is not None and list_p99 >= 0.0, \
+            "storm list latency must be queryable from the TSDB"
+        saturation = tsdb.latest("workqueue_saturation")
+        assert any(lbl.get("queue") == "SchedulerReconciler"
+                   for lbl, _ts, _v in saturation), saturation
+
+        return {
+            "ok": True,
+            "nodes": topo.total_nodes,
+            "pools": len(topo.pools),
+            "gangs_bound": len(shapes) + len(wave2),
+            "storm": storm,
+            "churned": churned,
+            "killed": len(killed),
+            "verdicts_exact": len(exact),
+            "verdicts_truncated": truncated_total,
+            "bind_count": bind_count,
+            "cycles_per_sec": cycles,
+            "bind_p99_s": round(bind_p99, 4),
+            "list_p99_s": round(list_p99, 6),
+        }
+    finally:
+        httpd.close()
+        mgr.stop()
+
+
+def main() -> int:
+    try:
+        report = run()
+    except AssertionError as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 1
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,9 +16,13 @@ from .store import NotFound, Store
 
 
 class Client:
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, event_retention: Optional[int] = None):
         self.store = store
         self._events: Optional["EventRecorder"] = None
+        #: overrides EventRecorder's max_events GC cap when set — scale
+        #: harnesses raise it so thousands of live gangs keep aggregating
+        #: instead of churning the retention GC (see runtime/events.py)
+        self.event_retention = event_retention
 
     def _res(self, api_version: str, kind: str) -> Resource:
         return REGISTRY.for_kind(api_version, kind)
@@ -92,7 +96,10 @@ class Client:
         if self._events is None:
             from ..runtime.events import EventRecorder
 
-            self._events = EventRecorder(self)
+            if self.event_retention is not None:
+                self._events = EventRecorder(self, max_events=self.event_retention)
+            else:
+                self._events = EventRecorder(self)
         return self._events
 
     def emit_event(

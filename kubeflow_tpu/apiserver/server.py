@@ -395,6 +395,9 @@ def _watch_stream(
         # thread + Store registration forever on a quiet resource.
         import queue as _queue
 
+        # per-event fanout counter: N watchers on a busy resource multiply
+        # every write by N here — the storm SLI the scale harness reads
+        sent = METRICS.counter("apiserver_watch_events_sent_total", resource=res.plural)
         while True:
             try:
                 # next_event, never .queue: preloaded initial-list/RV-replay
@@ -409,6 +412,7 @@ def _watch_stream(
                 yield json.dumps({"type": "SYNC", "object": item.object}).encode() + b"\n"
                 continue
             obj = convert(item.object, res.group, res.kind, res.version)
+            sent.inc()
             yield json.dumps({"type": item.type, "object": obj}).encode() + b"\n"
 
     return StreamingResponse(

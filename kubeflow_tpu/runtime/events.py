@@ -50,6 +50,9 @@ class _AggEntry:
     count: int
     #: spam bookkeeping rides the entry so both caches expire together
     first_seen: float = field(default_factory=time.monotonic)
+    #: last emit that touched this key — evicting a recently-active entry
+    #: means the cap, not natural quiescence, forced it out
+    last_seen: float = field(default_factory=time.monotonic)
 
 
 class _TokenBucket:
@@ -103,11 +106,16 @@ class EventRecorder:
         max_events: int = 256,
         burst: int = 25,
         refill_per_second: float = 1.0 / 30.0,
+        live_window_s: float = 60.0,
     ) -> None:
         self.client = client
         self.max_events = max_events
         self.burst = burst
         self.refill_per_second = refill_per_second
+        #: an evicted entry emitted within this window counts as still-live
+        #: (events_retention_saturated_total) — the cap is too small for
+        #: the active set, not merely sweeping out dead history
+        self.live_window_s = live_window_s
         self._lock = threading.Lock()
         #: insertion-ordered correlation cache — doubles as the GC ledger
         self._agg: Dict[AggKey, _AggEntry] = {}
@@ -149,8 +157,15 @@ class EventRecorder:
             while len(self._agg) > self.max_events:
                 old_key = next(iter(self._agg))
                 doomed.append(self._agg.pop(old_key))
+        now = time.monotonic()
         for old in doomed:  # retention GC: store deletes happen off-lock
             METRICS.counter("events_retention_deleted_total").inc()
+            if now - old.last_seen < self.live_window_s:
+                # the cap forced out a dedup key that was still taking
+                # emits — its next duplicate will mint a brand-new Event
+                # (count resets), so aggregation quality degrades; raise
+                # max_events when this counter moves under load
+                METRICS.counter("events_retention_saturated_total").inc()
             self.client.delete_opt("v1", "Event", old.name, old.namespace)
         return ev
 
@@ -178,6 +193,7 @@ class EventRecorder:
 
         with self._lock:
             entry.count += 1
+            entry.last_seen = time.monotonic()
             count = entry.count
         try:
             ev = self.client.patch(

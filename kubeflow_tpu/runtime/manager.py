@@ -100,6 +100,12 @@ class _WorkQueue:
             unfinished = sum(now - t for t in self._inflight.values())
         METRICS.gauge("workqueue_depth", queue=self.name).set(depth)
         METRICS.gauge("workqueue_unfinished_work_seconds", queue=self.name).set(unfinished)
+        # backlog pressure in [0, 1): 0 when the worker keeps up (nothing
+        # queued), -> 1 as keys pile up faster than the single worker
+        # drains them — depth/(depth+workers) for this one-worker queue; a
+        # busy worker with an empty queue is healthy, not saturated.
+        METRICS.gauge("workqueue_saturation", queue=self.name).set(
+            round(depth / (depth + 1.0), 6))
 
     def add(self, req: Request) -> None:
         with self._cond:

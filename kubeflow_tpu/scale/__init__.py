@@ -1,0 +1,21 @@
+"""Control-plane scale observatory: synthetic topologies + seeded load.
+
+``topology`` builds deterministic thousand-node clusters (pools, selectors,
+gang shapes) from a seed; ``loadgen`` drives gang-arrival waves, pod churn,
+node kills, and watch storms against the real apiserver+scheduler stack
+over HTTP. Together they are the harness behind ``tools/bench_controlplane``
+and ``e2e/controlplane_scale_driver.py`` (ROADMAP item 5).
+"""
+
+from .topology import POOL_LABEL, GangShape, PoolSpec, SyntheticTopology, synth_gangs, synthesize
+from .loadgen import LoadGenerator
+
+__all__ = [
+    "POOL_LABEL",
+    "GangShape",
+    "PoolSpec",
+    "SyntheticTopology",
+    "synth_gangs",
+    "synthesize",
+    "LoadGenerator",
+]

@@ -1,0 +1,123 @@
+"""Deterministic synthetic cluster topologies for control-plane scale runs.
+
+A :class:`SyntheticTopology` is fully determined by ``(num_nodes, seed)``:
+the same inputs produce byte-identical node objects, pool splits, and gang
+shapes on every run, so bench rows and parity tests are reproducible. Nodes
+are shaped exactly like the GKE-style fixtures the controllers already
+understand (``make_tpu_node``), with one extra pool label the scheduler's
+indexed ledger can group on.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..controllers.builtin import make_tpu_node
+
+POOL_LABEL = "scale.kubeflow.org/pool"
+
+# (generation, topology label, chips per node) — the slice shapes real
+# GKE TPU node pools come in; chips/node stays small so gangs span nodes.
+_POOL_KINDS = (
+    ("v4", "2x2x1", 4),
+    ("v5e", "2x4", 8),
+    ("v5e", "2x2", 4),
+    ("v5p", "2x2x4", 16),
+)
+
+
+@dataclass(frozen=True)
+class PoolSpec:
+    name: str
+    generation: str
+    topology: str
+    chips_per_node: int
+    nodes: int
+
+    def selector(self) -> Dict[str, str]:
+        return {POOL_LABEL: self.name}
+
+
+@dataclass(frozen=True)
+class GangShape:
+    name: str
+    size: int
+    chips_per_pod: int
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SyntheticTopology:
+    seed: int
+    pools: List[PoolSpec]
+
+    @property
+    def total_nodes(self) -> int:
+        return sum(p.nodes for p in self.pools)
+
+    @property
+    def total_chips(self) -> int:
+        return sum(p.nodes * p.chips_per_node for p in self.pools)
+
+    def pool(self, name: str) -> PoolSpec:
+        for p in self.pools:
+            if p.name == name:
+                return p
+        raise KeyError(name)
+
+    def node_name(self, pool: PoolSpec, i: int) -> str:
+        return f"{pool.name}-node-{i:05d}"
+
+    def nodes(self) -> Iterator[Dict[str, Any]]:
+        """Node objects in deterministic order (pool by pool)."""
+        for pool in self.pools:
+            for i in range(pool.nodes):
+                node = make_tpu_node(
+                    self.node_name(pool, i), pool.generation, pool.topology,
+                    pool.chips_per_node)
+                node["metadata"]["labels"][POOL_LABEL] = pool.name
+                yield node
+
+    def node_names(self) -> List[str]:
+        return [self.node_name(p, i) for p in self.pools for i in range(p.nodes)]
+
+
+def synthesize(num_nodes: int, seed: int = 0,
+               num_pools: Optional[int] = None) -> SyntheticTopology:
+    """Split ``num_nodes`` across a few heterogeneous pools, seeded."""
+    # string seeds stay deterministic across processes (tuple seeds hash)
+    rng = random.Random(f"topology:{seed}:{num_nodes}")
+    if num_pools is None:
+        num_pools = max(1, min(len(_POOL_KINDS), num_nodes // 50 or 1))
+    # seeded weights decide the split; every pool gets at least one node
+    weights = [rng.uniform(0.5, 1.5) for _ in range(num_pools)]
+    total_w = sum(weights)
+    counts = [max(1, int(num_nodes * w / total_w)) for w in weights]
+    counts[0] += num_nodes - sum(counts)  # absorb rounding in the first pool
+    pools = []
+    for i, count in enumerate(counts):
+        generation, topo, chips = _POOL_KINDS[i % len(_POOL_KINDS)]
+        pools.append(PoolSpec(
+            name=f"pool-{i}-{generation}", generation=generation,
+            topology=topo, chips_per_node=chips, nodes=count))
+    return SyntheticTopology(seed=seed, pools=pools)
+
+
+def synth_gangs(topology: SyntheticTopology, count: int, seed: int = 0,
+                prefix: str = "gang", max_size: int = 8) -> List[GangShape]:
+    """Seeded gang shapes sized to fit somewhere in ``topology``: each gang
+    targets one pool via selector and asks for at most a node's worth of
+    chips per pod, so a quiet cluster can always bind it."""
+    rng = random.Random(f"gangs:{seed}:{count}")
+    shapes = []
+    for i in range(count):
+        pool = rng.choice(topology.pools)
+        size = rng.randint(2, min(max_size, max(2, pool.nodes)))
+        chips = rng.choice([c for c in (1, 2, 4, pool.chips_per_node)
+                            if c <= pool.chips_per_node])
+        shapes.append(GangShape(
+            name=f"{prefix}-{i:04d}", size=size, chips_per_pod=chips,
+            selector=pool.selector()))
+    return shapes

@@ -26,8 +26,10 @@ Metrics live under the ``scheduler_`` namespace; every cycle runs in a
 
 from __future__ import annotations
 
+import calendar
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
@@ -42,6 +44,7 @@ from .flight import (
     FlightRecorder,
     dominant_node_reason,
     failed_scheduling_message,
+    truncate_node_verdicts,
 )
 from .gang import (
     DRAIN_ACK_ANNOTATION,
@@ -106,15 +109,26 @@ class SchedulerReconciler(Reconciler):
         reservation_ttl: float = 10.0,
         backoff_base: float = 0.05,
         backoff_cap: float = 5.0,
+        indexed_ledger: bool = True,
+        verdict_top_k: int = 8,
+        cycles_window_s: float = 30.0,
     ) -> None:
-        self.ledger = ChipLedger()
+        self.ledger = ChipLedger(indexed=indexed_ledger)
         self.backoff = BackoffQueue(backoff_base, backoff_cap)
         # every cycle's verdict, served at GET /debug/scheduler (flight.py)
         self.flight = FlightRecorder()
         self.assembly_timeout = assembly_timeout
         self.reservation_ttl = reservation_ttl
+        #: per-decision node-verdict cap; beyond it, verdicts aggregate into
+        #: one summary row per reason (flight.truncate_node_verdicts)
+        self.verdict_top_k = verdict_top_k
+        self.cycles_window_s = cycles_window_s
         self._wired = False
         self._lock = threading.Lock()
+        #: monotonic completion times of recent scheduling cycles, feeding
+        #: the scheduler_cycles_per_sec gauge at scrape time
+        self._cycle_times: "deque[float]" = deque(maxlen=65536)
+        METRICS.register_collector("scheduler_cycle_rate", self._collect_cycle_rate)
         #: gang → a member pod to requeue when a node appears
         self._pending: Dict[GangKey, Tuple[Optional[str], str]] = {}
         #: gang → monotonic time of its first scheduling attempt
@@ -184,6 +198,8 @@ class SchedulerReconciler(Reconciler):
         ) as span:
             outcome, delay = self._schedule_gang(client, gang, pod, span)
             span.set("outcome", outcome)
+        with self._lock:
+            self._cycle_times.append(time.monotonic())
         SCHED.counter("attempts_total", result=outcome).inc()
         with self._lock:
             SCHED.gauge("pending_gangs").set(len(self._pending))
@@ -278,7 +294,7 @@ class SchedulerReconciler(Reconciler):
             )
             return "unschedulable", delay
 
-        return self._bind(client, key, unbound, placement, span)
+        return self._bind(client, key, unbound, placement, span, members)
 
     def _await_assembly(
         self, client: Client, gang: Gang, pod: Dict[str, Any], span
@@ -322,6 +338,7 @@ class SchedulerReconciler(Reconciler):
         unbound: List[Dict[str, Any]],
         placement: List[str],
         span,
+        members: Optional[List[Dict[str, Any]]] = None,
     ) -> Tuple[str, float]:
         gang = gang_of(unbound[0])
         for target, node in zip(unbound, placement):
@@ -349,6 +366,7 @@ class SchedulerReconciler(Reconciler):
             )
         self.ledger.release(key)
         self._gang_done(key, bound=True)
+        self._observe_bind_latency(members or unbound)
         span.set("nodes", ",".join(sorted(set(placement))))
         self._record(
             client, gang, [], "bound", "scheduled",
@@ -627,7 +645,11 @@ class SchedulerReconciler(Reconciler):
                 attempt=self.backoff.failures(key),
                 backoff_seconds=delay,
                 wall_time=time.time(),
-                nodes=nodes or [],
+                # dominant_node_reason/failed_scheduling_message were computed
+                # from the FULL verdict list by the caller; the stored copy is
+                # capped so one unschedulable cycle on a 10k-node cluster
+                # doesn't pin thousands of dicts in the recorder ring
+                nodes=truncate_node_verdicts(nodes or [], self.verdict_top_k),
                 quota=quota,
                 preemption=preemption,
                 placement=placement,
@@ -699,6 +721,44 @@ class SchedulerReconciler(Reconciler):
             first = self._first_attempt.pop(key, None)
         if bound and first is not None:
             SCHED.histogram("time_to_bind_seconds").observe(time.monotonic() - first)
+
+    #: bucket ladder for the end-to-end bind SLI; creationTimestamps have
+    #: 1 s resolution, so the sub-second buckets catch same-second binds
+    BIND_LATENCY_BUCKETS = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+    def _observe_bind_latency(self, members: List[Dict[str, Any]]) -> None:
+        """End-to-end bind SLI: earliest gang member creationTimestamp (the
+        submit, stamped by the apiserver in wall time) → last pod bound
+        (now). Unlike time_to_bind_seconds — first *attempt* to bind — this
+        includes apiserver/informer/workqueue time before the scheduler ever
+        saw the gang, which is exactly the control-plane latency the scale
+        harness loads."""
+        submitted: Optional[float] = None
+        for p in members:
+            stamp = (p.get("metadata") or {}).get("creationTimestamp")
+            if not stamp:
+                continue
+            try:
+                ts = calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+            except ValueError:
+                continue
+            submitted = ts if submitted is None else min(submitted, ts)
+        if submitted is None:
+            return
+        SCHED.histogram(
+            "bind_latency_seconds", buckets=self.BIND_LATENCY_BUCKETS
+        ).observe(max(0.0, time.time() - submitted))
+
+    def _collect_cycle_rate(self) -> None:
+        """Scrape-time collector: scheduling cycles completed per second
+        over the trailing window."""
+        now = time.monotonic()
+        cutoff = now - self.cycles_window_s
+        with self._lock:
+            while self._cycle_times and self._cycle_times[0] < cutoff:
+                self._cycle_times.popleft()
+            n = len(self._cycle_times)
+        SCHED.gauge("cycles_per_sec").set(round(n / self.cycles_window_s, 6))
 
     def _pod_gone(self, pod_key: Tuple[Optional[str], str]) -> None:
         with self._lock:

@@ -73,6 +73,37 @@ class Decision:
         return out
 
 
+#: node verdicts kept verbatim per decision before aggregation kicks in
+DEFAULT_VERDICT_TOP_K = 8
+
+
+def truncate_node_verdicts(
+    nodes: List[Dict[str, Any]], top_k: int = DEFAULT_VERDICT_TOP_K
+) -> List[Dict[str, Any]]:
+    """Cap a decision's per-node verdict list for storage.
+
+    At thousands of nodes one unschedulable cycle would otherwise pin one
+    dict per rejected node in the recorder ring (512 decisions × 10k nodes).
+    The first ``top_k`` verdicts survive verbatim; the tail collapses into
+    one summary row per reason — ``...and N more nodes: insufficient_chips``
+    — so the debug surface still shows the full shape of the rejection.
+    Callers must derive ``dominant_node_reason`` / the Event message from
+    the full list *before* truncating; those stay exact.
+    """
+    if top_k < 0 or len(nodes) <= top_k:
+        return list(nodes)
+    kept = list(nodes[:top_k])
+    tail = Counter(v.get("reason", "unknown") for v in nodes[top_k:])
+    for reason, count in sorted(tail.items(), key=lambda kv: (-kv[1], kv[0])):
+        kept.append({
+            "node": f"...and {count} more nodes",
+            "reason": reason,
+            "truncated": count,
+            "summary": f"...and {count} more nodes: {reason}",
+        })
+    return kept
+
+
 def dominant_node_reason(nodes: List[Dict[str, Any]]) -> str:
     """The single most common rejection among non-feasible verdicts — what
     the ``reason`` label carries for an unschedulable decision."""

@@ -30,10 +30,11 @@ informer updates.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..api import meta as apimeta
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
@@ -41,6 +42,10 @@ from .gang import TERMINAL_PHASES, gang_of
 
 PodKey = Tuple[Optional[str], str]
 GangKey = Tuple[Optional[str], str]
+
+# Stamped per node (see on_node_event); excluded from pool fingerprints
+# because it is unique per node and would degenerate every pool to size 1.
+HOSTNAME_LABEL = "kubernetes.io/hostname"
 
 
 @dataclass
@@ -61,13 +66,29 @@ def node_tpu_capacity(node: Dict[str, Any]) -> int:
 
 
 class ChipLedger:
-    def __init__(self) -> None:
+    def __init__(self, indexed: bool = True) -> None:
         self._lock = threading.Lock()
         self._capacity: Dict[str, int] = {}
         self._labels: Dict[str, Dict[str, str]] = {}
         self._records: Dict[PodKey, _PodRecord] = {}
         self._used: Dict[str, int] = {}
         self._reserved: Dict[GangKey, Tuple[float, Dict[str, int]]] = {}
+        # Free-chip index: nodes grouped into pools by label fingerprint
+        # (all labels except the per-node hostname), with per-pool heaps of
+        # node ranks bucketed by base free chips (capacity - used). Queries
+        # touch pools + buckets instead of every node; reservation and
+        # assume_freed adjustments are overlaid per affected node. The index
+        # is maintained unconditionally (O(1) amortized per event) —
+        # ``indexed`` only selects the placement query path.
+        self.indexed = indexed
+        self._rank: Dict[str, int] = {}  # node -> position in _capacity order
+        self._rank_node: Dict[int, str] = {}
+        self._next_rank = 0
+        self._fp: Dict[str, frozenset] = {}  # node -> pool fingerprint
+        self._pools: Dict[frozenset, Dict[str, Any]] = {}
+        self._base_free: Dict[str, int] = {}  # node -> capacity - used
+        self._hn: Dict[str, Optional[str]] = {}  # node -> hostname label value
+        self._by_hostname: Dict[str, Set[str]] = {}
 
     # -- event feeds ---------------------------------------------------------
 
@@ -77,13 +98,21 @@ class ChipLedger:
             if event_type == "DELETED":
                 self._capacity.pop(name, None)
                 self._labels.pop(name, None)
+                self._index_drop(name)
             else:
+                if name not in self._capacity:
+                    # mirrors dict insertion order: re-adding a deleted node
+                    # appends it, re-setting an existing key keeps its slot
+                    self._rank[name] = self._next_rank
+                    self._rank_node[self._next_rank] = name
+                    self._next_rank += 1
                 self._capacity[name] = node_tpu_capacity(node)
                 labels = dict(apimeta.labels_of(node))
                 # kubelets stamp every node with its hostname; synthesize it so
                 # by-name nodeSelector pinning works against fixture nodes too
-                labels.setdefault("kubernetes.io/hostname", name)
+                labels.setdefault(HOSTNAME_LABEL, name)
                 self._labels[name] = labels
+                self._index_touch(name)
 
     def on_pod_event(self, event_type: str, pod: Dict[str, Any]) -> None:
         key = (apimeta.namespace_of(pod), apimeta.name_of(pod))
@@ -109,6 +138,14 @@ class ChipLedger:
             self._labels.clear()
             self._records.clear()
             self._used.clear()
+            self._rank.clear()
+            self._rank_node.clear()
+            self._next_rank = 0
+            self._fp.clear()
+            self._pools.clear()
+            self._base_free.clear()
+            self._hn.clear()
+            self._by_hostname.clear()
         for n in nodes:
             self.on_node_event("ADDED", n)
         for p in pods:
@@ -160,6 +197,7 @@ class ChipLedger:
         ttl: Optional[float] = None,
         assume_freed: Optional[Dict[str, int]] = None,
         now: Optional[float] = None,
+        use_index: Optional[bool] = None,
     ) -> Optional[List[str]]:
         """All-or-nothing placement for ``requirements`` = [(chips, nodeSelector)].
 
@@ -169,34 +207,23 @@ class ChipLedger:
         With ``ttl`` set, a feasible plan atomically replaces the gang's
         reservation; ``ttl=None`` is a pure feasibility query.
         ``assume_freed`` adds hypothetical capacity (a preemption victim's
-        chips) before planning.
+        chips) before planning. ``use_index`` overrides the constructor's
+        ``indexed`` choice for this one query (both paths return identical
+        placements — see tests/test_scale.py parity suite).
         """
         now = time.monotonic() if now is None else now
+        use = self.indexed if use_index is None else use_index
         with self._lock:
-            free = self._free_locked(gang_key, now)
-            for node, chips in (assume_freed or {}).items():
-                free[node] = free.get(node, 0) + chips
-            placement: List[str] = []
-            for chips, selector in requirements:
-                best: Optional[str] = None
-                for node in self._capacity:
-                    labels = self._labels.get(node, {})
-                    if any(labels.get(k) != v for k, v in (selector or {}).items()):
-                        continue
-                    if chips:
-                        if free.get(node, 0) < chips:
-                            continue
-                        # best-fit: pack slices tightly so whole nodes stay
-                        # free for the next multi-chip gang
-                        if best is None or free[node] < free[best]:
-                            best = node
-                    elif best is None:
-                        best = node
-                if best is None:
-                    return None
-                placement.append(best)
-                if chips:
-                    free[best] -= chips
+            if use:
+                delta = self._delta_locked(gang_key, assume_freed, now)
+                placement = self._select_indexed(requirements, delta)
+            else:
+                free = self._free_locked(gang_key, now)
+                for node, chips in (assume_freed or {}).items():
+                    free[node] = free.get(node, 0) + chips
+                placement = self._select_scan(requirements, free)
+            if placement is None:
+                return None
             if ttl is not None:
                 hold: Dict[str, int] = {}
                 for node, (chips, _sel) in zip(placement, requirements):
@@ -272,6 +299,230 @@ class ChipLedger:
 
     # -- internals (lock held) -----------------------------------------------
 
+    def _select_scan(
+        self, requirements: List[Tuple[int, Dict[str, str]]], free: Dict[str, int]
+    ) -> Optional[List[str]]:
+        """Reference placement: full scan over every node per requirement.
+        Kept as the ground truth the index is proven against, and as the
+        full-scan arm of the CONTROLPLANE bench."""
+        placement: List[str] = []
+        for chips, selector in requirements:
+            best: Optional[str] = None
+            for node in self._capacity:
+                labels = self._labels.get(node, {})
+                if any(labels.get(k) != v for k, v in (selector or {}).items()):
+                    continue
+                if chips:
+                    if free.get(node, 0) < chips:
+                        continue
+                    # best-fit: pack slices tightly so whole nodes stay
+                    # free for the next multi-chip gang
+                    if best is None or free[node] < free[best]:
+                        best = node
+                elif best is None:
+                    best = node
+            if best is None:
+                return None
+            placement.append(best)
+            if chips:
+                free[best] -= chips
+        return placement
+
+    def _delta_locked(
+        self,
+        exclude_gang: Optional[GangKey],
+        assume_freed: Optional[Dict[str, int]],
+        now: float,
+    ) -> Dict[str, int]:
+        """Sparse free-chip adjustments vs the indexed base (capacity - used):
+        other gangs' reservations subtract, assume_freed adds. Only the few
+        nodes touched by holds appear here — the index covers the rest."""
+        self._purge_expired(now)
+        delta: Dict[str, int] = {}
+        for gkey, (_deadline, by_node) in self._reserved.items():
+            if gkey == exclude_gang:
+                continue
+            for node, chips in by_node.items():
+                delta[node] = delta.get(node, 0) - chips
+        for node, chips in (assume_freed or {}).items():
+            delta[node] = delta.get(node, 0) + chips
+        return delta
+
+    def _select_indexed(
+        self, requirements: List[Tuple[int, Dict[str, str]]], delta: Dict[str, int]
+    ) -> Optional[List[str]]:
+        """Index-backed placement, decision-identical to ``_select_scan``.
+
+        The scan's best-fit comparison (strict ``<`` over ``_capacity``
+        iteration order) picks the node minimizing (free, insertion rank);
+        a zero-chip requirement picks the minimum rank outright. Both are
+        answered from per-pool free-buckets, with delta-overlaid nodes
+        (reservations / assume_freed / chips consumed by earlier
+        requirements in this same query) rescored individually.
+        """
+        placement: List[str] = []
+        for chips, selector in requirements:
+            sel = selector or {}
+            # (free-or-0, rank, node); free participates only when chips > 0
+            best: Optional[Tuple[int, int, str]] = None
+            hostname = sel.get(HOSTNAME_LABEL)
+            if hostname is not None:
+                # hostname is excluded from pool fingerprints (unique per
+                # node) — answer from the reverse map instead of the pools
+                for node in self._by_hostname.get(hostname, ()):
+                    cand = self._node_candidate(node, chips, sel, delta)
+                    if cand is not None and (best is None or cand[:2] < best[:2]):
+                        best = cand
+            else:
+                for pool in self._pools.values():
+                    plabels = pool["labels"]
+                    if any(plabels.get(k) != v for k, v in sel.items()):
+                        continue
+                    cand = self._pool_best(pool, chips, delta)
+                    if cand is not None and (best is None or cand[:2] < best[:2]):
+                        best = cand
+                # pool buckets answer from base free; delta-affected nodes
+                # were skipped there and are rescored with adjusted free
+                for node in delta:
+                    cand = self._node_candidate(node, chips, sel, delta)
+                    if cand is not None and (best is None or cand[:2] < best[:2]):
+                        best = cand
+            if best is None:
+                return None
+            node = best[2]
+            placement.append(node)
+            if chips:
+                delta[node] = delta.get(node, 0) - chips
+        return placement
+
+    def _node_candidate(
+        self, node: str, chips: int, sel: Dict[str, str], delta: Dict[str, int]
+    ) -> Optional[Tuple[int, int, str]]:
+        if node not in self._capacity:
+            return None  # assume_freed may name nodes the ledger never saw
+        labels = self._labels.get(node, {})
+        if any(labels.get(k) != v for k, v in sel.items()):
+            return None
+        if chips:
+            free = self._base_free[node] + delta.get(node, 0)
+            if free < chips:
+                return None
+            return (free, self._rank[node], node)
+        return (0, self._rank[node], node)
+
+    def _pool_best(
+        self, pool: Dict[str, Any], chips: int, delta: Dict[str, int]
+    ) -> Optional[Tuple[int, int, str]]:
+        best: Optional[Tuple[int, int, str]] = None
+        for f in sorted(pool["buckets"]):
+            if chips and f < chips:
+                continue
+            top = self._peek_bucket(pool, f, delta if chips else None)
+            if top is None:
+                continue
+            rank, node = top
+            if chips:
+                # buckets ascend by free: the first feasible one IS the
+                # best-fit minimum, and its heap top the tie-break winner
+                return (f, rank, node)
+            cand = (0, rank, node)
+            if best is None or cand < best:
+                best = cand
+        return best
+
+    def _peek_bucket(
+        self, pool: Dict[str, Any], f: int, exclude: Optional[Dict[str, int]]
+    ) -> Optional[Tuple[int, str]]:
+        """Min valid rank in a (pool, free) bucket without consuming it.
+        Stale entries (node moved pool / changed free / deleted) are popped
+        for good — lazy deletion; excluded (delta-overlaid) nodes are popped
+        and pushed back after the peek."""
+        heap = pool["buckets"].get(f)
+        if not heap:
+            pool["buckets"].pop(f, None)
+            return None
+        fp = pool["fp"]
+        stash: List[int] = []
+        found: Optional[Tuple[int, str]] = None
+        while heap:
+            rank = heap[0]
+            node = self._rank_node.get(rank)
+            if node is None or self._fp.get(node) != fp or self._base_free.get(node) != f:
+                heapq.heappop(heap)
+                continue
+            if exclude is not None and node in exclude:
+                stash.append(heapq.heappop(heap))
+                continue
+            found = (rank, node)
+            break
+        for rank in stash:
+            heapq.heappush(heap, rank)
+        if not heap:
+            pool["buckets"].pop(f, None)
+        return found
+
+    def _index_touch(self, name: str) -> None:
+        cap = self._capacity.get(name)
+        if cap is None:
+            self._index_drop(name)
+            return
+        labels = self._labels.get(name, {})
+        hostname = labels.get(HOSTNAME_LABEL)
+        old_hn = self._hn.get(name)
+        if old_hn != hostname:
+            if old_hn is not None:
+                peers = self._by_hostname.get(old_hn)
+                if peers is not None:
+                    peers.discard(name)
+                    if not peers:
+                        del self._by_hostname[old_hn]
+            if hostname is not None:
+                self._by_hostname.setdefault(hostname, set()).add(name)
+            self._hn[name] = hostname
+        fp = frozenset(kv for kv in labels.items() if kv[0] != HOSTNAME_LABEL)
+        old_fp = self._fp.get(name)
+        if old_fp is not None and old_fp != fp:
+            self._pool_remove(name, old_fp)
+        self._fp[name] = fp
+        pool = self._pools.get(fp)
+        if pool is None:
+            pool = {
+                "fp": fp,
+                "labels": dict(fp),
+                "nodes": set(),
+                "buckets": {},
+            }
+            self._pools[fp] = pool
+        pool["nodes"].add(name)
+        base_free = cap - self._used.get(name, 0)
+        if self._base_free.get(name) != base_free or old_fp != fp:
+            self._base_free[name] = base_free
+            heapq.heappush(pool["buckets"].setdefault(base_free, []), self._rank[name])
+
+    def _index_drop(self, name: str) -> None:
+        fp = self._fp.pop(name, None)
+        if fp is not None:
+            self._pool_remove(name, fp)
+        self._base_free.pop(name, None)
+        hostname = self._hn.pop(name, None)
+        if hostname is not None:
+            peers = self._by_hostname.get(hostname)
+            if peers is not None:
+                peers.discard(name)
+                if not peers:
+                    del self._by_hostname[hostname]
+        rank = self._rank.pop(name, None)
+        if rank is not None:
+            self._rank_node.pop(rank, None)
+
+    def _pool_remove(self, name: str, fp: frozenset) -> None:
+        pool = self._pools.get(fp)
+        if pool is None:
+            return
+        pool["nodes"].discard(name)
+        if not pool["nodes"]:
+            del self._pools[fp]
+
     def _free_locked(self, exclude_gang: Optional[GangKey], now: float) -> Dict[str, int]:
         self._purge_expired(now)
         free = {n: cap - self._used.get(n, 0) for n, cap in self._capacity.items()}
@@ -305,6 +556,8 @@ class ChipLedger:
             self._used[node] = n
         else:
             self._used.pop(node, None)
+        if node in self._capacity:
+            self._index_touch(node)
 
     # -- test/debug ----------------------------------------------------------
 

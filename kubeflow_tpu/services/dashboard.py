@@ -142,11 +142,26 @@ class TpuMetricsService:
                 "serving_queue_wait_seconds", 0.99, window_s, now),
             "windowSeconds": window_s,
         }
+        # control-plane SLIs (ISSUE 11): the scheduler's scraped rate/bind
+        # gauges plus per-queue backlog pressure, same federated source
+        cycles = [v for _l, _ts, v in self.tsdb.latest("scheduler_cycles_per_sec")]
+        saturation = {
+            labels.get("queue", ""): value
+            for labels, _ts, value in self.tsdb.latest("workqueue_saturation")
+        }
+        scheduler = {
+            "cyclesPerSec": round(sum(cycles), 6) if cycles else None,
+            "bindLatencyP99": self.tsdb.histogram_quantile(
+                "scheduler_bind_latency_seconds", 0.99, window_s, now),
+            "workqueueSaturation": saturation,
+            "windowSeconds": window_s,
+        }
         rules = getattr(self.monitoring, "rules", None)
         alerts = rules.snapshot()["alerts"] if rules is not None else []
         return {
             "targets": sorted(targets, key=lambda t: t["instance"]),
             "serving": serving,
+            "scheduler": scheduler,
             "alerts": alerts,
             "series": self.tsdb.stats(),
         }

@@ -1,0 +1,218 @@
+"""Control-plane bench: scheduler cycles/sec, end-to-end bind latency, and
+apiserver tail latency under a watch storm, at 1k and 5k synthetic nodes.
+
+Emits one JSON line per metric (the ``{"metric": ..., "value": ...}`` shape
+``tools/bench_gate.py`` extracts) plus a final summary line; committed
+rounds live at the repo root as ``CONTROLPLANE_rNN.json`` next to
+``BENCH_rNN.json`` and are gated by the same regression machinery.
+
+Three stages per run:
+
+1. **Ledger microbench** — the scheduler's per-cycle placement query
+   (all-or-nothing gang feasibility) against a synthetic topology, indexed
+   vs full-scan, measured as cycles/sec. This is the number the indexed
+   ChipLedger refactor (ISSUE 11 tentpole d) must move >=5x at 5k nodes.
+2. **Real-stack bind latency** — Store + Manager(scheduler, podlet) +
+   apiserver on a real HTTP listener; seeded gang waves arrive over HTTP
+   and ``scheduler_bind_latency_seconds`` (submit -> last pod bound) is
+   read back from the live registry as p50/p99.
+3. **Watch storm** — concurrent watch streams + mass relists against the
+   same stack; apiserver list p99 comes from the server-side
+   ``apiserver_request_seconds{verb="list"}`` series.
+
+Usage::
+
+    python tools/bench_controlplane.py                 # full 1k + 5k row
+    python tools/bench_controlplane.py --quick         # CI smoke (~500 nodes)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SEED = 11
+
+
+def emit(metric: str, value: float, **extra: Any) -> None:
+    row = {"metric": metric, "value": round(float(value), 4)}
+    row.update(extra)
+    print(json.dumps(row), flush=True)
+
+
+# -- stage 1: ledger microbench -----------------------------------------------
+
+def ledger_cycles_per_sec(topology, use_index: bool, duration_s: float,
+                          seed: int = SEED) -> float:
+    """One cycle = one all-or-nothing gang placement query (what the
+    scheduler runs per reconcile attempt) against a fully-built ledger."""
+    from kubeflow_tpu.scale.topology import synth_gangs
+    from kubeflow_tpu.scheduler.ledger import ChipLedger
+
+    ledger = ChipLedger()
+    for node in topology.nodes():
+        ledger.on_node_event("ADDED", node)
+    shapes = synth_gangs(topology, 32, seed=seed)
+    requirement_sets = [
+        [(s.chips_per_pod, dict(s.selector))] * s.size for s in shapes
+    ]
+    # warmup: settle the free-bucket index and any lazy heap cleanup
+    for reqs in requirement_sets[:4]:
+        ledger.place_and_reserve((None, "warmup"), reqs, ttl=None, now=1.0,
+                                 use_index=use_index)
+    start = time.perf_counter()
+    cycles = 0
+    while time.perf_counter() - start < duration_s:
+        reqs = requirement_sets[cycles % len(requirement_sets)]
+        ledger.place_and_reserve((None, f"g{cycles}"), reqs, ttl=None, now=1.0,
+                                 use_index=use_index)
+        cycles += 1
+    return cycles / (time.perf_counter() - start)
+
+
+# -- stages 2+3: real HTTP stack ----------------------------------------------
+
+def run_stack(topology, gangs: int, storm_streams: int, storm_relists: int,
+              seed: int = SEED) -> Dict[str, Any]:
+    from kubeflow_tpu.apiserver.client import Client
+    from kubeflow_tpu.apiserver.server import make_apiserver_app
+    from kubeflow_tpu.apiserver.store import Store
+    from kubeflow_tpu.controllers.builtin import PodletReconciler
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import METRICS, quantile_from_counts
+    from kubeflow_tpu.scale.loadgen import LoadGenerator
+    from kubeflow_tpu.scale.topology import synth_gangs
+    from kubeflow_tpu.scheduler import SchedulerReconciler
+
+    METRICS.reset()  # each topology size gets a clean histogram slate
+    store = Store()
+    # node registration is setup, not the measured path: feed the store
+    # directly so a 5k-node bench doesn't spend its budget on POSTs
+    client = Client(store, event_retention=4096)
+    for node in topology.nodes():
+        client.create(node)
+
+    mgr = Manager(store)
+    mgr.add(SchedulerReconciler(
+        assembly_timeout=10.0, reservation_ttl=5.0,
+        backoff_base=0.05, backoff_cap=0.5))
+    mgr.add(PodletReconciler())
+    app = make_apiserver_app(store)
+    httpd = app.serve(0)
+    base = f"http://127.0.0.1:{httpd.port}"
+    mgr.start()
+    try:
+        gen = LoadGenerator(base, topology, seed=seed)
+        shapes = synth_gangs(topology, gangs, seed=seed, max_size=6)
+        submit_start = time.perf_counter()
+        gen.gang_wave(shapes)
+        gen.wait_gangs_bound([s.name for s in shapes], timeout_s=120.0)
+        bind_wall = time.perf_counter() - submit_start
+
+        storm = gen.watch_storm(streams=storm_streams, relists=storm_relists,
+                                duration_s=2.0)
+        churned = gen.churn_pods(0.2)
+        killed = gen.kill_nodes(max(1, topology.total_nodes // 100))
+
+        hist = METRICS.histogram("apiserver_request_seconds",
+                                 verb="list", resource="pods")
+        list_p99_s = quantile_from_counts(
+            hist.buckets, hist.counts, hist.total, 0.99) or 0.0
+        return {
+            "bind_p50_s": METRICS.quantile("scheduler_bind_latency_seconds", 0.5),
+            "bind_p99_s": METRICS.quantile("scheduler_bind_latency_seconds", 0.99),
+            "bind_wall_s": bind_wall,
+            "pods_bound": sum(s.size for s in shapes),
+            "apiserver_list_p99_ms": list_p99_s * 1000.0,
+            "storm": storm,
+            "churned": churned,
+            "killed": len(killed),
+        }
+    finally:
+        httpd.close()
+        mgr.stop()
+
+
+def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
+               storm_streams: int, storm_relists: int,
+               flagship: bool) -> Dict[str, float]:
+    """One topology size end to end; ``flagship`` rows carry the unsuffixed
+    gated names, smaller sizes get a ``_<tag>`` suffix."""
+    from kubeflow_tpu.scale.topology import synthesize
+
+    topo = synthesize(num_nodes, seed=SEED)
+    suffix = "" if flagship else f"_{tag}"
+
+    indexed = ledger_cycles_per_sec(topo, use_index=True, duration_s=duration_s)
+    fullscan = ledger_cycles_per_sec(topo, use_index=False, duration_s=duration_s)
+    emit(f"scheduler_cycles_per_sec{suffix}", indexed,
+         nodes=topo.total_nodes, pools=len(topo.pools), path="indexed")
+    emit(f"scheduler_cycles_per_sec_fullscan{suffix}", fullscan,
+         nodes=topo.total_nodes, path="fullscan")
+    emit(f"controlplane_index_speedup_x{suffix}", indexed / max(fullscan, 1e-9),
+         nodes=topo.total_nodes)
+
+    stack = run_stack(topo, gangs=gangs, storm_streams=storm_streams,
+                      storm_relists=storm_relists)
+    emit(f"bind_latency_p50_s{suffix}", stack["bind_p50_s"] or 0.0,
+         nodes=topo.total_nodes, pods_bound=stack["pods_bound"])
+    emit(f"bind_latency_p99_s{suffix}", stack["bind_p99_s"] or 0.0,
+         nodes=topo.total_nodes, pods_bound=stack["pods_bound"],
+         bind_wall_s=round(stack["bind_wall_s"], 3))
+    emit(f"apiserver_list_p99_ms_storm{suffix}", stack["apiserver_list_p99_ms"],
+         nodes=topo.total_nodes, streams=stack["storm"]["streams"],
+         lists=stack["storm"]["lists"],
+         watch_events=stack["storm"]["watch_events"],
+         client_list_p99_ms=round(stack["storm"]["list_p99_ms"], 2))
+    return {
+        f"scheduler_cycles_per_sec{suffix}": round(indexed, 2),
+        f"scheduler_cycles_per_sec_fullscan{suffix}": round(fullscan, 2),
+        f"controlplane_index_speedup_x{suffix}": round(indexed / max(fullscan, 1e-9), 2),
+        f"bind_latency_p50_s{suffix}": round(stack["bind_p50_s"] or 0.0, 4),
+        f"bind_latency_p99_s{suffix}": round(stack["bind_p99_s"] or 0.0, 4),
+        f"apiserver_list_p99_ms_storm{suffix}": round(stack["apiserver_list_p99_ms"], 2),
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=5000,
+                    help="flagship topology size (gated row)")
+    ap.add_argument("--nodes-small", type=int, default=1000)
+    ap.add_argument("--duration", type=float, default=1.0,
+                    help="seconds per ledger microbench arm")
+    ap.add_argument("--gangs", type=int, default=12)
+    ap.add_argument("--storm-streams", type=int, default=16)
+    ap.add_argument("--storm-relists", type=int, default=40)
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: one 500-node size, short arms")
+    args = ap.parse_args(argv)
+
+    summary: Dict[str, float] = {}
+    if args.quick:
+        summary.update(bench_size(
+            500, "500", duration_s=0.3, gangs=4, storm_streams=4,
+            storm_relists=8, flagship=True))
+    else:
+        summary.update(bench_size(
+            args.nodes_small, "1k", duration_s=args.duration, gangs=args.gangs,
+            storm_streams=args.storm_streams, storm_relists=args.storm_relists,
+            flagship=False))
+        summary.update(bench_size(
+            args.nodes, "5k", duration_s=args.duration, gangs=args.gangs,
+            storm_streams=args.storm_streams, storm_relists=args.storm_relists,
+            flagship=True))
+    summary["platform"] = "cpu-controlplane"
+    summary["errors"] = None
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@ the best round before it, per metric, with direction- and noise-aware
 tolerances.
 
 The repo commits its bench history as ``BENCH_r*.json`` / ``MULTICHIP_r*.json``
+/ ``CONTROLPLANE_r*.json``
 (one file per round: the driver's command, exit code, stdout tail of JSON
 metric lines, and the parsed summary line). Until now nothing *read* that
 history — the r04→r05 serving decode drop (2605→2309 tok/s, −11.4%) and the
@@ -50,6 +51,15 @@ SPECS: Dict[str, Tuple[str, float]] = {
     "multichip_tokens_per_sec_per_chip": ("higher", 0.10),
     "multichip_composite_tokens_per_sec_per_chip": ("higher", 0.10),
     "multichip_scaling_efficiency": ("higher", 0.10),
+    # control-plane scale row (tools/bench_controlplane.py). Cycles/sec is a
+    # pure-CPU microbench that wobbles with host load; bind latency is
+    # quantized by 1s creationTimestamp resolution, so both get wide bands.
+    "scheduler_cycles_per_sec": ("higher", 0.25),
+    "scheduler_cycles_per_sec_fullscan": ("higher", 0.35),
+    "controlplane_index_speedup_x": ("higher", 0.35),
+    "bind_latency_p99_s": ("lower", 0.50),
+    "bind_latency_p50_s": ("lower", 0.50),
+    "apiserver_list_p99_ms_storm": ("lower", 0.50),
 }
 
 #: summary-line keys lifted into standalone metrics (the final bench line
@@ -127,7 +137,7 @@ def load_history(history_dir: Path, exclude: List[str]) -> Dict[int, Dict[str, f
     skip = {int(e.lstrip("rR")) for e in exclude}
     rounds: Dict[int, Dict[str, float]] = {}
     for path in sorted(history_dir.glob("*.json")):
-        m = re.fullmatch(r"(?:BENCH|MULTICHIP)_r(\d+)\.json", path.name)
+        m = re.fullmatch(r"(?:BENCH|MULTICHIP|CONTROLPLANE)_r(\d+)\.json", path.name)
         if not m or int(m.group(1)) in skip:
             continue
         try:
