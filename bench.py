@@ -748,6 +748,10 @@ def _run_serving(platform: str) -> dict:
             "ttft_p50": cont.get("ttft_p50") if cont else None,
             "ttft_p99": cont.get("ttft_p99") if cont else None,
             "queue_wait_p99": cont.get("queue_wait_p99") if cont else None,
+            # paged/chunked/speculative knob readout (ISSUE 12): the spec
+            # accept rate rides into the summary line so the bench gate can
+            # track it round over round
+            "spec_accept_rate": cont.get("spec_accept_rate") if cont else None,
             "platform": platform,
         })
     except Exception as e:
@@ -834,6 +838,8 @@ def main() -> int:
         "gpt2_medium_tokens_per_sec": gpt.get("tokens_per_sec_per_chip"),
         "serving_decode_tokens_per_sec_b8": rows.get("serving", {}).get("value"),
         "serving_bert_p50_ms_b8": rows.get("serving", {}).get("bert_http_p50_ms_b8"),
+        "serving_ttft_p99_s": rows.get("serving", {}).get("ttft_p99"),
+        "spec_accept_rate": rows.get("serving", {}).get("spec_accept_rate"),
         "hpo_trials_per_hour": rows.get("hpo", {}).get("value"),
         "multichip_tokens_per_sec_per_chip": rows.get("multichip", {}).get("value"),
         "multichip_scaling_efficiency": rows.get("multichip", {}).get("scaling_efficiency"),

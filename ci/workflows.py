@@ -213,21 +213,35 @@ def elastic_e2e() -> Dict:
     return b.build()
 
 
+def paged_kv_e2e() -> Dict:
+    """The paged-KV serving job: a 2-replica fleet on the paged arena +
+    chunked prefill + speculative decode path over real HTTP — greedy
+    completions bit-identical to the static oracle, an over-bucket prompt
+    served through chunked prefill, chatty first-token latency under the
+    long request's own TTFT while its prefill is in flight, spec counters
+    live, and every KV block reclaimed after the burst
+    (e2e/paged_kv_driver.py asserts all of it), plus the block
+    kernel/allocator and continuous-batching parity unit suites."""
+    b = WorkflowBuilder("paged-kv-e2e")
+    b.run("paged-kv-driver", ["python", "-m", "e2e.paged_kv_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("kv-cache-unit", "tests/test_kv_cache.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("continuous-unit", "tests/test_continuous_batching.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 def bench_regression() -> Dict:
     """The bench-gate job: tools/bench_gate.py compares the newest committed
     bench round against the best earlier round per metric and fails on any
-    regression past tolerance. The two known r05 serving regressions
-    (decode throughput, BERT HTTP p50 — ROADMAP item 2) are carried as
-    explicit waivers so the gate is green on known-and-tracked state but
-    trips on anything NEW; the waivers die with the next round. Plus the
-    gate's and attribution plane's unit suite."""
+    regression past tolerance. The r05 serving regressions that this job
+    used to carry as round-pinned waivers are RECOVERED in the committed
+    r06 round (paged KV + chunked prefill + speculative decode, ISSUE 12),
+    so the gate runs strict again — zero waivers. Plus the gate's and
+    attribution plane's unit suite."""
     b = WorkflowBuilder("bench-regression")
-    b.run("bench-gate", [
-        "python", "tools/bench_gate.py", "--history-dir", ".",
-        "--waive", "serving_bert_p50_ms_b8@r05",
-        "--waive", "serving_decode_tokens_per_sec_b8@r05",
-        "--waive", "serving_gpt_kv_decode_tokens_per_sec_b8@r05",
-    ])
+    b.run("bench-gate", ["python", "tools/bench_gate.py", "--history-dir", "."])
     b.pytest("attribution-unit", "tests/test_attribution.py",
              env={"JAX_PLATFORMS": "cpu"})
     return b.build()
@@ -276,6 +290,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
         name="controlplane-scale-e2e-5k", nodes=5000, timeout_s=1800),
     "serving-fleet-e2e": serving_fleet_e2e,
     "serving-overload-e2e": serving_overload_e2e,
+    "paged-kv-e2e": paged_kv_e2e,
     "elastic-e2e": elastic_e2e,
     "bench-regression": bench_regression,
     "attribution-e2e": attribution_e2e,

@@ -136,7 +136,20 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     Budgets cycle [32, 64, 128, 224]: the static path groups ``slots``
     requests per batch and every member pays the group MAX (lockstep
     decode); the continuous engine retires each sequence at ITS budget and
-    admits the next from the queue."""
+    admits the next from the queue.
+
+    ISSUE-12 knobs (docs/PERFORMANCE.md): ``BENCH_PAGED`` (default 1)
+    runs the engine on the paged KV arena, ``BENCH_KV_BLOCKS`` sizes the
+    arena (0 = full capacity), ``BENCH_PREFILL_CHUNK`` sets the
+    chunked-prefill budget (engine default when unset, 0 disables).
+    ``BENCH_SPEC`` (default 1) adds a second timed pass on a speculative
+    engine — the draft is the target's own first ``n_layers // 4`` blocks
+    with tied embeddings (self-speculative drafting: no second checkpoint;
+    the accept rate on a TRAINED model tracks how early the truncated
+    stack commits to the full stack's argmax, on this bench's random init
+    it is a floor, not a ceiling) — reporting ``spec_accept_rate`` and
+    ``spec_tokens_per_sec`` next to the plain numbers, ``BENCH_SPEC_K``
+    tokens per round."""
     from kubeflow_tpu.models.gpt import GptConfig, GptLM, generate
     from kubeflow_tpu.serving.continuous import ContinuousBatcher
 
@@ -174,8 +187,13 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     static_s = time.perf_counter() - t0
 
     # -- continuous path: same requests through the slot engine ------------
+    paged = os.environ.get("BENCH_PAGED", "1") == "1"
+    kv_blocks = int(os.environ.get("BENCH_KV_BLOCKS", "0") or 0) or None
+    pc_env = os.environ.get("BENCH_PREFILL_CHUNK", "")
+    prefill_chunk = int(pc_env) if pc_env else None
     eng = ContinuousBatcher(cfg, params, slots=slots, chunk=chunk,
-                            pipeline=pipeline)
+                            pipeline=pipeline, paged=paged,
+                            kv_blocks=kv_blocks, prefill_chunk=prefill_chunk)
     try:
         # warm the engine's programs (per-group-size prefill, adopt, and
         # the chunked step) the same way the static path's generate()
@@ -194,16 +212,64 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     # SLO quantiles out of the engine's histograms (registry bucket
     # interpolation — the same numbers a /metrics scrape would show).
     # prewarm() runs uninstrumented, so only the timed requests count.
+    # Queried BEFORE the speculative pass below adds its own observations.
     from kubeflow_tpu.runtime.metrics import METRICS
 
     def _q(name: str, q: float) -> float:
         v = METRICS.quantile(name, q)  # None = no observations (not 0.0)
         return round(v, 4) if v is not None else 0.0
 
+    ttft_p50, ttft_p99 = _q("serving_ttft_seconds", 0.5), _q("serving_ttft_seconds", 0.99)
+    queue_wait_p99 = _q("serving_queue_wait_seconds", 0.99)
+
+    # -- speculative pass: same requests, self-speculative draft -----------
+    spec: Dict[str, Any] = {}
+    if os.environ.get("BENCH_SPEC", "1") == "1":
+        spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+        draft_layers = max(1, cfg.n_layers // 4)
+        draft_cfg = GptConfig(d_model=cfg.d_model, n_layers=draft_layers,
+                              n_heads=cfg.n_heads, d_ff=cfg.d_ff,
+                              max_seq=cfg.max_seq, vocab_size=cfg.vocab_size)
+        draft_params = {k: v for k, v in params.items()
+                        if not k.startswith("block_")}
+        for i in range(draft_layers):
+            draft_params[f"block_{i}"] = params[f"block_{i}"]
+        drafted0 = METRICS.counter("serving_spec_tokens_drafted_total").value
+        accepted0 = METRICS.counter("serving_spec_tokens_accepted_total").value
+        seng = ContinuousBatcher(cfg, params, slots=slots, chunk=chunk,
+                                 pipeline=pipeline, paged=paged,
+                                 kv_blocks=kv_blocks,
+                                 prefill_chunk=prefill_chunk,
+                                 spec_draft=(draft_cfg, draft_params),
+                                 spec_k=spec_k)
+        try:
+            seng.prewarm(prompt_len)
+            t0 = time.perf_counter()
+            futs = [seng.submit(prompts[i], budgets[i])
+                    for i in range(n_requests)]
+            for f in futs:
+                f.result(timeout=1800)
+            spec_s = time.perf_counter() - t0
+        finally:
+            seng.close()
+        drafted = METRICS.counter("serving_spec_tokens_drafted_total").value - drafted0
+        accepted = METRICS.counter("serving_spec_tokens_accepted_total").value - accepted0
+        spec = {
+            "spec_k": spec_k,
+            "spec_draft_layers": draft_layers,
+            "spec_wall_s": round(spec_s, 2),
+            "spec_tokens_per_sec": round(total_tokens / spec_s, 1),
+            "spec_accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        }
+
     return {
-        "ttft_p50": _q("serving_ttft_seconds", 0.5),
-        "ttft_p99": _q("serving_ttft_seconds", 0.99),
-        "queue_wait_p99": _q("serving_queue_wait_seconds", 0.99),
+        "ttft_p50": ttft_p50,
+        "ttft_p99": ttft_p99,
+        "queue_wait_p99": queue_wait_p99,
+        "paged": paged,
+        "kv_blocks": kv_blocks or "full",
+        "prefill_chunk": eng.prefill_chunk,
+        **spec,
         "slots": slots, "requests": n_requests, "budgets": "32/64/128/224",
         "useful_tokens": total_tokens,
         "static_wall_s": round(static_s, 2),

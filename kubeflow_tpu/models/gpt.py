@@ -131,9 +131,18 @@ class GptAttention(nn.Module):
     # KUBEFLOW_TPU_KV_KERNEL env flag (deployment-wide default); True/False
     # pin it per model instance so the fast path is testable in-process.
     kv_kernel: Optional[bool] = None
+    # paged: per-slot decode against a shared block arena + per-call block
+    # tables instead of a contiguous [b, max_seq] cache (ISSUE 12). The
+    # cache collection holds "k_arena"/"v_arena" [kv_blocks, kv_block_t,
+    # h, d] (last row = trash block) and "cursors" [b]; the caller passes
+    # the [b, max_blocks] table each apply.
+    paged: bool = False
+    kv_blocks: int = 0
+    kv_block_t: int = 16
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 block_tables: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         dense = functools.partial(
             nn.DenseGeneral,
@@ -144,6 +153,10 @@ class GptAttention(nn.Module):
             use_bias=False,
         )
         if self.decode:
+            if self.paged:
+                if not self.per_slot:
+                    raise ValueError("paged KV decode requires per_slot=True")
+                return self._paged_decode_attention(x, dense, block_tables)
             return self._decode_attention(x, dense)
         q = rope(dense(name="query")(x), positions, cfg.rope_theta)
         k = rope(dense(name="key")(x), positions, cfg.rope_theta)
@@ -254,6 +267,76 @@ class GptAttention(nn.Module):
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, values.astype(jnp.float32))
         return self._out_proj(ctx.astype(cfg.dtype))
 
+    def _paged_decode_attention(self, x: jax.Array, dense,
+                                block_tables: jax.Array) -> jax.Array:
+        """Per-slot decode against the shared block arena (ISSUE 12).
+
+        Same math as the per-slot branch of :meth:`_decode_attention`, with
+        the [b, max_seq] cache replaced by an indirect view: the write goes
+        through the block table (Pallas ``kv_block_update`` or the XLA
+        scatter reference), and the read gathers ``arena[tables]`` back
+        into a [b, max_blocks*block_t, h, d] view. When ``block_t`` divides
+        ``max_seq`` (the engine enforces it) that view has exactly the
+        contiguous cache's shape, so the masked softmax/einsum below is
+        bit-identical to the contiguous path — the parity suite's contract.
+        Rows whose table entries point at the trash block read garbage
+        there, but only at positions the ``<= cursor`` mask already hides.
+        """
+        cfg = self.cfg
+        b, seg_len = x.shape[0], x.shape[1]
+        arena_shape = (max(self.kv_blocks, 1), self.kv_block_t,
+                       cfg.n_heads, cfg.head_dim)
+        cache_k = self.variable("cache", "k_arena", jnp.zeros, arena_shape, cfg.dtype)
+        cache_v = self.variable("cache", "v_arena", jnp.zeros, arena_shape, cfg.dtype)
+        cursors = self.variable("cache", "cursors", lambda: jnp.zeros((b,), jnp.int32))
+        if block_tables is None:
+            raise ValueError("paged decode needs block_tables=[b, max_blocks]")
+        start = cursors.value                                   # [b]
+        seg_positions = start[:, None] + jnp.arange(seg_len)    # [b, L]
+        q = rope(dense(name="query")(x), seg_positions, cfg.rope_theta)
+        k = rope(dense(name="key")(x), seg_positions, cfg.rope_theta)
+        v = dense(name="value")(x)
+        use_kernel = (
+            _kv_kernel_enabled() if self.kv_kernel is None else self.kv_kernel
+        )
+        from ..ops.kv_cache import kv_block_update, kv_block_update_ref
+
+        if seg_len == 1 and use_kernel:
+            keys_arena = kv_block_update(
+                cache_k.value, k[:, 0], start, block_tables, max_seq=cfg.max_seq)
+            vals_arena = kv_block_update(
+                cache_v.value, v[:, 0], start, block_tables, max_seq=cfg.max_seq)
+        else:
+            keys_arena = kv_block_update_ref(
+                cache_k.value, k, start, block_tables, max_seq=cfg.max_seq)
+            vals_arena = kv_block_update_ref(
+                cache_v.value, v, start, block_tables, max_seq=cfg.max_seq)
+        if not self.is_initializing():
+            cache_k.value = keys_arena
+            cache_v.value = vals_arena
+            cursors.value = start + seg_len
+
+        bt = arena_shape[1]
+        mb = block_tables.shape[1]
+        view = (b, mb * bt, cfg.n_heads, cfg.head_dim)
+        keys = keys_arena[block_tables].reshape(view)
+        values = vals_arena[block_tables].reshape(view)
+        mask = (jnp.arange(mb * bt)[None, None, None, :]
+                <= seg_positions[:, None, :, None])             # [b,1,L,mb*bt]
+        scale = cfg.head_dim**-0.5
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(jnp.float32),
+                keys.astype(jnp.float32),
+            )
+            * scale
+        )
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, values.astype(jnp.float32))
+        return self._out_proj(ctx.astype(cfg.dtype))
+
 
 class GptMlp(nn.Module):
     cfg: GptConfig
@@ -275,14 +358,19 @@ class GptBlock(nn.Module):
     decode: bool = False
     per_slot: bool = False
     kv_kernel: Optional[bool] = None
+    paged: bool = False
+    kv_blocks: int = 0
+    kv_block_t: int = 16
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 block_tables: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
         x = x + GptAttention(cfg, self.attention_fn, self.decode, self.per_slot,
-                             self.kv_kernel, name="attention")(
-            ln(name="ln_attn")(x).astype(cfg.dtype), positions
+                             self.kv_kernel, self.paged, self.kv_blocks,
+                             self.kv_block_t, name="attention")(
+            ln(name="ln_attn")(x).astype(cfg.dtype), positions, block_tables
         )
         normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
         if cfg.num_experts > 0:
@@ -318,9 +406,14 @@ class GptLM(nn.Module):
     decode: bool = False
     per_slot: bool = False
     kv_kernel: Optional[bool] = None
+    paged: bool = False
+    kv_blocks: int = 0
+    kv_block_t: int = 16
 
     @nn.compact
-    def __call__(self, input_ids: jax.Array, *, return_hidden: bool = False) -> jax.Array:
+    def __call__(self, input_ids: jax.Array, *,
+                 block_tables: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.cfg
         embed = nn.Embed(
             cfg.vocab_size,
@@ -361,7 +454,9 @@ class GptLM(nn.Module):
                 block = nn.remat(GptBlock, static_argnums=())
             for i in range(cfg.n_layers):
                 x = block(cfg, self.attention_fn, self.mesh, self.decode,
-                          self.per_slot, self.kv_kernel, name=f"block_{i}")(x, positions)
+                          self.per_slot, self.kv_kernel, self.paged,
+                          self.kv_blocks, self.kv_block_t,
+                          name=f"block_{i}")(x, positions, block_tables)
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
         if return_hidden:
             # final hidden states for a fused loss (blockwise_causal_lm_loss)

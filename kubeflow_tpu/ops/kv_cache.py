@@ -97,3 +97,104 @@ def kv_row_update(cache: jax.Array, new: jax.Array, cursors: jax.Array,
         input_output_aliases={1: 0},  # flattened args: (cursors, cache, new)
         interpret=interpret,
     )(cursors.astype(jnp.int32), cache, new.astype(cache.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) variants — ISSUE 12.
+#
+# The paged layout replaces the per-slot [S, T, H, D] cache with one shared
+# arena [N, block_t, H, D] plus a per-slot block table [S, MB] of arena row
+# ids. The LAST arena row (N-1) is the trash block: table entries for
+# unallocated positions point there, so a write through a trash entry lands
+# in a row nothing ever reads (the attention mask hides every position at or
+# beyond the row's cursor). That single convention is what makes retirement
+# safe without device synchronization: the engine redirects a slot's table
+# row to trash BEFORE returning its blocks to the free list, and dispatches
+# execute in issue order.
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(cur_ref, tbl_ref, arena_ref, new_ref, out_ref,
+                  *, block_t: int, max_seq: int):
+    s = pl.program_id(0)
+    cur = cur_ref[s]
+    off = jnp.minimum(cur, max_seq - 1) % block_t
+    out_ref[...] = arena_ref[...]
+    # Same no-op contract as kv_row_update: a cursor at or beyond max_seq
+    # leaves the tile untouched (the index map still selects a valid tile).
+    out_ref[0, pl.dslice(off, 1)] = jnp.where(
+        cur < max_seq, new_ref[0], arena_ref[0, pl.dslice(off, 1)])
+
+
+@functools.partial(jax.jit, static_argnames=("max_seq", "interpret"))
+def kv_block_update(arena: jax.Array, new: jax.Array, cursors: jax.Array,
+                    tables: jax.Array, *, max_seq: int,
+                    interpret: bool | None = None) -> jax.Array:
+    """Paged generalization of :func:`kv_row_update`.
+
+    arena: [N, block_t, H, D] shared block arena (row N-1 is the trash
+    block); new: [S, H, D] (or [S, 1, H, D]); cursors: [S] int32 absolute
+    positions; tables: [S, MB] int32 arena row ids per slot.
+
+    Writes ``new[s]`` at ``arena[tables[s, cursors[s] // block_t],
+    cursors[s] % block_t]``. Both the cursor- and table-scalars are
+    prefetched so the block index map can chase the indirection; the grid
+    stays (S,) and each step touches exactly one [1, block_t, H, D] tile.
+    Cursors at or beyond ``max_seq`` are a no-op for the data (the tile
+    selection clamps, the in-kernel predicate skips the write); positions
+    whose table entry is the trash block land in the trash row.
+    """
+    N, block_t, H, D = arena.shape
+    S = new.shape[0]
+    mb = tables.shape[1]
+    if new.ndim == 3:
+        new = new[:, None]
+    if interpret is None:
+        interpret = _interpret_default()
+
+    def arena_block(s, cur, tbl):
+        pos = jnp.minimum(cur[s], max_seq - 1)
+        return (tbl[s, jnp.minimum(pos // block_t, mb - 1)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, block_t, H, D), arena_block),
+            pl.BlockSpec((1, 1, H, D), lambda s, cur, tbl: (s, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_t, H, D), arena_block),
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, block_t=block_t, max_seq=max_seq),
+        out_shape=jax.ShapeDtypeStruct(arena.shape, arena.dtype),
+        grid_spec=grid_spec,
+        # flattened args: (cursors, tables, arena, new)
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(cursors.astype(jnp.int32), tables.astype(jnp.int32),
+      arena, new.astype(arena.dtype))
+
+
+def kv_block_update_ref(arena: jax.Array, seg: jax.Array, cursors: jax.Array,
+                        tables: jax.Array, *, max_seq: int) -> jax.Array:
+    """XLA scatter reference for :func:`kv_block_update`, generalized to
+    multi-token segments (speculative-verify writes ``seg_len`` positions
+    per row in one call).
+
+    arena: [N, block_t, H, D]; seg: [S, L, H, D]; cursors: [S] (position of
+    ``seg[:, 0]``); tables: [S, MB]. Out-of-range positions are redirected
+    to the trash row (N-1) instead of being skipped so the whole update
+    stays one scatter per token.
+    """
+    N, block_t, _, _ = arena.shape
+    S, L = seg.shape[:2]
+    mb = tables.shape[1]
+    rows = jnp.arange(S)
+    cursors = cursors.astype(jnp.int32)
+    for j in range(L):
+        pos = cursors + j
+        bi = jnp.clip(pos // block_t, 0, mb - 1)
+        blk = jnp.where(pos < max_seq, tables[rows, bi], N - 1)
+        arena = arena.at[blk, pos % block_t].set(seg[:, j].astype(arena.dtype))
+    return arena

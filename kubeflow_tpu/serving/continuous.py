@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import math
 import os
 import queue
 import threading
@@ -50,6 +51,7 @@ from ..runtime.metrics import METRICS
 from ..runtime.tracing import TRACER, Span
 from .errors import (DeadlineExceeded, EngineClosed, FleetSaturated,
                      RequestCancelled)
+from .paged import KVBlockAllocator, KVReservation
 
 #: admission priority classes; batch is shed first under saturation
 PRIORITIES = ("interactive", "batch")
@@ -90,6 +92,37 @@ def _bucket_for(n: int) -> int:
         if n <= b:
             return b
     raise ValueError(f"prompt length {n} exceeds the largest prefill bucket")
+
+
+def _block_tile(max_seq: int, requested: int = 16) -> int:
+    """Arena tile (``block_t``) for the paged KV layout: the largest value
+    not above ``requested`` that divides both ``max_seq`` (so the gathered
+    [S, max_blocks*block_t] view is shape-identical to the contiguous
+    cache — the bit-parity contract) and the smallest prefill bucket (so
+    every bucket splice is a whole number of blocks)."""
+    base = math.gcd(int(max_seq), PREFILL_BUCKETS[0])
+    return next(b for b in range(min(int(requested), base), 0, -1)
+                if base % b == 0)
+
+
+def effective_prefill_chunk(requested: Optional[int], max_seq: int,
+                            block_t: int = 1) -> int:
+    """Resolve the chunked-prefill chunk size an engine will actually use:
+    the largest value not above ``requested`` that divides ``max_seq``
+    (chunk starts must never clamp inside the scalar-cursor prefill cache)
+    and is a whole number of KV blocks. ``requested`` None defaults to the
+    largest prefill bucket; 0/negative disables chunking (returns 0).
+    ``GenerativeModel`` calls this too, so routing and engine agree."""
+    if requested is None:
+        requested = PREFILL_BUCKETS[-1]
+    requested = min(int(requested), int(max_seq))
+    if requested <= 0:
+        return 0
+    step = max(int(block_t), 1)
+    for c in range(requested, 0, -1):
+        if max_seq % c == 0 and c % step == 0:
+            return c
+    return 0
 
 
 @dataclass(eq=False)  # identity equality: field eq would compare ndarrays
@@ -181,6 +214,22 @@ def _fail(req: _Request, error: BaseException) -> None:
     req._notify()
 
 
+@dataclass(eq=False)
+class _ChunkedPrefill:
+    """One long prompt mid-chunked-prefill (ISSUE 12): it owns a slot and
+    (paged) a KV reservation from the first chunk, prefills into a private
+    [1, max_seq] scalar-cursor cache one fixed-size chunk per engine
+    iteration — decode chunks keep dispatching in between, which is the
+    whole point — and adopts into the shared cache when the last chunk
+    lands."""
+    req: _Request
+    slot: int
+    cache: Any
+    key: Any
+    pos: int = 0                       # prompt tokens prefilled so far
+    res: Optional[KVReservation] = None
+
+
 class ContinuousBatcher:
     """Slot-based decode engine over one per-slot KV cache.
 
@@ -219,7 +268,43 @@ class ContinuousBatcher:
                  kv_kernel: Optional[bool] = None,
                  engine_id: str = "0",
                  max_pending: int = 0,
-                 interactive_reserve: float = 0.25):
+                 interactive_reserve: float = 0.25,
+                 paged: bool = True,
+                 kv_blocks: Optional[int] = None,
+                 kv_block_t: int = 16,
+                 prefill_chunk: Optional[int] = None,
+                 spec_draft: Optional[Tuple[GptConfig, Any]] = None,
+                 spec_k: int = 4):
+        """New ISSUE-12 knobs (defaults keep every pre-existing behavior):
+
+        ``paged``: shared block-arena KV layout with a per-slot block table
+        (default). ``paged=False`` keeps the contiguous per-slot cache as
+        the parity ground truth — the same pattern as
+        ``ChipLedger(indexed=True)``.
+
+        ``kv_blocks``: allocatable arena blocks (None = full capacity
+        parity, ``slots * ceil(max_seq / block_t)`` — no admission
+        back-pressure beyond the contiguous layout's). Smaller arenas trade
+        HBM for ``KVBlocksExhausted`` back-pressure under long-prompt load;
+        watch ``serving_kv_blocks_{free,used}``.
+
+        ``kv_block_t``: requested arena tile; auto-shrunk so it divides
+        ``max_seq`` and the smallest prefill bucket (bit-parity contract).
+
+        ``prefill_chunk``: prompts longer than this prefill in fixed-size
+        chunks interleaved with decode dispatches (None = the largest
+        prefill bucket, which also extends the engine's servable prompt
+        range from that bucket up to ``max_seq - budget``; 0 disables —
+        long prompts then fail fast at admission).
+
+        ``spec_draft``: ``(draft_cfg, draft_params)`` enables speculative
+        decoding — the draft greedily proposes ``spec_k - 1`` tokens per
+        round, the target verifies all positions in ONE batched forward,
+        and the accepted prefix commits with cursor rollback on both
+        caches. Greedy requests stay bit-identical to plain decode;
+        sampled slots accept exactly one token per round, drawn from the
+        verify logits.
+        """
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -243,12 +328,62 @@ class ContinuousBatcher:
         # fixed admission-group pad: one prefill program + one zero
         # template per prompt bucket; waves larger than this are chunked
         self._group_pad = min(slots, MAX_GROUP)
+        # -- paged KV layout (ISSUE 12) ------------------------------------
+        self.paged = bool(paged)
+        if self.paged:
+            self.kv_block_t = _block_tile(cfg.max_seq, kv_block_t)
+            self._max_blocks = cfg.max_seq // self.kv_block_t
+            n_blocks = (int(kv_blocks) if kv_blocks
+                        else slots * self._max_blocks)
+            self._alloc: Optional[KVBlockAllocator] = KVBlockAllocator(
+                n_blocks, self.kv_block_t, engine_id=self.engine_id)
+            # ONE host-side block table shared by every layer (each
+            # dispatch snapshots it to device); entries default to the
+            # trash block so unallocated positions can never hit real data
+            self._tables = np.full((slots, self._max_blocks),
+                                   self._alloc.trash, np.int32)
+            self._slot_res: Dict[int, KVReservation] = {}
+            # upper bound on each slot's device cursor at the dispatch
+            # frontier — spec rounds advance the real cursor by a
+            # data-dependent amount, so granting tracks the bound
+            self._ub_cursor = np.zeros((slots,), np.int64)
+        else:
+            self.kv_block_t = 0
+            self._alloc = None
+        # -- chunked prefill (ISSUE 12) ------------------------------------
+        self.prefill_chunk = effective_prefill_chunk(
+            prefill_chunk, cfg.max_seq, self.kv_block_t or 1)
+        self._chunked: Optional[_ChunkedPrefill] = None
+        self._chunk_prefill_fn: Optional[Any] = None
+        self._draft_full_prefill_fn: Optional[Any] = None
+        # -- speculative decoding (ISSUE 12) -------------------------------
+        self.spec_k = 0
+        if spec_draft is not None:
+            draft_cfg, draft_params = spec_draft
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError("spec draft must share the target's vocab")
+            if draft_cfg.max_seq < cfg.max_seq:
+                raise ValueError("spec draft max_seq must cover the target's")
+            self.spec_k = max(2, int(spec_k))
+            self._draft_cfg = draft_cfg
+            self._draft_params = draft_params
+            self._draft_model = GptLM(draft_cfg, decode=True, per_slot=True,
+                                      kv_kernel=False)
+            self._draft_prefill_model = GptLM(draft_cfg, decode=True)
         # kv_kernel: per-slot KV-write strategy (None = the
         # KUBEFLOW_TPU_KV_KERNEL env default; see models.gpt)
-        self.model = GptLM(cfg, decode=True, per_slot=True,
-                           kv_kernel=kv_kernel)
+        if self.paged:
+            self.model = GptLM(cfg, decode=True, per_slot=True,
+                               kv_kernel=kv_kernel, paged=True,
+                               kv_blocks=self._alloc.n_blocks + 1,
+                               kv_block_t=self.kv_block_t)
+        else:
+            self.model = GptLM(cfg, decode=True, per_slot=True,
+                               kv_kernel=kv_kernel)
         self._prefill_model = GptLM(cfg, decode=True)  # [1, P], scalar cursor
         self.cache = self._fresh_cache()
+        if self.spec_k:
+            self.draft_cache = self._fresh_draft_cache()
         self.last_tok = jnp.zeros((slots,), jnp.int32)
         # per-slot sampling state: temperature 0 = greedy; each admission
         # folds a fresh counter into the base key so sampled requests draw
@@ -273,12 +408,14 @@ class ContinuousBatcher:
         self._handoff: List[_Request] = []
         self._step_fn = self._build_step()
         self._adopt_fn = self._build_adopt()
-        self._prefill_fns: Dict[Tuple[int, int], Any] = {}
+        self._spec_fn = self._build_spec_step() if self.spec_k else None
+        self._draft_adopt_fn = self._build_draft_adopt() if self.spec_k else None
+        self._prefill_fns: Dict[Tuple[int, int, bool], Any] = {}
         # reusable zero prefill-cache per group bucket: prefill does NOT
         # donate its cache input, so one template serves every admission —
         # without it each wave re-allocates 2*n_layers zero buffers on the
         # device (measured as dispatch-stream noise on the tunnel)
-        self._zero_small: Dict[int, Any] = {}
+        self._zero_small: Dict[Tuple[int, bool], Any] = {}
         self._worker = threading.Thread(target=self._loop, name="continuous-batcher",
                                         daemon=True)
         self._worker.start()
@@ -286,6 +423,17 @@ class ContinuousBatcher:
     # -- compiled pieces -----------------------------------------------------
     def _fresh_cache(self) -> Dict[str, Any]:
         cfg, S = self.cfg, self.slots
+        if self.paged:
+            arena = (self._alloc.n_blocks + 1, self.kv_block_t,
+                     cfg.n_heads, cfg.head_dim)
+            return {
+                f"block_{i}": {"attention": {
+                    "k_arena": jnp.zeros(arena, cfg.dtype),
+                    "v_arena": jnp.zeros(arena, cfg.dtype),
+                    "cursors": jnp.zeros((S,), jnp.int32),
+                }}
+                for i in range(cfg.n_layers)
+            }
         kv = (S, cfg.max_seq, cfg.n_heads, cfg.head_dim)
         return {
             f"block_{i}": {"attention": {
@@ -296,19 +444,36 @@ class ContinuousBatcher:
             for i in range(cfg.n_layers)
         }
 
+    def _fresh_draft_cache(self) -> Dict[str, Any]:
+        # the draft stays contiguous: it is small by construction, so the
+        # paged arena's memory win does not apply to it
+        dcfg, S = self._draft_cfg, self.slots
+        kv = (S, dcfg.max_seq, dcfg.n_heads, dcfg.head_dim)
+        return {
+            f"block_{i}": {"attention": {
+                "k": jnp.zeros(kv, dcfg.dtype),
+                "v": jnp.zeros(kv, dcfg.dtype),
+                "cursors": jnp.zeros((S,), jnp.int32),
+            }}
+            for i in range(dcfg.n_layers)
+        }
+
     def _build_step(self):
         model = self.model
         chunk = self.chunk
+        paged = self.paged
 
         # donate cache+tok+rngs: without donation every dispatch COPIES the
         # full multi-GB KV cache into fresh output buffers (measured: the
         # copy, not the math, dominated chunked stepping)
         @functools.partial(jax.jit, donate_argnums=(1, 2, 4))
-        def step(params, cache, tok, temps, rngs):
+        def step(params, cache, tok, temps, rngs, *tables):
             def one(carry, _):
                 cache, tok, rngs = carry
+                kwargs = {"block_tables": tables[0]} if paged else {}
                 logits, updated = model.apply(
-                    {"params": params, "cache": cache}, tok[:, None], mutable=["cache"]
+                    {"params": params, "cache": cache}, tok[:, None],
+                    mutable=["cache"], **kwargs
                 )
                 lg = logits[:, -1]                               # [slots, vocab]
                 greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
@@ -326,7 +491,118 @@ class ContinuousBatcher:
 
         return step
 
+    def _build_spec_step(self):
+        """One speculative round: the draft model greedily proposes
+        ``spec_k`` tokens (``spec_k - 1`` of them verifiable), the target
+        verifies all positions in ONE seg_len=spec_k forward, and both
+        caches roll their cursors back to the accepted frontier.
+
+        Accept-prefix semantics (greedy slots): emitted tokens are
+        ``t_1 .. t_m`` with ``m = 1 + (leading draft/target matches)`` —
+        exactly the tokens plain greedy decode would emit, because each
+        ``t_j`` is conditioned only on accepted history. Position ``C+j``
+        of both caches holds the KV of a matched (= accepted) token for
+        every ``j < m``, so rollback to ``C + m`` leaves both caches
+        bit-identical to a plain decode that emitted the same tokens; the
+        stale KV above the frontier is overwritten before it is ever
+        unmasked. Sampled slots accept exactly one token per round, drawn
+        from the verify logits at position 0 (one key split per round).
+        """
+        model, draft_model = self.model, self._draft_model
+        k = self.spec_k
+        paged = self.paged
+
+        def _rollback(cache, delta):
+            out = {}
+            for name, layer in cache.items():
+                att = dict(layer["attention"])
+                att["cursors"] = att["cursors"] - delta
+                out[name] = {"attention": att}
+            return out
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 6))
+        def spec(params, dparams, cache, dcache, tok, temps, rngs, *tables):
+            def draft_one(carry, _):
+                dcache, tok = carry
+                logits, updated = draft_model.apply(
+                    {"params": dparams, "cache": dcache}, tok[:, None],
+                    mutable=["cache"])
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (updated["cache"], nxt), nxt
+
+            # k draft steps: writes the draft KV for tok and d_1..d_{k-1}
+            # (so a fully accepted round leaves the draft cache complete
+            # after rollback); d_k itself is never verified
+            (dcache, _), drafts = jax.lax.scan(
+                draft_one, (dcache, tok), None, length=k)
+            drafts = jnp.moveaxis(drafts, 0, 1)                  # [S, k]
+            seg = jnp.concatenate([tok[:, None], drafts[:, :k - 1]], axis=1)
+            kwargs = {"block_tables": tables[0]} if paged else {}
+            logits, updated = model.apply(
+                {"params": params, "cache": cache}, seg,
+                mutable=["cache"], **kwargs)
+            cache = updated["cache"]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k]
+            pairs = jax.vmap(jax.random.split)(rngs)
+            rngs, keys = pairs[:, 0], pairs[:, 1]
+            sampled = jax.vmap(
+                lambda k_, l, t: jax.random.categorical(
+                    k_, l / jnp.maximum(t, 1e-6))
+            )(keys, logits[:, 0], temps).astype(jnp.int32)
+            match = (drafts[:, :k - 1] == greedy[:, :k - 1]).astype(jnp.int32)
+            m_greedy = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            m = jnp.where(temps > 0.0, 1, m_greedy).astype(jnp.int32)  # [S]
+            toks = jnp.where(temps[:, None] > 0.0,
+                             jnp.concatenate([sampled[:, None], greedy[:, 1:]],
+                                             axis=1),
+                             greedy)                             # [S, k]
+            cache = _rollback(cache, k - m)
+            dcache = _rollback(dcache, k - m)
+            last = jnp.take_along_axis(toks, (m - 1)[:, None], axis=1)[:, 0]
+            return cache, dcache, last, rngs, toks, m
+
+        return spec
+
     def _build_adopt(self):
+        if self.paged:
+            bt = self.kv_block_t
+
+            @functools.partial(jax.jit, donate_argnums=(0, 5, 6, 7))
+            def paged_adopt(cache, small, block_ids, slots, true_lens,
+                            last_tok, temps, rngs, first_toks, temperatures,
+                            slot_rngs):
+                """Paged adoption: scatter each prefill row's first ``L``
+                positions (``L = block_ids.shape[1] * block_t`` — the
+                prompt bucket or the chunked-prefill span, both whole
+                blocks by construction) into the arena rows named by
+                ``block_ids``. Rows' trailing entries are the trash block,
+                so bucket padding past the granted blocks lands in trash;
+                padding inside the last granted block sits above the
+                cursor, which the mask hides until decode overwrites it."""
+                n = slots.shape[0]
+                nb = block_ids.shape[1]
+                ids = block_ids.reshape(-1)
+                out = {}
+                for name, layer in cache.items():
+                    att, small_att = layer["attention"], small[name]["attention"]
+                    shape = small_att["k"].shape                 # [n_pad, max_seq, h, d]
+                    seg_k = small_att["k"][:n, :nb * bt].reshape(
+                        n * nb, bt, shape[2], shape[3])
+                    seg_v = small_att["v"][:n, :nb * bt].reshape(
+                        n * nb, bt, shape[2], shape[3])
+                    k = att["k_arena"].at[ids].set(
+                        seg_k.astype(att["k_arena"].dtype))
+                    v = att["v_arena"].at[ids].set(
+                        seg_v.astype(att["v_arena"].dtype))
+                    cursors = att["cursors"].at[slots].set(true_lens)
+                    out[name] = {"attention": {
+                        "k_arena": k, "v_arena": v, "cursors": cursors}}
+                return (out, last_tok.at[slots].set(first_toks),
+                        temps.at[slots].set(temperatures),
+                        rngs.at[slots].set(slot_rngs))
+
+            return paged_adopt
+
         @functools.partial(jax.jit, donate_argnums=(0, 4, 5, 6))
         def adopt(cache, small, slots, true_lens, last_tok, temps, rngs,
                   first_toks, temperatures, slot_rngs):
@@ -356,8 +632,31 @@ class ContinuousBatcher:
 
         return adopt
 
+    def _build_draft_adopt(self):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def draft_adopt(dcache, small, slots, true_lens):
+            """Splice draft-prefill rows into the (contiguous) draft cache
+            — the sampling state lives with the target adopt; the draft
+            only needs KV + cursors."""
+            n = slots.shape[0]
+            out = {}
+            for name, layer in dcache.items():
+                att, small_att = layer["attention"], small[name]["attention"]
+                k, v = att["k"], att["v"]
+                for i in range(n):
+                    k = jax.lax.dynamic_update_slice(
+                        k, small_att["k"][i:i + 1], (slots[i], 0, 0, 0))
+                    v = jax.lax.dynamic_update_slice(
+                        v, small_att["v"][i:i + 1], (slots[i], 0, 0, 0))
+                cursors = att["cursors"].at[slots].set(true_lens)
+                out[name] = {"attention": {"k": k, "v": v, "cursors": cursors}}
+            return out
+
+        return draft_adopt
+
     def _prefill_group(self, prompts: Sequence[np.ndarray],
-                       temperatures: Sequence[float], keys) -> Tuple[Any, Any]:
+                       temperatures: Sequence[float], keys,
+                       draft: bool = False) -> Tuple[Any, Any]:
         """ONE batched prefill for a same-length-bucket admission group:
         [n_pad, bucket] prompt forward on a reused zero [n_pad, max_seq]
         cache (shared cursor 0 — every row starts at position 0), padded
@@ -371,8 +670,8 @@ class ContinuousBatcher:
         n_pad = self._group_pad
         if n > n_pad:
             raise ValueError(f"admission group of {n} exceeds pad {n_pad}")
-        if (bucket, n_pad) not in self._prefill_fns:
-            model = self._prefill_model
+        if (bucket, n_pad, draft) not in self._prefill_fns:
+            model = self._draft_prefill_model if draft else self._prefill_model
 
             @jax.jit
             def prefill(params, cache, ids, true_lens, temperatures, keys):
@@ -391,11 +690,11 @@ class ContinuousBatcher:
                 first = jnp.where(temperatures > 0.0, sampled, greedy)
                 return updated["cache"], first
 
-            self._prefill_fns[(bucket, n_pad)] = prefill
-        cfg = self.cfg
-        if n_pad not in self._zero_small:
+            self._prefill_fns[(bucket, n_pad, draft)] = prefill
+        cfg = self._draft_cfg if draft else self.cfg
+        if (n_pad, draft) not in self._zero_small:
             kv = (n_pad, cfg.max_seq, cfg.n_heads, cfg.head_dim)
-            self._zero_small[n_pad] = {
+            self._zero_small[(n_pad, draft)] = {
                 f"block_{i}": {"attention": {
                     "k": jnp.zeros(kv, cfg.dtype),
                     "v": jnp.zeros(kv, cfg.dtype),
@@ -403,7 +702,7 @@ class ContinuousBatcher:
                 }}
                 for i in range(cfg.n_layers)
             }
-        small = self._zero_small[n_pad]
+        small = self._zero_small[(n_pad, draft)]
         ids = np.zeros((n_pad, bucket), np.int32)
         true_lens = np.ones((n_pad,), np.int32)
         temps = np.zeros((n_pad,), np.float32)
@@ -414,8 +713,9 @@ class ContinuousBatcher:
         if keys.shape[0] != n_pad:  # pad the key rows (unused rows ignored)
             keys = jnp.concatenate(
                 [keys, jnp.zeros((n_pad - n, 2), keys.dtype)], axis=0)
-        return self._prefill_fns[(bucket, n_pad)](
-            self.params, small, jnp.asarray(ids), jnp.asarray(true_lens),
+        return self._prefill_fns[(bucket, n_pad, draft)](
+            self._draft_params if draft else self.params, small,
+            jnp.asarray(ids), jnp.asarray(true_lens),
             jnp.asarray(temps), keys)
 
     # -- public API ----------------------------------------------------------
@@ -442,6 +742,14 @@ class ContinuousBatcher:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + budget exceeds max_seq")
+        if self.paged:
+            need = self._alloc.blocks_for(len(prompt) + max_new_tokens)
+            if need > self._alloc.n_blocks:
+                # waiting can never help — fail fast instead of pending
+                # forever behind an arena that is too small by construction
+                raise ValueError(
+                    f"prompt + budget needs {need} KV blocks; the arena has "
+                    f"{self._alloc.n_blocks} (raise kv_blocks)")
         req = _Request(prompt, max_new_tokens, eos_id=eos_id,
                        temperature=float(temperature),
                        deadline=deadline, priority=priority, on_done=on_done)
@@ -565,10 +873,20 @@ class ContinuousBatcher:
         chain."""
         events: List[Tuple[str, Any, Any]] = []
         by_bucket: Dict[int, List[Tuple[_Request, Any]]] = {}
+        back: List[_Request] = []  # re-queued (chunked busy / arena full)
         for req in reqs:
             # fresh sampling key per admission (distinct stream per request)
             self._rng_counter += 1
             key = jax.random.fold_in(self._base_rng, self._rng_counter)
+            if self.prefill_chunk and len(req.prompt) > self.prefill_chunk:
+                # long prompt → chunked prefill. One in flight at a time:
+                # it holds a slot from its first chunk, and serializing
+                # keeps prefill compute from flooding the decode stream.
+                if self._chunked is not None or not self._free:
+                    back.append(req)
+                elif not self._start_chunked(req, key):
+                    back.append(req)
+                continue
             try:
                 bucket = _bucket_for(len(req.prompt))
             except Exception as e:  # bad request fails alone, takes no slot
@@ -579,6 +897,28 @@ class ContinuousBatcher:
                   for chunk in by_bucket.values()
                   for i in range(0, len(chunk), self._group_pad)]
         for group in groups:
+            reserved: List[KVReservation] = []
+            if self.paged:
+                # reserve worst-case blocks BEFORE spending prefill compute;
+                # exhaustion is back-pressure (the request stays pending and
+                # retries as retirements free blocks), not an error
+                admit: List[Tuple[_Request, Any]] = []
+                for req, key in group:
+                    blocks = self._alloc.blocks_for(
+                        len(req.prompt) + req.max_new_tokens)
+                    try:
+                        res = self._alloc.reserve(blocks)
+                    except FleetSaturated:
+                        back.append(req)
+                        continue
+                    except Exception as e:
+                        _fail(req, e)
+                        continue
+                    admit.append((req, key))
+                    reserved.append(res)
+                group = admit
+                if not group:
+                    continue
             try:
                 keys = jnp.stack([k for _, k in group])
                 t0 = time.perf_counter()
@@ -586,6 +926,8 @@ class ContinuousBatcher:
                     [r.prompt for r, _ in group],
                     [r.temperature for r, _ in group], keys)
             except Exception as e:  # whole-group failure takes no slots
+                for res in reserved:
+                    self._alloc.release(res)
                 for req, _ in group:
                     _fail(req, e)
                 continue
@@ -597,25 +939,70 @@ class ContinuousBatcher:
                       trace_id=_trace_id(group[0][0]))
             n = len(group)
             slots = [self._free.pop() for _ in range(n)]
+            slots_arr = jnp.asarray(slots, dtype=jnp.int32)
+            true_lens_arr = jnp.asarray(
+                [len(r.prompt) for r, _ in group], dtype=jnp.int32)
             try:
                 # drop the scalar cursor — adopt() resets the row cursors itself
                 small = {nm: {"attention": {"k": l["attention"]["k"],
                                             "v": l["attention"]["v"]}}
                          for nm, l in small.items()}
                 first_n = first[:n]
-                self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
-                    self.cache, small, jnp.asarray(slots, dtype=jnp.int32),
-                    jnp.asarray([len(r.prompt) for r, _ in group], dtype=jnp.int32),
-                    self.last_tok, self.temps, self.rngs, first_n,
-                    jnp.asarray([r.temperature for r, _ in group],
-                                dtype=jnp.float32),
-                    jnp.stack([jax.random.fold_in(k, 1) for _, k in group]))
+                adopt_args = (self.last_tok, self.temps, self.rngs, first_n,
+                              jnp.asarray([r.temperature for r, _ in group],
+                                          dtype=jnp.float32),
+                              jnp.stack([jax.random.fold_in(k, 1)
+                                         for _, k in group]))
+                if self.paged:
+                    # grant each row the blocks its PROMPT needs (decode
+                    # grants the rest as cursors advance) and point its
+                    # table at them — BEFORE the adopt dispatch snapshots
+                    # the block ids
+                    bucket = _bucket_for(max(len(r.prompt) for r, _ in group))
+                    nb = bucket // self.kv_block_t
+                    block_ids = np.full((n, nb), self._alloc.trash, np.int32)
+                    for i, ((req, _), slot, res) in enumerate(
+                            zip(group, slots, reserved)):
+                        self._alloc.grant(
+                            res, self._alloc.blocks_for(len(req.prompt)))
+                        block_ids[i, :len(res.granted)] = res.granted
+                        self._tables[slot, :len(res.granted)] = res.granted
+                        self._slot_res[slot] = res
+                        self._ub_cursor[slot] = len(req.prompt)
+                    self.cache, self.last_tok, self.temps, self.rngs = \
+                        self._adopt_fn(self.cache, small,
+                                       jnp.asarray(block_ids), slots_arr,
+                                       true_lens_arr, *adopt_args)
+                else:
+                    self.cache, self.last_tok, self.temps, self.rngs = \
+                        self._adopt_fn(self.cache, small, slots_arr,
+                                       true_lens_arr, *adopt_args)
+                if self.spec_k:
+                    # the draft must adopt the same prompts before any spec
+                    # round includes these rows; a failure here is engine
+                    # state corruption, so it propagates to the loop's
+                    # catch-all (fail everything, close) rather than being
+                    # swallowed per-group
+                    dsmall, _ = self._prefill_group(
+                        [r.prompt for r, _ in group],
+                        [r.temperature for r, _ in group], keys, draft=True)
+                    dsmall = {nm: {"attention": {"k": l["attention"]["k"],
+                                                 "v": l["attention"]["v"]}}
+                              for nm, l in dsmall.items()}
+                    self.draft_cache = self._draft_adopt_fn(
+                        self.draft_cache, dsmall, slots_arr, true_lens_arr)
             except Exception as e:
                 # Adopt failed AFTER the slots were popped: these requests
                 # are in neither _active nor the pending queue, so _shutdown
                 # could never fail them — callers would block until their
                 # result() timeout. Restore the slots and fail the group now.
                 self._free.extend(slots)
+                if self.paged:
+                    for slot, res in zip(slots, reserved):
+                        self._tables[slot, :] = self._alloc.trash
+                        self._slot_res.pop(slot, None)
+                        self._ub_cursor[slot] = 0
+                        self._alloc.release(res)
                 for req, _ in group:
                     _fail(req, e)
                 continue
@@ -638,8 +1025,225 @@ class ContinuousBatcher:
             events.append(("first", first_n,
                            [(req, slot) for (req, _), slot in zip(group, slots)],
                            now))
+        if back:
+            # requeue at the FRONT in arrival order: these requests lost no
+            # place in line — they only wait for arena blocks or for the
+            # (serialized) chunked-prefill lane to free up
+            for r in reversed(back):
+                self._pending.appendleft(r)
+            self._set_queue_gauge()
         self._set_occupancy()
         return events
+
+    # -- chunked prefill (ISSUE 12) ------------------------------------------
+    def _build_chunk_prefill(self):
+        model = self._prefill_model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def chunk_prefill(params, cache, ids, first_idx, temperature, key):
+            logits, updated = model.apply(
+                {"params": params, "cache": cache}, ids, mutable=["cache"])
+            # only the LAST chunk's call reads a real token (first_idx =
+            # the prompt's true last position inside that chunk); earlier
+            # chunks pass 0 and discard the result
+            lg = logits[0, first_idx]
+            greedy = jnp.argmax(lg).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key, lg / jnp.maximum(temperature, 1e-6)).astype(jnp.int32)
+            return updated["cache"], jnp.where(
+                temperature > 0.0, sampled, greedy)
+
+        return chunk_prefill
+
+    def _start_chunked(self, req: _Request, key) -> bool:
+        """Claim a slot (and, paged, the worst-case block reservation) for
+        one long prompt and install it as THE in-flight chunked prefill —
+        the actual chunk dispatches happen one per engine iteration from
+        :meth:`_advance_chunked` so decode keeps ticking in between.
+        Returns False when the arena cannot reserve yet (caller requeues);
+        a structurally impossible request fails and returns True."""
+        res = None
+        if self.paged:
+            blocks = self._alloc.blocks_for(len(req.prompt) + req.max_new_tokens)
+            try:
+                res = self._alloc.reserve(blocks)
+            except FleetSaturated:
+                return False
+            except Exception as e:
+                _fail(req, e)
+                return True
+        cfg = self.cfg
+        kv = (1, cfg.max_seq, cfg.n_heads, cfg.head_dim)
+        cache = {
+            f"block_{i}": {"attention": {
+                "k": jnp.zeros(kv, cfg.dtype),
+                "v": jnp.zeros(kv, cfg.dtype),
+                "cursor": jnp.zeros((), jnp.int32),
+            }}
+            for i in range(cfg.n_layers)
+        }
+        slot = self._free.pop()
+        self._chunked = _ChunkedPrefill(req=req, slot=slot, cache=cache,
+                                        key=key, res=res)
+        _ev(req, "chunked_prefill_start", slot=slot,
+            chunks=-(-len(req.prompt) // self.prefill_chunk))
+        return True
+
+    def _abort_chunked(self, cp: _ChunkedPrefill) -> None:
+        """Release a mid-prefill request's slot and (paged) blocks; the
+        caller completes/fails the request itself. Retire ordering applies
+        here too: the table row goes to trash before the blocks return."""
+        if self.paged:
+            self._tables[cp.slot, :] = self._alloc.trash
+            self._slot_res.pop(cp.slot, None)
+            self._ub_cursor[cp.slot] = 0
+            if cp.res is not None:
+                self._alloc.release(cp.res)
+        self._free.append(cp.slot)
+        self._chunked = None
+
+    def _advance_chunked(self) -> List[Tuple[str, Any, Any, float]]:
+        """Dispatch ONE prefill chunk for the in-flight long prompt; on the
+        last chunk, adopt into the shared cache and activate the slot.
+        Returns the pipelined 'first' event when the adoption happens."""
+        cp = self._chunked
+        req = cp.req
+        if req.done.is_set():  # failed/completed elsewhere; just clean up
+            self._abort_chunked(cp)
+            return []
+        if req.cancel_requested:
+            req.finish_reason = "cancelled"
+            METRICS.counter("serving_cancelled_total").inc()
+            _ev(req, "cancelled", stage="prefill")
+            self._abort_chunked(cp)
+            _fail(req, RequestCancelled("cancelled during chunked prefill"))
+            return []
+        if req.expired():
+            req.finish_reason = "deadline"
+            METRICS.counter("serving_deadline_expired_total",
+                            stage="prefill").inc()
+            _ev(req, "deadline_expired", stage="prefill")
+            self._abort_chunked(cp)
+            _fail(req, DeadlineExceeded(
+                "deadline expired during chunked prefill"))
+            return []
+        if self._chunk_prefill_fn is None:
+            self._chunk_prefill_fn = self._build_chunk_prefill()
+        n = len(req.prompt)
+        c = self.prefill_chunk
+        start = cp.pos
+        seg = req.prompt[start:start + c]
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :len(seg)] = seg
+        last = start + c >= n
+        # padding past the prompt (final chunk only) writes garbage KV at
+        # positions >= n; adoption sets the cursor to n, so the mask hides
+        # it until decode overwrites position n onward
+        first_idx = (n - 1) - start if last else 0
+        cp.cache, first = self._chunk_prefill_fn(
+            self.params, cp.cache, jnp.asarray(ids),
+            jnp.asarray(first_idx, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32), cp.key)
+        cp.pos = start + c
+        METRICS.counter("serving_prefill_chunks_total").inc()
+        _ev(req, "prefill_chunk", start=start)
+        if not last:
+            return []
+        # -- last chunk: adopt + activate -----------------------------------
+        slot = cp.slot
+        first_arr = first[None]
+        small = {nm: {"attention": {"k": l["attention"]["k"],
+                                    "v": l["attention"]["v"]}}
+                 for nm, l in cp.cache.items()}
+        slots_arr = jnp.asarray([slot], jnp.int32)
+        true_lens_arr = jnp.asarray([n], jnp.int32)
+        adopt_args = (self.last_tok, self.temps, self.rngs, first_arr,
+                      jnp.asarray([req.temperature], jnp.float32),
+                      jax.random.fold_in(cp.key, 1)[None])
+        if self.paged:
+            nb = cp.pos // self.kv_block_t  # whole blocks: bt | chunk
+            block_ids = np.full((1, nb), self._alloc.trash, np.int32)
+            self._alloc.grant(cp.res, self._alloc.blocks_for(n))
+            block_ids[0, :len(cp.res.granted)] = cp.res.granted
+            self._tables[slot, :len(cp.res.granted)] = cp.res.granted
+            self._slot_res[slot] = cp.res
+            self._ub_cursor[slot] = n
+            self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
+                self.cache, small, jnp.asarray(block_ids), slots_arr,
+                true_lens_arr, *adopt_args)
+        else:
+            self.cache, self.last_tok, self.temps, self.rngs = self._adopt_fn(
+                self.cache, small, slots_arr, true_lens_arr, *adopt_args)
+        if self.spec_k:
+            # the draft adopts the full prompt in one forward (its whole
+            # point is being small; chunking IT would serialize more
+            # dispatches for no decode-lane benefit)
+            dcfg = self._draft_cfg
+            kv = (1, dcfg.max_seq, dcfg.n_heads, dcfg.head_dim)
+            dzero = {
+                f"block_{i}": {"attention": {
+                    "k": jnp.zeros(kv, dcfg.dtype),
+                    "v": jnp.zeros(kv, dcfg.dtype),
+                    "cursor": jnp.zeros((), jnp.int32),
+                }}
+                for i in range(dcfg.n_layers)
+            }
+            if self._draft_full_prefill_fn is None:
+                dmodel = self._draft_prefill_model
+
+                @jax.jit
+                def draft_full(params, cache, ids):
+                    _, updated = dmodel.apply(
+                        {"params": params, "cache": cache}, ids,
+                        mutable=["cache"])
+                    return updated["cache"]
+
+                self._draft_full_prefill_fn = draft_full
+            dids = np.zeros((1, cp.pos), np.int32)
+            dids[0, :n] = req.prompt
+            dsmall = self._draft_full_prefill_fn(
+                self._draft_params, dzero, jnp.asarray(dids))
+            dsmall = {nm: {"attention": {"k": l["attention"]["k"],
+                                         "v": l["attention"]["v"]}}
+                      for nm, l in dsmall.items()}
+            self.draft_cache = self._draft_adopt_fn(
+                self.draft_cache, dsmall, slots_arr, true_lens_arr)
+        try:
+            first_arr.copy_to_host_async()
+        except Exception:
+            pass
+        now = time.perf_counter()
+        self._active[slot] = req
+        if req.submit_at is not None:
+            METRICS.histogram(
+                "serving_queue_wait_seconds", buckets=QUEUE_WAIT_BUCKETS,
+            ).observe(now - req.submit_at, trace_id=_trace_id(req))
+        _ev(req, "admitted", slot=slot)
+        _ev(req, "prefill_done")
+        self._chunked = None
+        self._set_occupancy()
+        return [("first", first_arr, [(req, slot)], now)]
+
+    def _grant_active(self, tokens: int) -> None:
+        """Advance every active slot's cursor upper bound by the tokens the
+        next dispatch may write and grant the blocks that frontier needs —
+        BEFORE the dispatch snapshots the table. The bound (not the exact
+        data-dependent cursor, which spec rounds make device-resident)
+        drives granting; positions past ``res.total`` stay on trash, which
+        only retired-but-undrained rows can reach."""
+        if not self.paged:
+            return
+        max_seq = self.cfg.max_seq
+        for slot in self._active:
+            res = self._slot_res.get(slot)
+            if res is None:
+                continue
+            ub = min(int(self._ub_cursor[slot]) + tokens, max_seq)
+            self._ub_cursor[slot] = ub
+            base = len(res.granted)
+            for off, blk in enumerate(
+                    self._alloc.grant(res, self._alloc.blocks_for(ub))):
+                self._tables[slot, base + off] = blk
 
     def _set_occupancy(self) -> None:
         active = len(self._active)
@@ -651,6 +1255,19 @@ class ContinuousBatcher:
     def _retire(self, slot: int) -> None:
         req = self._active.pop(slot)
         self._free.append(slot)
+        if self.paged:
+            # retire-ordering invariant: redirect the table row to TRASH
+            # before the blocks return to the free list. Later dispatches
+            # snapshot the trashed table, so a block re-granted to another
+            # slot can only be written by (a) dispatches issued before this
+            # retire — which execute before the new slot's adopt overwrites
+            # the block (device streams run in issue order) — or (b) the
+            # new slot itself. Never a corrupting interleave.
+            self._tables[slot, :] = self._alloc.trash
+            res = self._slot_res.pop(slot, None)
+            if res is not None:
+                self._alloc.release(res)
+            self._ub_cursor[slot] = 0
         req.done_at = time.perf_counter()
         if req.finish_reason is None:
             req.finish_reason = "ok"
@@ -764,6 +1381,12 @@ class ContinuousBatcher:
         """Fail everything in flight, pending, and still queued — all with
         the SAME cause, so a device failure is debuggable from any failed
         caller, not only the in-flight ones."""
+        if self._chunked is not None:
+            # mid-prefill request: in neither _active nor _pending — it
+            # would hang its caller if this path forgot it
+            cp = self._chunked
+            self._abort_chunked(cp)
+            _fail(cp.req, EngineClosed(cause))
         for req in self._active.values():
             _fail(req, EngineClosed(cause))
         self._active.clear()
@@ -787,7 +1410,16 @@ class ContinuousBatcher:
         a row whose request finished in an earlier event is a discarded
         tail; a row adopted after the dispatch is not in the snapshot."""
         kind, dev, meta, dispatched_at = event
-        block = np.asarray(dev)  # host fetch (async copy started at dispatch)
+        widths = None
+        if kind == "spec":
+            # one speculative round: [slots, spec_k] candidate tokens plus
+            # the per-slot accepted width m (1..spec_k) — only the first
+            # m are real, the rest were refuted by the verify forward
+            toks_dev, acc_dev = dev
+            block = np.asarray(toks_dev)
+            widths = np.asarray(acc_dev)
+        else:
+            block = np.asarray(dev)  # host fetch (async copy started at dispatch)
         now = time.perf_counter()
         if kind == "first":
             for (req, slot), tok in zip(meta, block):
@@ -817,19 +1449,31 @@ class ContinuousBatcher:
             "serving_decode_chunk_seconds", buckets=DECODE_CHUNK_BUCKETS
         ).observe(now - dispatched_at)
         for slot, req in meta.items():
+            # usable tokens this row produced: the whole chunk, or the
+            # accepted prefix of a speculative round
+            width = int(widths[slot]) if widths is not None else block.shape[1]
+            if widths is not None and not req.done.is_set():
+                # accept-rate numerators: spec_k - 1 verifiable drafts per
+                # round; width - 1 of them accepted (the +1 is the target's
+                # own token, drafted or not)
+                METRICS.counter("serving_spec_tokens_drafted_total").inc(
+                    self.spec_k - 1)
+                if width > 1:
+                    METRICS.counter("serving_spec_tokens_accepted_total").inc(
+                        width - 1)
             if req.done.is_set():
                 # retired in an earlier event; this row's whole block was
                 # computed for nobody — the engine's "preempted work" cost
                 METRICS.counter("serving_discarded_tail_tokens_total").inc(
-                    block.shape[1])
+                    width)
                 if req.finish_reason in ("deadline", "cancelled"):
                     # tokens generated past an expired deadline / abandoned
                     # future — the goodput-loss counter (ISSUE 9)
                     METRICS.counter("serving_wasted_decode_tokens_total").inc(
-                        block.shape[1])
+                        width)
                 continue
             appended = 0
-            for j in range(block.shape[1]):
+            for j in range(width):
                 tok = int(block[slot, j])
                 req.tokens.append(tok)
                 appended += 1
@@ -842,7 +1486,7 @@ class ContinuousBatcher:
                     self._retire(slot)
                     METRICS.counter(
                         "serving_discarded_tail_tokens_total"
-                    ).inc(block.shape[1] - j - 1)
+                    ).inc(width - j - 1)
                     appended = 0
                     break
             if appended:
@@ -861,7 +1505,8 @@ class ContinuousBatcher:
         events: "collections.deque[Tuple[str, Any, Any, float]]" = collections.deque()
 
         def chunk_depth() -> int:
-            return sum(1 for kind, _, _, _ in events if kind == "chunk")
+            return sum(1 for kind, _, _, _ in events
+                       if kind in ("chunk", "spec"))
 
         while True:
             # drain arrivals into the pending deque; block only when fully
@@ -869,7 +1514,8 @@ class ContinuousBatcher:
             # of single submits admit as ONE batched prefill.
             try:
                 timeout = (None if not (self._active or self._pending
-                                        or events or self._draining) else 0.0)
+                                        or events or self._draining
+                                        or self._chunked) else 0.0)
                 while True:
                     item = self._queue.get(timeout=timeout) if timeout is None \
                         else self._queue.get_nowait()
@@ -911,20 +1557,47 @@ class ContinuousBatcher:
                     self._set_queue_gauge()
                     events.extend(self._admit_wave(wave))
                     dispatched = True
+                if self._chunked is not None:
+                    # ONE prefill chunk per iteration, interleaved between
+                    # decode dispatches — TTFT of the chatty slots stops
+                    # being hostage to the longest prompt (drain included:
+                    # the mid-prefill request is in-flight work)
+                    events.extend(self._advance_chunked())
+                    dispatched = True
                 if self._active:
-                    # one CHUNK of decode steps for every slot (inactive
-                    # rows compute too — static shapes are the TPU
-                    # contract; their outputs are discarded when processed
-                    # against the snapshot)
-                    self.cache, self.last_tok, self.rngs, toks = self._step_fn(
-                        self.params, self.cache, self.last_tok, self.temps,
-                        self.rngs)
-                    try:
-                        toks.copy_to_host_async()
-                    except Exception:
-                        pass
-                    events.append(("chunk", toks, dict(self._active),
-                                   time.perf_counter()))
+                    # one CHUNK of decode steps (or one speculative round)
+                    # for every slot (inactive rows compute too — static
+                    # shapes are the TPU contract; their outputs are
+                    # discarded when processed against the snapshot)
+                    self._grant_active(self.spec_k if self.spec_k
+                                       else self.chunk)
+                    extra = ((jnp.asarray(self._tables),)
+                             if self.paged else ())
+                    if self.spec_k:
+                        (self.cache, self.draft_cache, self.last_tok,
+                         self.rngs, toks, acc) = self._spec_fn(
+                            self.params, self._draft_params, self.cache,
+                            self.draft_cache, self.last_tok, self.temps,
+                            self.rngs, *extra)
+                        try:
+                            toks.copy_to_host_async()
+                            acc.copy_to_host_async()
+                        except Exception:
+                            pass
+                        events.append(("spec", (toks, acc),
+                                       dict(self._active),
+                                       time.perf_counter()))
+                    else:
+                        self.cache, self.last_tok, self.rngs, toks = \
+                            self._step_fn(self.params, self.cache,
+                                          self.last_tok, self.temps,
+                                          self.rngs, *extra)
+                        try:
+                            toks.copy_to_host_async()
+                        except Exception:
+                            pass
+                        events.append(("chunk", toks, dict(self._active),
+                                       time.perf_counter()))
                     dispatched = True
                 # keep the dispatch frontier at most ``pipeline`` chunks
                 # ahead of the processed state; when nothing new could be
@@ -933,7 +1606,8 @@ class ContinuousBatcher:
                     self._process_event(events.popleft())
                 if not dispatched and events:
                     self._process_event(events.popleft())
-                if self._draining and not self._active and not events:
+                if (self._draining and not self._active and not events
+                        and self._chunked is None):
                     # drain complete: every in-flight slot ran to its
                     # budget/EOS; park the unserved pendings (futures still
                     # open) for the caller and zero this replica's gauges

@@ -206,6 +206,7 @@ class EngineFleet:
                  pipeline: int = 3, name: str = "fleet",
                  router: Optional[PrefixRouter] = None,
                  engine_factory: Optional[Callable[[str], Any]] = None,
+                 engine_kwargs: Optional[Dict[str, Any]] = None,
                  client: Any = None, namespace: str = "default",
                  replica_chips: int = 0, priority_class: str = "default",
                  poll_interval: float = 0.2, register_debug: bool = True,
@@ -234,9 +235,14 @@ class EngineFleet:
             def engine_factory(engine_id: str):
                 from .continuous import ContinuousBatcher
 
+                # engine_kwargs: ISSUE-12 per-engine knobs (paged KV arena
+                # sizing, chunked prefill, speculative decoding) forwarded
+                # verbatim so GenerativeModel configures fleets and single
+                # engines identically
                 return ContinuousBatcher(cfg, params, slots=slots,
                                          chunk=chunk, pipeline=pipeline,
-                                         engine_id=engine_id)
+                                         engine_id=engine_id,
+                                         **(engine_kwargs or {}))
 
         self._factory = engine_factory
         self._lock = threading.RLock()

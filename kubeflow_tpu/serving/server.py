@@ -266,6 +266,19 @@ class GenerativeModel(ServedModel):
     replicas: int = 1
     #: autoscaler headroom; None pins the fleet at ``replicas``
     max_replicas: Optional[int] = None
+    # -- ISSUE-12 engine knobs (docs/SERVING.md has the full table) --------
+    #: paged (block-arena) KV layout; False keeps the contiguous parity path
+    paged: bool = True
+    #: allocatable arena blocks (None = contiguous-capacity parity)
+    kv_blocks: Optional[int] = None
+    #: requested arena tile (auto-shrunk to divide max_seq + the buckets)
+    kv_block_t: int = 16
+    #: chunked-prefill budget (None = largest prefill bucket; 0 disables —
+    #: over-bucket prompts then fall back to the static generate() path)
+    prefill_chunk: Optional[int] = None
+    #: (draft_cfg, draft_params) enables speculative decoding
+    spec_draft: Optional[Any] = None
+    spec_k: int = 4
 
     def __post_init__(self):
         # Per-request sampling state: a base key seeded from OS entropy folded
@@ -282,6 +295,10 @@ class GenerativeModel(ServedModel):
     def _continuous_engine(self):
         from .continuous import ContinuousBatcher
 
+        engine_kwargs = dict(paged=self.paged, kv_blocks=self.kv_blocks,
+                             kv_block_t=self.kv_block_t,
+                             prefill_chunk=self.prefill_chunk,
+                             spec_draft=self.spec_draft, spec_k=self.spec_k)
         with self._engine_lock:
             if self._engine is None:
                 if self.replicas > 1 or self.max_replicas:
@@ -290,10 +307,12 @@ class GenerativeModel(ServedModel):
                     self._engine = EngineFleet(
                         self.cfg, self.params, replicas=self.replicas,
                         max_replicas=self.max_replicas or max(self.replicas, 1),
-                        slots=self.slots, name=self.name)
+                        slots=self.slots, name=self.name,
+                        engine_kwargs=engine_kwargs)
                 else:
                     self._engine = ContinuousBatcher(self.cfg, self.params,
-                                                     slots=self.slots)
+                                                     slots=self.slots,
+                                                     **engine_kwargs)
             return self._engine
 
     def close(self) -> None:
@@ -321,11 +340,21 @@ class GenerativeModel(ServedModel):
         # the static path's generate() would turn this into a 500)
         if prompts.shape[1] + self.max_new_tokens > self.cfg.max_seq:
             raise HttpError(413, "prompt + generation budget exceeds max_seq")
-        # prompts longer than the engine's largest prefill bucket take the
-        # static generate() path instead of erroring: flipping continuous
-        # on by default must not shrink the servable prompt range below
-        # cfg.max_seq (review finding, round 5)
-        if self.continuous and prompts.shape[1] <= PREFILL_BUCKETS[-1]:
+        # prompts longer than the engine's largest prefill bucket: chunked
+        # prefill (ISSUE 12) serves them through the engine when enabled —
+        # effective_prefill_chunk here MUST mirror the engine's own
+        # resolution so routing and admission agree; when disabled they
+        # take the static generate() path instead of erroring (flipping
+        # continuous on must not shrink the servable prompt range below
+        # cfg.max_seq — review finding, round 5)
+        from .continuous import _block_tile, effective_prefill_chunk
+
+        chunk = effective_prefill_chunk(
+            self.prefill_chunk, self.cfg.max_seq,
+            _block_tile(self.cfg.max_seq, self.kv_block_t)
+            if self.paged else 1)
+        if self.continuous and (prompts.shape[1] <= PREFILL_BUCKETS[-1]
+                                or chunk > 0):
             from ..runtime.tracing import TRACER, format_traceparent
 
             eng = self._continuous_engine()
@@ -354,6 +383,11 @@ class GenerativeModel(ServedModel):
             except FleetSaturated as e:
                 raise HttpError(503, f"fleet saturated: {e}",
                                 headers=retry_after_headers(e)) from e
+            except ValueError as e:
+                # structurally unservable request (e.g. prompt + budget
+                # needs more KV blocks than the arena holds): the client's
+                # fault, so 400 — never a 500 (ISSUE 12 satellite)
+                raise HttpError(400, str(e)) from e
             except DeadlineExceeded as e:
                 raise HttpError(504, f"deadline exceeded: {e}") from e
             except TimeoutError as e:

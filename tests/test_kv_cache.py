@@ -147,3 +147,124 @@ def test_continuous_batcher_accepts_kv_kernel():
     from kubeflow_tpu.serving.continuous import ContinuousBatcher
 
     assert "kv_kernel" in inspect.signature(ContinuousBatcher.__init__).parameters
+
+
+# -- paged (block-table) variants — ISSUE 12 ---------------------------------
+
+from kubeflow_tpu.ops.kv_cache import kv_block_update, kv_block_update_ref
+from kubeflow_tpu.serving.paged import KVBlockAllocator, KVBlocksExhausted
+
+
+def _paged_reference(arena, seg, cursors, tables, max_seq):
+    """Plain-numpy oracle: write seg[s, j] at the block-table-mapped
+    position cursors[s] + j; out-of-range positions land in the trash row
+    (arena's last)."""
+    out = np.array(arena, copy=True)
+    N, bt = out.shape[:2]
+    for s in range(seg.shape[0]):
+        for j in range(seg.shape[1]):
+            pos = int(cursors[s]) + j
+            blk = int(tables[s, pos // bt]) if pos < max_seq else N - 1
+            out[blk, pos % bt] = seg[s, j]
+    return out
+
+
+@pytest.mark.parametrize("interpret", [True])
+def test_block_update_matches_reference(interpret):
+    """Pallas block-update kernel == XLA scatter reference == numpy oracle,
+    over random cursors and a shuffled (non-identity) block table."""
+    S, MB, bt, H, D = 5, 4, 8, 2, 4
+    max_seq = MB * bt
+    n_blocks = S * MB
+    rng = np.random.default_rng(7)
+    arena_np = rng.normal(size=(n_blocks + 1, bt, H, D)).astype(np.float32)
+    new_np = rng.normal(size=(S, H, D)).astype(np.float32)
+    cursors = rng.integers(0, max_seq, S).astype(np.int32)
+    perm = rng.permutation(n_blocks)[: S * MB].reshape(S, MB).astype(np.int32)
+    want = _paged_reference(arena_np, new_np[:, None], cursors, perm, max_seq)
+    out_k = kv_block_update(jnp.asarray(arena_np), jnp.asarray(new_np),
+                            jnp.asarray(cursors), jnp.asarray(perm),
+                            max_seq=max_seq, interpret=interpret)
+    np.testing.assert_array_equal(np.asarray(out_k), want)
+    out_r = kv_block_update_ref(jnp.asarray(arena_np),
+                                jnp.asarray(new_np)[:, None],
+                                jnp.asarray(cursors), jnp.asarray(perm),
+                                max_seq=max_seq)
+    np.testing.assert_array_equal(np.asarray(out_r), want)
+
+
+def test_block_update_out_of_range_writes_only_trash():
+    """Cursors at/past max_seq: the kernel leaves EVERY real block
+    untouched (same no-op contract as kv_row_update); the scatter
+    reference redirects the write into the trash row — either way no real
+    data can be corrupted by a retired/idle row stepping past its end."""
+    S, MB, bt, H, D = 3, 2, 4, 2, 4
+    max_seq = MB * bt
+    n_blocks = S * MB
+    arena = jnp.zeros((n_blocks + 1, bt, H, D), jnp.float32)
+    new = jnp.ones((S, H, D), jnp.float32)
+    tables = jnp.arange(S * MB, dtype=jnp.int32).reshape(S, MB)
+    cursors = jnp.asarray([max_seq, max_seq + 3, 1], jnp.int32)
+    for out in (
+        kv_block_update(arena, new, cursors, tables, max_seq=max_seq,
+                        interpret=True),
+        kv_block_update_ref(arena, new[:, None], cursors, tables,
+                            max_seq=max_seq),
+    ):
+        out = np.asarray(out)
+        assert out[tables[2, 0], 1].all()          # in-range row wrote
+        assert out[: n_blocks].sum() == H * D      # ...and ONLY that row
+    # multi-token segment straddling max_seq: the tail goes to trash
+    seg = jnp.ones((1, 3, H, D), jnp.float32)
+    out = np.asarray(kv_block_update_ref(
+        arena, seg, jnp.asarray([max_seq - 1], jnp.int32), tables[:1],
+        max_seq=max_seq))
+    assert out[: n_blocks].sum() == H * D          # one real write
+    assert out[n_blocks].sum() == 2 * H * D        # two trash writes
+
+
+def test_block_allocator_accounting_and_backpressure():
+    alloc = KVBlockAllocator(8, 16)
+    assert alloc.trash == 8 and alloc.available() == 8 and alloc.used() == 0
+    assert alloc.blocks_for(1) == 1 and alloc.blocks_for(16) == 1
+    assert alloc.blocks_for(17) == 2
+    res = alloc.reserve(5)
+    # reserved-but-ungranted blocks count against available, not used
+    assert alloc.available() == 3 and alloc.used() == 0
+    got = alloc.grant(res, 2)
+    assert len(got) == 2 and res.granted == got
+    assert alloc.used() == 2 and alloc.available() == 3
+    assert alloc.grant(res, 2) == []               # idempotent up-to
+    # exhaustion -> FleetSaturated-family back-pressure, never corruption
+    with pytest.raises(KVBlocksExhausted):
+        alloc.reserve(4)
+    from kubeflow_tpu.serving.errors import FleetSaturated
+    assert issubclass(KVBlocksExhausted, FleetSaturated)
+    res2 = alloc.reserve(3)
+    alloc.grant(res2, 3)
+    assert alloc.available() == 0 and alloc.used() == 5
+    # impossible request fails fast (waiting can never help)
+    with pytest.raises(ValueError):
+        alloc.reserve(9)
+    # release returns granted AND promised blocks
+    alloc.release(res)
+    assert alloc.available() == 5 and alloc.used() == 3
+    alloc.release(res2)
+    assert alloc.available() == 8 and alloc.used() == 0
+    # grant caps at the reservation total; trash is never handed out
+    res3 = alloc.reserve(2)
+    granted = alloc.grant(res3, 99)
+    assert len(granted) == 2 and alloc.trash not in granted
+
+
+def test_block_allocator_publishes_gauges():
+    from kubeflow_tpu.runtime.metrics import METRICS
+
+    alloc = KVBlockAllocator(4, 8, engine_id="gauge-test")
+    res = alloc.reserve(3)
+    alloc.grant(res, 3)
+    free = METRICS.gauge("serving_kv_blocks_free", replica="gauge-test")
+    used = METRICS.gauge("serving_kv_blocks_used", replica="gauge-test")
+    assert free.value == 1 and used.value == 3
+    alloc.release(res)
+    assert free.value == 4 and used.value == 0

@@ -150,6 +150,7 @@ def test_binding_authority_stays_in_scheduler():
 # softmax, and the final logits/classifier head.
 F32_MATMUL_ALLOWLIST = {
     ("gpt.py", "GptAttention._decode_attention"),  # decode softmax island
+    ("gpt.py", "GptAttention._paged_decode_attention"),  # same island, paged
     ("gpt.py", "GptLM.__call__"),                  # f32 logits head
     ("gpt.py", "causal_lm_loss"),
     ("gpt.py", "blockwise_causal_lm_loss"),

@@ -46,6 +46,11 @@ SPECS: Dict[str, Tuple[str, float]] = {
     "serving_gpt_kv_decode_tokens_per_sec_b8": ("higher", 0.05),
     "serving_decode_tokens_per_sec_b8": ("higher", 0.05),
     "serving_bert_p50_ms_b8": ("lower", 0.05),
+    # ISSUE-12 serving SLI rows: ttft_p99 comes from histogram-bucket
+    # interpolation over a 16-request window (coarse buckets -> wide band);
+    # the speculative accept rate is a model property, steady run to run.
+    "serving_ttft_p99_s": ("lower", 0.25),
+    "spec_accept_rate": ("higher", 0.10),
     "hpo_trials_per_hour": ("higher", 0.15),
     "hpo_mnist_trials_per_hour": ("higher", 0.15),
     "multichip_tokens_per_sec_per_chip": ("higher", 0.10),
@@ -70,6 +75,8 @@ SUMMARY_KEYS = (
     "gpt2_medium_tokens_per_sec",
     "serving_decode_tokens_per_sec_b8",
     "serving_bert_p50_ms_b8",
+    "serving_ttft_p99_s",
+    "spec_accept_rate",
     "hpo_trials_per_hour",
     "multichip_tokens_per_sec_per_chip",
     "multichip_scaling_efficiency",
