@@ -166,6 +166,26 @@ def controlplane_scale_e2e(name: str = "controlplane-scale-e2e",
     return b.build()
 
 
+def apf_e2e() -> Dict:
+    """The API priority-and-fairness job: a fairness-gated apiserver with
+    the scheduler reconciling through the gate as ``system:scheduler``
+    while a seeded abusive tenant floods LIST/watch/churn — gang waves must
+    keep binding with p99 within 2x the same-run quiet baseline, the
+    low-priority flood must shed with 429 + Retry-After (and the scheduler
+    flow never be rejected), watch storms must ride the watch cache, a
+    compacted watcher must recover through 410 -> paginated relist, and the
+    fairness-disabled control must shed nothing
+    (e2e/fairness_driver.py asserts all of it), plus the flow-control /
+    pagination / watch-cache / client-backoff / sharded-workqueue unit
+    suite."""
+    b = WorkflowBuilder("apf-e2e")
+    b.run("fairness-abuse-driver", ["python", "-m", "e2e.fairness_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("fairness-unit", "tests/test_fairness.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 def serving_fleet_e2e() -> Dict:
     """The serving-fleet job: a 3-replica engine fleet over real HTTP —
     prefix-affinity hits, a synthetic SLO breach scaling the fleet up and
@@ -288,6 +308,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "controlplane-scale-e2e": controlplane_scale_e2e,
     "controlplane-scale-e2e-5k": lambda: controlplane_scale_e2e(
         name="controlplane-scale-e2e-5k", nodes=5000, timeout_s=1800),
+    "apf-e2e": apf_e2e,
     "serving-fleet-e2e": serving_fleet_e2e,
     "serving-overload-e2e": serving_overload_e2e,
     "paged-kv-e2e": paged_kv_e2e,

@@ -10,7 +10,11 @@ APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py),
 APISERVER_TLS_CERT_FILE + APISERVER_TLS_KEY_FILE to serve HTTPS (the
 reference substrate is TLS-only; clients verify via APISERVER_CA_FILE —
 web/tls.py). Bearer tokens over plaintext HTTP are only acceptable for
-loopback dev runs.
+loopback dev runs. APISERVER_FAIRNESS=1 (the deployment default in
+manifests) turns on the priority-and-fairness gate (fairness.py): requests
+are classified into priority levels by flow identity and shed with 429 +
+Retry-After when a level's queues overflow; =0/unset keeps the open
+admit-everything dev behavior.
 """
 
 from __future__ import annotations
@@ -31,7 +35,13 @@ def main() -> None:
     store = Store()
     webhook_url = os.environ.get("WEBHOOK_URL", "")
     auth = auth_from_env(store)
-    app = make_apiserver_app(store, webhook_url=webhook_url or None, auth=auth)
+    fairness = None
+    if os.environ.get("APISERVER_FAIRNESS", "") not in ("", "0", "false"):
+        from .fairness import FlowController
+
+        fairness = FlowController()
+    app = make_apiserver_app(store, webhook_url=webhook_url or None, auth=auth,
+                             fairness=fairness)
     if not webhook_url:
         store.register_admission(
             admission_hook(Client(store), cluster_domain=os.environ.get("CLUSTER_DOMAIN", "cluster.local"))
@@ -53,12 +63,13 @@ def main() -> None:
         ctx = server_context(cert, key)
     server = app.serve(port, host="0.0.0.0", ssl_context=ctx)
     logging.getLogger("kubeflow_tpu.apiserver").info(
-        "apiserver on :%d (%s, backend=%s, admission=%s, auth=%s)",
+        "apiserver on :%d (%s, backend=%s, admission=%s, auth=%s, fairness=%s)",
         server.port,
         "TLS" if ctx else "plain HTTP",
         type(store.backend).__name__,
         webhook_url or "in-process",
         "token+rbac" if auth else "open",
+        "on" if fairness else "off",
     )
     try:
         block_forever()

@@ -4,35 +4,90 @@ Controllers and web backends use this interface; it is shaped so an HTTP
 implementation against a real Kubernetes API server is a drop-in (same verbs,
 same addressing). Mirrors the role of controller-runtime's ``client.Client``
 in the reference controllers.
+
+Retry discipline: every verb retries shed/overloaded responses (429
+TooManyRequests / 503 ServiceUnavailable — the retryable pair, never the
+fatal 4xx family) with capped exponential backoff and FULL jitter
+(delay ~ U(0, min(cap, base·2^attempt)), the AWS-architecture-blog variant
+that de-synchronizes a thundering herd), honoring a server-sent
+``Retry-After`` as the floor. The in-process Store never sheds, so the
+wrapper only bites against a fairness-gated remote apiserver.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
 from ..api.meta import REGISTRY, Resource
-from .store import NotFound, Store
+from .store import NotFound, ServiceUnavailable, Store, TooManyRequests
+
+#: retry policy defaults — bounded so a dead apiserver fails a verb in
+#: seconds, not minutes; informers/reconcilers have their own outer loops
+RETRY_MAX_ATTEMPTS = 4
+RETRY_BASE_S = 0.1
+RETRY_CAP_S = 5.0
+#: a malicious/buggy Retry-After must not park a controller for an hour
+RETRY_AFTER_CLAMP_S = 30.0
 
 
 class Client:
-    def __init__(self, store: Store, event_retention: Optional[int] = None):
+    def __init__(self, store: Store, event_retention: Optional[int] = None,
+                 max_retries: int = RETRY_MAX_ATTEMPTS,
+                 backoff_base_s: float = RETRY_BASE_S,
+                 backoff_cap_s: float = RETRY_CAP_S,
+                 retry_sleep: Callable[[float], None] = time.sleep,
+                 retry_rng: Optional[random.Random] = None):
         self.store = store
         self._events: Optional["EventRecorder"] = None
         #: overrides EventRecorder's max_events GC cap when set — scale
         #: harnesses raise it so thousands of live gangs keep aggregating
         #: instead of churning the retention GC (see runtime/events.py)
         self.event_retention = event_retention
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        #: injectable for fake-clock tests; defaults are the real thing
+        self._retry_sleep = retry_sleep
+        self._retry_rng = retry_rng if retry_rng is not None else random.Random()
 
     def _res(self, api_version: str, kind: str) -> Resource:
         return REGISTRY.for_kind(api_version, kind)
 
+    def backoff_delay(self, attempt: int, retry_after_s: Optional[float]) -> float:
+        """Full-jitter delay for the given (0-based) attempt; a server
+        Retry-After is the floor, clamped so it can't park us forever."""
+        delay = self._retry_rng.uniform(
+            0.0, min(self.backoff_cap_s, self.backoff_base_s * (2.0 ** attempt)))
+        if retry_after_s:
+            delay = max(delay, min(float(retry_after_s), RETRY_AFTER_CLAMP_S))
+        return delay
+
+    def _retrying(self, fn: Callable[[], Any]) -> Any:
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except (TooManyRequests, ServiceUnavailable) as e:
+                if attempt >= self.max_retries:
+                    raise
+                from ..runtime.metrics import METRICS  # lazy: import-cycle guard
+
+                METRICS.counter("apiserver_client_retries_total",
+                                code=str(e.code)).inc()
+                self._retry_sleep(self.backoff_delay(
+                    attempt, getattr(e, "retry_after_s", None)))
+                attempt += 1
+
     # -- verbs --------------------------------------------------------------
     def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        return self.store.create(obj)
+        return self._retrying(lambda: self.store.create(obj))
 
     def get(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
-        return self.store.get(self._res(api_version, kind), name, namespace)
+        return self._retrying(
+            lambda: self.store.get(self._res(api_version, kind), name, namespace))
 
     def get_opt(
         self, api_version: str, kind: str, name: str, namespace: Optional[str] = None
@@ -50,26 +105,52 @@ class Client:
         label_selector: Optional[Dict[str, str]] = None,
         field_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
-        return self.store.list(
+        return self._retrying(lambda: self.store.list(
             self._res(api_version, kind),
             namespace=namespace,
             label_selector=label_selector,
             field_selector=field_selector,
-        )
+        ))
+
+    def list_paged(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 500,
+    ) -> Tuple[List[Dict[str, Any]], int]:
+        """Full collection via the paginated LIST path (limit/continue
+        tokens, every page pinned to one consistent snapshot). Returns
+        (items, snapshot rv) — the informer relist primitive. A stale
+        continue token surfaces as Expired (410): restart from page one."""
+        res = self._res(api_version, kind)
+        items: List[Dict[str, Any]] = []
+        token: Optional[str] = None
+        rv = 0
+        while True:
+            page, rv, token = self._retrying(lambda tok=token: self.store.list_page(
+                res, namespace=namespace, label_selector=label_selector,
+                limit=limit, continue_token=tok))
+            items.extend(page)
+            if not token:
+                return items, rv
 
     def update(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        return self.store.update(obj)
+        return self._retrying(lambda: self.store.update(obj))
 
     def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
-        return self.store.update_status(obj)
+        return self._retrying(lambda: self.store.update_status(obj))
 
     def patch(
         self, api_version: str, kind: str, name: str, patch: Dict[str, Any], namespace: Optional[str] = None
     ) -> Dict[str, Any]:
-        return self.store.patch(self._res(api_version, kind), name, patch, namespace)
+        return self._retrying(
+            lambda: self.store.patch(self._res(api_version, kind), name, patch, namespace))
 
     def delete(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> Dict[str, Any]:
-        return self.store.delete(self._res(api_version, kind), name, namespace)
+        return self._retrying(
+            lambda: self.store.delete(self._res(api_version, kind), name, namespace))
 
     def delete_opt(self, api_version: str, kind: str, name: str, namespace: Optional[str] = None) -> None:
         try:

@@ -21,12 +21,24 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from ..api import meta as apimeta
 from ..api.meta import Resource
-from .store import ApiError, Conflict, Expired, Forbidden, Invalid, NotFound, WatchEvent
+from .store import (
+    ApiError,
+    Conflict,
+    Expired,
+    Forbidden,
+    Invalid,
+    NotFound,
+    ServiceUnavailable,
+    TooManyRequests,
+    WatchEvent,
+)
 
-_ERRORS = {404: NotFound, 409: Conflict, 422: Invalid, 403: Forbidden, 410: Expired}
+_ERRORS = {404: NotFound, 409: Conflict, 422: Invalid, 403: Forbidden, 410: Expired,
+           429: TooManyRequests, 503: ServiceUnavailable}
 
 
-def _raise_for(status_body: Dict[str, Any], code: int) -> None:
+def _raise_for(status_body: Dict[str, Any], code: int,
+               headers: Optional[Any] = None) -> None:
     cls = _ERRORS.get(code, ApiError)
     err = cls(status_body.get("message", f"HTTP {code}"))
     # Codes without a dedicated class (e.g. server-side 400s) must keep their
@@ -35,6 +47,17 @@ def _raise_for(status_body: Dict[str, Any], code: int) -> None:
     if cls is ApiError:
         err.code = code
         err.reason = status_body.get("reason", err.reason)
+    # Retryable shedding (429/503) carries the server's Retry-After through
+    # to the typed error so backoff honors it instead of guessing — callers
+    # (fleet watcher, informers, elastic trainer) distinguish these from
+    # fatal 4xx by catching TooManyRequests/ServiceUnavailable.
+    if headers is not None and hasattr(err, "retry_after_s"):
+        raw = headers.get("Retry-After") if hasattr(headers, "get") else None
+        if raw:
+            try:
+                err.retry_after_s = float(raw)
+            except ValueError:
+                pass
     raise err
 
 
@@ -88,9 +111,16 @@ class RemoteWatch:
 
 class RemoteStore:
     def __init__(self, base_url: str, timeout: float = 30.0, token: Optional[str] = None,
-                 ca_file: Optional[str] = None):
+                 ca_file: Optional[str] = None, flow: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # Flow identity for the apiserver's priority-and-fairness gate
+        # (fairness.py): sent as X-Flow-Client on every request. Env default
+        # (APISERVER_FLOW) so per-role processes declare their flow without
+        # call-site changes; None = classified from the auth identity.
+        import os as _os
+
+        self.flow = flow if flow is not None else _os.environ.get("APISERVER_FLOW") or None
         # Role identity for the apiserver's token/RBAC gate (auth.py). Env
         # default so every role picks up its manifest-mounted token without
         # call-site changes; None = anonymous (open/dev apiserver).
@@ -135,6 +165,8 @@ class RemoteStore:
         headers = {"content-type": "application/json"}
         if self.token:
             headers["authorization"] = f"Bearer {self.token}"
+        if self.flow:
+            headers["x-flow-client"] = self.flow
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             return urllib.request.urlopen(
@@ -145,7 +177,7 @@ class RemoteStore:
                 status = json.loads(payload)
             except ValueError:
                 status = {"message": payload.decode(errors="replace")}
-            _raise_for(status, e.code)
+            _raise_for(status, e.code, headers=e.headers)
 
     def _json(self, method: str, path: str, body: Optional[Dict] = None, query: str = "") -> Any:
         with self._request(method, path, body, query) as resp:
@@ -177,6 +209,39 @@ class RemoteStore:
 
             items = [o for o in items if _match_fields(o, field_selector)]
         return items
+
+    def list_page(
+        self,
+        res: Resource,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ):
+        """One page of a paginated LIST — the Store.list_page surface over
+        the wire (``limit``/``continue`` query params). Returns
+        (items, rv, next_token); a stale token surfaces as Expired (410)."""
+        params = []
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        if continue_token:
+            params.append("continue=" + urllib.request.quote(continue_token))
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            params.append("labelSelector=" + urllib.request.quote(sel))
+        doc = self._json("GET", self._path(res, namespace), query="&".join(params))
+        items = doc["items"]
+        if field_selector:
+            from .store import _match_fields
+
+            items = [o for o in items if _match_fields(o, field_selector)]
+        md = doc.get("metadata") or {}
+        try:
+            rv = int(md.get("resourceVersion") or 0)
+        except ValueError:
+            rv = 0
+        return items, rv, md.get("continue") or None
 
     def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
         res = apimeta.REGISTRY.for_object(obj)

@@ -33,6 +33,7 @@ from ..runtime.metrics import METRICS
 from ..runtime.tracing import TRACER
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .auth import ApiAuth, Identity, Unauthenticated
+from .fairness import FlowController, FlowRejected
 from .store import ApiError, Store
 
 
@@ -101,8 +102,17 @@ def seed_webhook_config(store: Store, url: str, failure_policy: str = "Ignore",
 
 
 def make_apiserver_app(
-    store: Store, webhook_url: Optional[str] = None, auth: Optional[ApiAuth] = None
+    store: Store,
+    webhook_url: Optional[str] = None,
+    auth: Optional[ApiAuth] = None,
+    fairness: Optional[FlowController] = None,
 ) -> App:
+    """``fairness`` gates every resource verb through API priority-and-
+    fairness (apiserver/fairness.py): requests are classified into a flow
+    (``X-Flow-Client`` header, else authenticated identity), queued behind
+    per-priority-level concurrency shares, and shed with 429 + Retry-After
+    on overflow. ``None`` (default) keeps the open admit-everything
+    behavior — in-process test stores don't need flow control."""
     from .admission import dynamic_admission_hook
 
     app = App("apiserver")
@@ -187,16 +197,33 @@ def make_apiserver_app(
             authorize(req, "watch", res)
             return _watch_stream(store, res, ns, selector, req)
         authorize(req, "list", res)
+        limit_param = req.query1("limit")
+        cont = req.query1("continue") or None
         try:
-            items, rv = store.list_with_rv(hub_resource(res), namespace=ns, label_selector=selector)
+            if limit_param or cont:
+                try:
+                    limit = int(limit_param) if limit_param else None
+                except ValueError:
+                    raise HttpError(400, f"invalid limit {limit_param!r}") from None
+                items, rv, next_token = store.list_page(
+                    hub_resource(res), namespace=ns, label_selector=selector,
+                    limit=limit, continue_token=cont)
+            else:
+                items, rv = store.list_with_rv(
+                    hub_resource(res), namespace=ns, label_selector=selector)
+                next_token = None
         except ApiError as e:
             return error(e)
+        # RV captured atomically with the snapshot (store.list_with_rv /
+        # the page's pinned snapshot) so list+watch-from-RV never misses
+        # interleaved writes — and every page of one list reports the SAME RV.
+        metadata: Dict[str, Any] = {"resourceVersion": str(rv)}
+        if next_token:
+            metadata["continue"] = next_token
         return {
             "apiVersion": res.api_version,
             "kind": res.list_kind or f"{res.kind}List",
-            # RV captured atomically with the snapshot (store.list_with_rv) so
-            # list+watch-from-RV never misses interleaved writes.
-            "metadata": {"resourceVersion": str(rv)},
+            "metadata": metadata,
             "items": [outbound(o, res) for o in items],
         }
 
@@ -281,17 +308,40 @@ def make_apiserver_app(
         except ApiError as e:
             return error(e)
 
+    def flow_reject(e: FlowRejected) -> JsonResponse:
+        retry_after = max(1, int(round(e.retry_after_s)))
+        return JsonResponse(
+            {"apiVersion": "v1", "kind": "Status", "status": "Failure",
+             "code": 429, "reason": "TooManyRequests", "message": str(e)},
+            status=429, headers={"Retry-After": str(retry_after)},
+        )
+
     def instrumented(verb: str, handler):
         """kube-apiserver's request SLI surface: one histogram + in-flight
         gauge per (verb, resource), plus a child span under the dispatch
         span (which already continues any inbound ``traceparent``, so a
-        controller's write shows up inside its reconcile trace)."""
+        controller's write shows up inside its reconcile trace).
+
+        When fairness is configured, the flow-control gate sits here —
+        around every resource verb, before any store work. The seat is held
+        for the handler dispatch only: a watch's streaming phase runs
+        seatless (served from the watch cache, it no longer amplifies store
+        load), matching APF's treatment of long-running requests."""
 
         def wrapped(req: Request):
             v = verb
             if v == "list" and req.query1("watch") in ("true", "1"):
                 v = "watch"
             resource = req.params.get("plural", "")
+            ticket = None
+            if fairness is not None:
+                ident = req.context.get("identity")
+                try:
+                    ticket = fairness.admit(
+                        req.header("x-flow-client") or None,
+                        getattr(ident, "user", None))
+                except FlowRejected as e:
+                    return flow_reject(e)
             gauge = METRICS.gauge("apiserver_inflight_requests", verb=v)
             gauge.inc()
             start = time.monotonic()
@@ -315,6 +365,8 @@ def make_apiserver_app(
                     resp.on_close = close
                 return resp
             finally:
+                if ticket is not None:
+                    fairness.release(ticket)
                 if dec_on_exit:
                     gauge.dec()
                 if v != "watch":
@@ -346,6 +398,11 @@ def make_apiserver_app(
     @app.route("/healthz")
     def healthz(req: Request):
         return {"status": "ok", "resourceVersion": str(store.backend.current_rv())}
+
+    if fairness is not None:
+        @app.route("/debug/fairness")
+        def debug_fairness(req: Request):
+            return fairness.snapshot()
 
     @app.route("/apis")
     def discovery(req: Request):

@@ -20,8 +20,10 @@ a resourceVersion via the write journal (etcd watch-window semantics).
 
 from __future__ import annotations
 
+import base64
 import collections
 import fnmatch
+import json
 import queue
 import threading
 import time
@@ -89,6 +91,30 @@ class Forbidden(ApiError):
 class Expired(ApiError):
     code = 410
     reason = "Expired"
+
+
+class TooManyRequests(ApiError):
+    """429: the fairness layer shed this request. ``retry_after_s`` carries
+    the server's Retry-After so clients can honor it instead of guessing."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ServiceUnavailable(ApiError):
+    """503: transient server-side overload/outage — retryable, unlike the
+    fatal 4xx family."""
+
+    code = 503
+    reason = "ServiceUnavailable"
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -181,12 +207,41 @@ class _Watcher:
             yield item
 
 
+#: default watch-cache ring length — sized to ride out a 1k-notebook churn
+#: wave between informer reconnects; override per Store for tests.
+WATCH_CACHE_SIZE = 4096
+
+#: LIST continue-token snapshots: how many concurrent paginated LISTs may be
+#: in flight, and how long an abandoned one is kept before its token expires.
+PAGE_SNAPSHOT_CAP = 64
+PAGE_SNAPSHOT_TTL_S = 60.0
+
+
 class Store:
-    def __init__(self, backend=None) -> None:
+    def __init__(self, backend=None, watch_cache_size: int = WATCH_CACHE_SIZE) -> None:
         self._lock = threading.RLock()
         self.backend = backend if backend is not None else default_backend()
         self._watchers: List[_Watcher] = []
         self._admission: List[AdmissionHook] = []
+        # Watch cache (etcd watch-window analog, backend-independent): a
+        # bounded ring of (rv, res_key, type, obj) fed by _notify. A watch
+        # with since_rv replays from the ring when it still covers that RV;
+        # compaction past it surfaces 410 Expired so the client relists.
+        # Size 0 disables the ring (journal-only semantics, see watch()).
+        self._wc_size = max(0, int(watch_cache_size))
+        self._wc_events: "collections.deque[Tuple[int, str, str, Dict[str, Any]]]" = (
+            collections.deque())
+        # Highest RV compacted out of the ring. Seeded with the backend's
+        # current RV: a pre-populated persistent backend has history this
+        # ring never saw, so those RVs must fall through to the journal.
+        self._wc_trimmed_rv = self.backend.current_rv()
+        # Per-bucket object mirror serving send_initial watches without a
+        # backend read per client (the watch-storm amplification fix).
+        # Lazily built on first use, then maintained inline by _notify.
+        self._wc_mirror: Dict[str, Dict[Tuple[Optional[str], str], Dict[str, Any]]] = {}
+        # LIST pagination snapshots: token id -> (expires_mono, rv, items).
+        self._page_snaps: "collections.OrderedDict[str, Tuple[float, int, List[Dict[str, Any]]]]" = (
+            collections.OrderedDict())
         # GC ownership index, maintained at write time so a sweep never has
         # to decode the whole store (the old full-scan sweep at 20Hz was the
         # top cost in the 500-notebook loadtest profile):
@@ -247,12 +302,46 @@ class Store:
 
     def _notify(self, res: Resource, event: WatchEvent) -> None:
         obj = event.object
+        self._wc_record(res, event.type, obj)
         for w in list(self._watchers):
             if w.closed:
                 self._watchers.remove(w)
                 continue
             if w.matches(res.key, obj):
                 w.send(WatchEvent(event.type, apimeta.deepcopy(obj)))
+
+    # -- watch cache (caller holds the lock) ---------------------------------
+    def _wc_record(self, res: Resource, type_: str, obj: Dict[str, Any]) -> None:
+        snap = apimeta.deepcopy(obj)
+        mirror = self._wc_mirror.get(res.key)
+        if mirror is not None:
+            mkey = (apimeta.namespace_of(snap), apimeta.name_of(snap))
+            if type_ == "DELETED":
+                mirror.pop(mkey, None)
+            else:
+                mirror[mkey] = snap
+        if self._wc_size <= 0:
+            return
+        try:
+            rv = int(snap.get("metadata", {}).get("resourceVersion"))
+        except (TypeError, ValueError):
+            return  # un-versioned event: not replayable, skip the ring
+        self._wc_events.append((rv, res.key, type_, snap))
+        while len(self._wc_events) > self._wc_size:
+            self._wc_trimmed_rv = self._wc_events.popleft()[0]
+
+    def _wc_initial(self, res: Resource) -> List[Dict[str, Any]]:
+        """Current bucket contents from the mirror (built once per bucket via
+        a single backend read, maintained by _notify thereafter) — a watch
+        storm of send_initial clients costs zero backend list reads."""
+        mirror = self._wc_mirror.get(res.key)
+        if mirror is None:
+            mirror = {}
+            for obj in self.backend.list(res.key, None, None):
+                mirror[(apimeta.namespace_of(obj), apimeta.name_of(obj))] = (
+                    apimeta.deepcopy(obj))
+            self._wc_mirror[res.key] = mirror
+        return list(mirror.values())
 
     @staticmethod
     def now() -> str:
@@ -324,13 +413,70 @@ class Store:
         missed by the informer pattern.
         """
         res = conversion.hub_resource(res)
+        from ..runtime.metrics import METRICS  # lazy: runtime imports this module
+
         with self._lock:
+            # every read that reaches the backing store — the counter the
+            # scale harness watches to prove watch storms stay in the cache
+            METRICS.counter("apiserver_store_list_total", resource=res.plural).inc()
             ns = namespace if (res.namespaced and namespace is not None) else None
             out = self.backend.list(res.key, ns, label_selector)
             rv = self.backend.current_rv()
             if field_selector:
                 out = [o for o in out if _match_fields(o, field_selector)]
             return out, rv
+
+    def list_page(
+        self,
+        res: Resource,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> Tuple[List[Dict[str, Any]], int, Optional[str]]:
+        """Paginated LIST pinned to a consistent resourceVersion snapshot.
+
+        Page 1 takes one store snapshot (items + RV atomically, like
+        list_with_rv) and parks it under an opaque continue token;
+        continuation pages serve slices of that parked snapshot, so every
+        page reflects the SAME RV no matter how much the store moved in
+        between. Tokens are bounded (PAGE_SNAPSHOT_CAP) and expire
+        (PAGE_SNAPSHOT_TTL_S); a stale/garbled token raises Expired (410
+        ``Expired`` — K8s "continue token is too old"), telling the client
+        to restart the list from scratch.
+        """
+        with self._lock:
+            now = time.monotonic()
+            for tid in [t for t, (exp, _, _) in self._page_snaps.items() if exp < now]:
+                del self._page_snaps[tid]
+            if continue_token:
+                try:
+                    tok = json.loads(base64.urlsafe_b64decode(
+                        continue_token.encode()).decode())
+                    tid, off = str(tok["id"]), int(tok["off"])
+                except (ValueError, KeyError, TypeError):
+                    raise Expired("malformed continue token; restart the list") from None
+                snap = self._page_snaps.get(tid)
+                if snap is None:
+                    raise Expired(
+                        "the provided continue token has expired; restart the list")
+                _, rv, items = snap
+            else:
+                items, rv = self.list_with_rv(
+                    res, namespace, label_selector, field_selector)
+                tid, off = uuid.uuid4().hex[:16], 0
+            if limit is None or off + limit >= len(items):
+                if continue_token:
+                    self._page_snaps.pop(tid, None)  # fully consumed
+                return [apimeta.deepcopy(o) for o in items[off:]], rv, None
+            if not continue_token:
+                self._page_snaps[tid] = (now + PAGE_SNAPSHOT_TTL_S, rv, items)
+                while len(self._page_snaps) > PAGE_SNAPSHOT_CAP:
+                    self._page_snaps.popitem(last=False)
+            next_token = base64.urlsafe_b64encode(
+                json.dumps({"id": tid, "off": off + limit}).encode()).decode()
+            return [apimeta.deepcopy(o) for o in items[off:off + limit]], rv, next_token
 
     def update(self, obj: Dict[str, Any], subresource: Optional[str] = None) -> Dict[str, Any]:
         res, obj = _to_hub(obj)
@@ -451,10 +597,15 @@ class Store:
         since_rv: Optional[int] = None,
         sync_marker: bool = False,
     ) -> _Watcher:
-        """Open a watch stream. ``since_rv`` replays history from the write
-        journal (native backend only) before going live — etcd watch-window
-        semantics; raises Expired (410) when the window has been trimmed, in
-        which case the caller relists (informer resync).
+        """Open a watch stream. ``since_rv`` replays history before going
+        live — etcd watch-window semantics. The bounded in-memory event ring
+        (watch cache) is the primary replay source regardless of backend;
+        the native write journal is the fallback for RVs the ring has
+        already compacted. When neither covers the RV, raises Expired (410
+        "too old resource version") and the caller relists (informer
+        resync). ``watch_cache_size=0`` disables the ring: then a
+        journal-less backend refuses since_rv outright (Invalid), the
+        pre-ring behavior.
 
         ``sync_marker`` appends a ``SYNC`` event (empty object) after the
         initial-list/replay burst and before any live event. Informers use
@@ -468,22 +619,33 @@ class Store:
         w = _Watcher(key, namespace, label_selector)
         with self._lock:
             if since_rv is not None:
-                if not getattr(self.backend, "journal_capable", False):
+                ring_covers = self._wc_size > 0 and since_rv >= self._wc_trimmed_rv
+                if ring_covers:
+                    for rv, res_key, type_, obj in self._wc_events:
+                        if rv > since_rv and w.matches(res_key, obj):
+                            w.preload(WatchEvent(type_, apimeta.deepcopy(obj)))
+                elif getattr(self.backend, "journal_capable", False):
+                    try:
+                        # Single-bucket watches filter in the C core — a
+                        # resume must not marshal the whole journal.
+                        records = self.backend.journal_since(
+                            since_rv, bucket=res.key if res else None
+                        )
+                    except JournalExpired as e:
+                        raise Expired(str(e)) from None
+                    for rec in records:
+                        if w.matches(rec.bucket, rec.object):
+                            w.preload(WatchEvent(rec.type, rec.object))
+                elif self._wc_size > 0:
+                    raise Expired(
+                        f"too old resource version: {since_rv} "
+                        f"(oldest retained: {self._wc_trimmed_rv})")
+                else:
                     raise Invalid("this backend keeps no journal; watch without since_rv")
-                try:
-                    # Single-bucket watches filter in the C core — a resume
-                    # must not marshal the whole journal.
-                    records = self.backend.journal_since(
-                        since_rv, bucket=res.key if res else None
-                    )
-                except JournalExpired as e:
-                    raise Expired(str(e)) from None
-                for rec in records:
-                    if w.matches(rec.bucket, rec.object):
-                        w.preload(WatchEvent(rec.type, rec.object))
             elif send_initial and res is not None:
-                for obj in self.list(res, namespace=namespace, label_selector=label_selector):
-                    w.preload(WatchEvent("ADDED", obj))
+                for obj in self._wc_initial(res):
+                    if w.matches(res.key, obj):
+                        w.preload(WatchEvent("ADDED", apimeta.deepcopy(obj)))
             if sync_marker:
                 # The marker carries the store RV at the snapshot: informers
                 # use it to jump their seen-RV to "current" on (re)connect,

@@ -32,6 +32,14 @@ EngineFleet or anything exposing ``live_handles()``):
   requests on a replica (clients disconnecting mid-generation); the
   engine must reap the slots.
 
+ISSUE 13 adds the tenant-abuse kind (pass ``apiserver_url=``):
+
+- ``flood_apiserver`` — a noisy tenant: blast real LIST traffic over HTTP
+  at the apiserver at ``param`` qps for ``duration`` seconds, tagged with
+  ``target`` as the ``X-Flow-Client`` header so the priority-and-fairness
+  gate (apiserver/fairness.py) classifies it. 429s are expected and
+  counted, not errors — shedding the flood is the point.
+
 Every firing bumps ``chaos_faults_injected_total{kind}``.
 """
 
@@ -50,7 +58,8 @@ from .metrics import METRICS
 LOG = logging.getLogger(__name__)
 
 KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver",
-         "slow_replica", "crash_replica_mid_decode", "client_abandon")
+         "slow_replica", "crash_replica_mid_decode", "client_abandon",
+         "flood_apiserver")
 
 #: chaos components stamp Events under this source
 COMPONENT = "chaos-monkey"
@@ -130,6 +139,7 @@ class ChaosMonkey:
         store=None,
         informers: Sequence[Any] = (),
         fleet: Any = None,
+        apiserver_url: Optional[str] = None,
     ) -> None:
         self._client = client
         self._schedule = schedule
@@ -138,6 +148,10 @@ class ChaosMonkey:
         #: EngineFleet (or anything with ``live_handles()``) — the target
         #: set for the serving fault kinds
         self._fleet = fleet
+        #: base URL of a live apiserver — the target of flood_apiserver
+        self._apiserver_url = apiserver_url.rstrip("/") if apiserver_url else None
+        #: (sent, rejected) tallies of completed floods, for harness asserts
+        self.flood_stats: List[Dict[str, int]] = []
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         #: engines slowed by slow_replica, reset on stop() so a finished
@@ -355,3 +369,79 @@ class ChaosMonkey:
                 break
         if cancelled == 0:
             raise RuntimeError("no in-flight request to abandon")
+
+    # -- tenant-abuse injector -----------------------------------------------
+    def flood_apiserver(self, flow: str, qps: float, duration_s: float,
+                        wait: bool = False) -> Fault:
+        """Convenience wrapper: inject a ``flood_apiserver`` fault NOW for
+        ``duration_s`` seconds at ``qps`` LISTs/s under flow identity
+        ``flow``. Returns the Fault; pass ``wait=True`` to block until the
+        flood drains (harness synchronization)."""
+        fault = Fault(at=0.0, kind="flood_apiserver", target=flow,
+                      param=qps, duration=duration_s)
+        self.inject(fault)
+        if wait:
+            self.join(timeout=duration_s + 10.0)
+        return fault
+
+    def _flood_apiserver(self, fault: Fault) -> None:
+        """A noisy tenant: real HTTP LISTs against the apiserver at
+        ``param`` qps for ``duration`` seconds, stamped with the flow
+        identity so fairness classifies (and sheds) them. Runs in a side
+        thread so scheduled faults stay on time; 429/503 responses are
+        tallied, not raised — being shed is the expected outcome."""
+        if self._apiserver_url is None:
+            raise RuntimeError("flood_apiserver needs apiserver_url")
+        import urllib.error
+        import urllib.request
+
+        base = self._apiserver_url
+        flow = fault.target or "bulk:chaos"
+        qps = max(0.1, fault.param)
+        duration = max(0.0, fault.duration)
+        stats = {"sent": 0, "rejected": 0, "errors": 0}
+        stats_lock = threading.Lock()
+        self.flood_stats.append(stats)
+        # Burst-synchronized workers: every round, ALL workers fire at once
+        # (thundering herd), then sleep to the next round boundary. A paced
+        # open-loop flood never exceeds concurrency ~qps*latency, which
+        # against a fast apiserver rounds to one — it would trickle through
+        # the seats without ever pressing on the queues. Bursts are what a
+        # real notebook-fanout tenant does and what the gate must shed.
+        workers = max(4, min(16, int(qps / 25) or 4))
+        interval = workers / qps  # rounds/s * workers = qps
+        t0 = time.monotonic()
+        end = t0 + duration
+
+        def blast():
+            k = 0
+            while not self._stop.is_set():
+                due = t0 + k * interval
+                now = time.monotonic()
+                if due >= end:
+                    return
+                if due > now and self._stop.wait(due - now):
+                    return
+                req = urllib.request.Request(
+                    base + "/api/v1/pods", headers={"x-flow-client": flow})
+                outcome = None
+                try:
+                    with urllib.request.urlopen(req, timeout=5.0) as resp:
+                        resp.read()
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    outcome = "rejected" if e.code in (429, 503) else "errors"
+                except Exception:
+                    outcome = "errors"
+                with stats_lock:
+                    stats["sent"] += 1
+                    if outcome:
+                        stats[outcome] += 1
+                # skip rounds that passed while this request was in flight —
+                # the herd stays synchronized instead of smearing out
+                k = max(k + 1, int((time.monotonic() - t0) / interval) + 1)
+
+        for _ in range(workers):
+            t = threading.Thread(target=blast, name="chaos-flood", daemon=True)
+            self._threads.append(t)
+            t.start()

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
 from ..apiserver.client import Client
+from ..apiserver.store import Expired
 from .metrics import METRICS
 
 log = logging.getLogger("kubeflow_tpu.informer")
@@ -215,12 +216,65 @@ class SharedInformer:
             return len(self._items)
 
     # -- the pump ------------------------------------------------------------
+    def _relist(self) -> None:
+        """Recover from a compacted watch window (410 Gone): rebuild the
+        mirror through the PAGINATED list path — a storm of relisting
+        informers must not each issue one giant unbounded LIST — firing
+        synthetic DELETED for vanished keys and ADDED/MODIFIED for the rest,
+        then resume watching from the snapshot RV."""
+        items, rv = self.client.list_paged(self.api_version, self.kind)
+        with self._lock:
+            fresh = {
+                (apimeta.namespace_of(o), apimeta.name_of(o)): o for o in items
+            }
+            vanished = [
+                (k, self._items[k]) for k in list(self._items) if k not in fresh
+            ]
+            for key, old in vanished:
+                self._apply("DELETED", key, old)
+            arrived = []
+            for key, obj in fresh.items():
+                arrived.append(("MODIFIED" if key in self._items else "ADDED", obj))
+                self._apply("MODIFIED", key, obj)
+        self._note_rv(rv)
+        self._last_sync_mono = time.monotonic()
+        self._synced.set()
+        for _key, old in vanished:
+            METRICS.counter("informer_events_total", kind=self.kind, type="DELETED").inc()
+            self._dispatch("DELETED", old)
+        for type_, obj in arrived:
+            METRICS.counter("informer_events_total", kind=self.kind, type=type_).inc()
+            self._dispatch(type_, obj)
+
     def _pump(self) -> None:
         while not self._stopped.is_set():
+            # Resume from the last seen RV when we have one: reconnects replay
+            # only the missed window (watch cache / journal) instead of
+            # re-listing the world. A compacted window (Expired, 410) falls
+            # back to the paginated relist.
+            resume_rv = self._last_rv
+            # a never-synced mirror may be mid-initial-list: resume could
+            # permanently miss the unapplied remainder — relist instead
+            initial = resume_rv <= 0 or not self._synced.is_set()
             try:
-                watcher = self.client.watch(
-                    self.api_version, self.kind, send_initial=True, sync_marker=True
-                )
+                if initial:
+                    watcher = self.client.watch(
+                        self.api_version, self.kind, send_initial=True, sync_marker=True
+                    )
+                else:
+                    watcher = self.client.watch(
+                        self.api_version, self.kind, since_rv=resume_rv, sync_marker=True
+                    )
+            except Expired as e:
+                log.warning("informer %s: watch window expired (%s); relisting", self.kind, e)
+                METRICS.counter("informer_relists_total", kind=self.kind).inc()
+                try:
+                    self._relist()
+                except Exception as e2:
+                    log.warning("informer %s: relist failed: %s", self.kind, e2)
+                    METRICS.counter("informer_watch_reconnects_total", kind=self.kind).inc()
+                    self._stopped.wait(1.0)
+                continue
             except Exception as e:
                 log.warning("informer %s: watch connect failed: %s", self.kind, e)
                 METRICS.counter("informer_watch_reconnects_total", kind=self.kind).inc()
@@ -233,19 +287,23 @@ class SharedInformer:
             # cached key NOT re-sent vanished while we were disconnected —
             # fire synthetic DELETED so handler-maintained state (gauge
             # indexes etc.) can't go stale. client-go emits deletes on
-            # relist for exactly this reason.
+            # relist for exactly this reason. Vanished-key detection is only
+            # sound when the stream carried a FULL initial list; an RV-resume
+            # stream replays deltas, where absence means "unchanged".
             seen: set = set()
             syncing = True
             try:
                 for event in watcher:
                     if event.type == "SYNC":
                         syncing = False
-                        with self._lock:
-                            vanished = [
-                                (k, self._items[k]) for k in list(self._items) if k not in seen
-                            ]
-                            for key, old in vanished:
-                                self._apply("DELETED", key, old)
+                        vanished = []
+                        if initial:
+                            with self._lock:
+                                vanished = [
+                                    (k, self._items[k]) for k in list(self._items) if k not in seen
+                                ]
+                                for key, old in vanished:
+                                    self._apply("DELETED", key, old)
                         self._note_rv((event.object or {}).get("resourceVersion"))
                         self._last_sync_mono = time.monotonic()
                         self._synced.set()

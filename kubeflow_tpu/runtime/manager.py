@@ -63,40 +63,81 @@ class Reconciler:
         return []
 
 
+class _Shard:
+    """One lock domain of a sharded workqueue: its own pending dict (dedup),
+    delayed heap, deadline/failure/enqueue-time maps."""
+
+    __slots__ = ("lock", "pending", "delayed", "deadlines", "failures",
+                 "added_at", "seq")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.pending: Dict[Request, None] = {}
+        self.delayed: List[Tuple[float, int, Request]] = []
+        #: authoritative earliest deadline per request — heap entries whose
+        #: deadline disagrees are superseded duplicates and get dropped on pop
+        self.deadlines: Dict[Request, float] = {}
+        self.failures: Dict[Request, int] = {}
+        #: enqueue time per pending request (queue-duration histogram)
+        self.added_at: Dict[Request, float] = {}
+        self.seq = 0
+
+
 class _WorkQueue:
-    """Deduplicating delayed workqueue with per-item failure backoff.
+    """Deduplicating delayed workqueue, SHARDED by key hash.
+
+    The round-11 churn profile showed every watch pump and the worker
+    serializing on one queue-wide condition: at 100k-pod churn the producers
+    (N watch streams mapping events to keys) convoy behind each other. Keys
+    now hash to ``shards`` independent lock domains — dedup, delay heaps and
+    failure counts are all per-shard, so two pumps enqueueing different keys
+    never contend. One queue-wide condition remains solely as the consumer
+    wakeup signal (producers touch it only to notify, never to do work
+    under it); ``_version`` closes the scan-then-sleep lost-wakeup window.
 
     Instrumented with the controller-runtime workqueue metric family
     (``workqueue_depth``/``adds``/``queue_duration``/``retries``/
-    ``unfinished_work``), labeled by the owning controller's name — the
-    first dashboard anyone opens when a controller looks stuck.
+    ``unfinished_work``), labeled by the owning controller's name and
+    AGGREGATED across shards — the dashboard contract is unchanged.
     """
 
-    def __init__(self, name: str = "") -> None:
+    SHARDS = 8
+
+    def __init__(self, name: str = "", shards: int = SHARDS) -> None:
         self.name = name
         self._cond = threading.Condition()
-        self._pending: Dict[Request, None] = {}
-        self._delayed: List[Tuple[float, int, Request]] = []
-        #: authoritative earliest deadline per request — heap entries whose
-        #: deadline disagrees are superseded duplicates and get dropped on pop
-        self._deadlines: Dict[Request, float] = {}
-        self._seq = 0
-        self._failures: Dict[Request, int] = {}
+        self._shards = [_Shard() for _ in range(max(1, shards))]
+        self._rr = 0  # consumer scan cursor: rotate so no shard starves
+        self._version = 0  # bumped under _cond on every enqueue/shutdown
         self._processing = 0
-        #: enqueue time per pending request (queue-duration histogram)
-        self._added_at: Dict[Request, float] = {}
         #: start times of in-flight items, FIFO-drained by task_done()
         self._inflight: Dict[int, float] = {}
+        self._inflight_seq = 0
         self._shutdown = False
         # unfinished-work must grow while a reconcile hangs, so it is
         # computed at scrape time; keyed registration keeps remounts (and
         # per-test Managers reusing controller names) from stacking up
         METRICS.register_collector(f"workqueue_{name}", self._collect)
 
+    def _shard(self, req: Request) -> _Shard:
+        return self._shards[hash(req) % len(self._shards)]
+
+    def _depth(self) -> int:
+        # len() per shard without locks: a point-in-time gauge may be off by
+        # an in-flight add, never corrupt
+        return sum(len(s.pending) for s in self._shards)
+
+    @property
+    def _delayed(self) -> List[Tuple[float, int, Request]]:
+        # debug/test view of the delayed heaps, flattened across shards (a
+        # request hashes to exactly one shard, so dedup invariants — one
+        # heap entry per hot-requeued key — read the same as pre-sharding)
+        return [entry for s in self._shards for entry in s.delayed]
+
     def _collect(self) -> None:
         now = time.monotonic()
+        depth = self._depth()
         with self._cond:
-            depth = len(self._pending)
             unfinished = sum(now - t for t in self._inflight.values())
         METRICS.gauge("workqueue_depth", queue=self.name).set(depth)
         METRICS.gauge("workqueue_unfinished_work_seconds", queue=self.name).set(unfinished)
@@ -107,69 +148,103 @@ class _WorkQueue:
         METRICS.gauge("workqueue_saturation", queue=self.name).set(
             round(depth / (depth + 1.0), 6))
 
-    def add(self, req: Request) -> None:
+    def _wake(self) -> None:
         with self._cond:
-            if req not in self._pending:
-                self._pending[req] = None
-                self._added_at.setdefault(req, time.monotonic())
-                METRICS.counter("workqueue_adds_total", queue=self.name).inc()
-                METRICS.gauge("workqueue_depth", queue=self.name).set(len(self._pending))
-                self._cond.notify()
+            self._version += 1
+            self._cond.notify()
+
+    def add(self, req: Request) -> None:
+        sh = self._shard(req)
+        with sh.lock:
+            if req in sh.pending:
+                return
+            sh.pending[req] = None
+            sh.added_at.setdefault(req, time.monotonic())
+        METRICS.counter("workqueue_adds_total", queue=self.name).inc()
+        METRICS.gauge("workqueue_depth", queue=self.name).set(self._depth())
+        self._wake()
 
     def add_after(self, req: Request, delay: float) -> None:
         deadline = time.monotonic() + delay
-        with self._cond:
-            cur = self._deadlines.get(req)
+        sh = self._shard(req)
+        with sh.lock:
+            cur = sh.deadlines.get(req)
             if cur is not None and cur <= deadline:
                 return  # already scheduled at least as early; no new entry
-            self._deadlines[req] = deadline
-            self._seq += 1
-            heapq.heappush(self._delayed, (deadline, self._seq, req))
-            self._cond.notify()
+            sh.deadlines[req] = deadline
+            sh.seq += 1
+            heapq.heappush(sh.delayed, (deadline, sh.seq, req))
+        self._wake()
 
     def add_rate_limited(self, req: Request) -> None:
-        with self._cond:
-            n = self._failures.get(req, 0)
-            self._failures[req] = n + 1
+        sh = self._shard(req)
+        with sh.lock:
+            n = sh.failures.get(req, 0)
+            sh.failures[req] = n + 1
         METRICS.counter("workqueue_retries_total", queue=self.name).inc()
         self.add_after(req, min(0.005 * (2**n), 30.0))
 
     def forget(self, req: Request) -> None:
-        with self._cond:
-            self._failures.pop(req, None)
+        sh = self._shard(req)
+        with sh.lock:
+            sh.failures.pop(req, None)
 
-    def get(self, timeout: Optional[float] = None) -> Optional[Request]:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
-            while True:
-                now = time.monotonic()
-                while self._delayed and self._delayed[0][0] <= now:
-                    due, _, dreq = heapq.heappop(self._delayed)
-                    if self._deadlines.get(dreq) != due:
+    def _try_pop(self, now: float) -> Tuple[Optional[Request], Optional[float]]:
+        """One pass over all shards from the rotation cursor: promote due
+        delayed items, pop the first pending request. Returns (request or
+        None, earliest future delayed deadline or None)."""
+        n = len(self._shards)
+        start = self._rr
+        next_due: Optional[float] = None
+        for i in range(n):
+            sh = self._shards[(start + i) % n]
+            with sh.lock:
+                while sh.delayed and sh.delayed[0][0] <= now:
+                    due, _, dreq = heapq.heappop(sh.delayed)
+                    if sh.deadlines.get(dreq) != due:
                         continue  # superseded by an earlier add_after
-                    del self._deadlines[dreq]
-                    if dreq not in self._pending:
-                        self._pending[dreq] = None
-                        self._added_at.setdefault(dreq, now)
+                    del sh.deadlines[dreq]
+                    if dreq not in sh.pending:
+                        sh.pending[dreq] = None
+                        sh.added_at.setdefault(dreq, now)
                         METRICS.counter("workqueue_adds_total", queue=self.name).inc()
-                if self._pending:
-                    req = next(iter(self._pending))
-                    del self._pending[req]
-                    added = self._added_at.pop(req, None)
+                if sh.delayed:
+                    due = sh.delayed[0][0]
+                    next_due = due if next_due is None else min(next_due, due)
+                if sh.pending:
+                    req = next(iter(sh.pending))
+                    del sh.pending[req]
+                    added = sh.added_at.pop(req, None)
                     if added is not None:
                         METRICS.histogram(
                             "workqueue_queue_duration_seconds", queue=self.name
                         ).observe(now - added)
+                    self._rr = (start + i + 1) % n
+                    return req, next_due
+        return None, next_due
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Request]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                v0 = self._version
+            now = time.monotonic()
+            req, next_due = self._try_pop(now)
+            if req is not None:
+                with self._cond:
                     self._processing += 1
-                    self._seq += 1
-                    self._inflight[self._seq] = now
-                    METRICS.gauge("workqueue_depth", queue=self.name).set(len(self._pending))
-                    return req
+                    self._inflight_seq += 1
+                    self._inflight[self._inflight_seq] = now
+                METRICS.gauge("workqueue_depth", queue=self.name).set(self._depth())
+                return req
+            with self._cond:
                 if self._shutdown:
                     return None
+                if self._version != v0:
+                    continue  # an add raced our scan; rescan before sleeping
                 wait = None
-                if self._delayed:
-                    wait = max(0.0, self._delayed[0][0] - now)
+                if next_due is not None:
+                    wait = max(0.0, next_due - now)
                 if deadline is not None:
                     rem = deadline - now
                     if rem <= 0:
@@ -186,6 +261,7 @@ class _WorkQueue:
     def shutdown(self) -> None:
         with self._cond:
             self._shutdown = True
+            self._version += 1
             self._cond.notify_all()
 
     def empty(self) -> bool:
@@ -193,7 +269,13 @@ class _WorkQueue:
         (periodic requeues: culling cadence, scheduler retries) don't count —
         they represent scheduled future work, not outstanding work."""
         with self._cond:
-            return not self._pending and self._processing == 0
+            if self._processing != 0:
+                return False
+        for sh in self._shards:
+            with sh.lock:
+                if sh.pending:
+                    return False
+        return True
 
 
 class _Controller:

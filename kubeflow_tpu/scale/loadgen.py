@@ -36,22 +36,27 @@ def _percentile(samples: List[float], q: float) -> float:
 class LoadGenerator:
     def __init__(self, base_url: str, topology: SyntheticTopology,
                  seed: int = 0, namespace: str = "default",
-                 timeout_s: float = 30.0) -> None:
+                 timeout_s: float = 30.0, flow: Optional[str] = None) -> None:
         self.base = base_url.rstrip("/")
         self.topology = topology
         self.namespace = namespace
         self.timeout_s = timeout_s
         self.rng = random.Random(f"loadgen:{seed}")
         self.submitted_gangs: Dict[str, GangShape] = {}
+        #: flow identity stamped on every request (X-Flow-Client) so the
+        #: apiserver's fairness gate can classify this generator's traffic —
+        #: the abuse harness runs one loadgen per tenant persona
+        self.flow = flow
 
     # -- raw HTTP -------------------------------------------------------------
 
     def _request(self, method: str, path: str, body: Optional[dict] = None) -> Any:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"content-type": "application/json"} if data else {}
+        if self.flow:
+            headers["x-flow-client"] = self.flow
         req = urllib.request.Request(
-            self.base + path, data=data,
-            headers={"content-type": "application/json"} if data else {},
-            method=method)
+            self.base + path, data=data, headers=headers, method=method)
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             payload = resp.read()
         return json.loads(payload) if payload else None
@@ -175,8 +180,10 @@ class LoadGenerator:
         def drain(idx: int) -> None:
             url = (f"{self.base}/api/v1/namespaces/{self.namespace}/pods"
                    "?watch=true&sendInitial=true")
+            req = urllib.request.Request(
+                url, headers={"x-flow-client": self.flow} if self.flow else {})
             try:
-                with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     while not stop.is_set():
                         line = resp.readline()
                         if not line:

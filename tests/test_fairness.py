@@ -1,0 +1,522 @@
+"""API priority and fairness: the control plane under tenant abuse.
+
+ISSUE 13 pins the whole shedding pipeline: flow classification, per-level
+concurrency seats, shuffle-sharded bounded queues, 429 + Retry-After on
+overflow, paginated LIST with consistent continue tokens, the watch-cache
+ring (410 on compaction), the informer's relist recovery, the client's
+full-jitter retry discipline, and the sharded controller workqueue.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import REGISTRY, new_object
+from kubeflow_tpu.apiserver.backend import DictBackend
+from kubeflow_tpu.apiserver.client import (
+    RETRY_AFTER_CLAMP_S,
+    Client,
+)
+from kubeflow_tpu.apiserver.fairness import (
+    DEFAULT_LEVELS,
+    LEVEL_LOW,
+    LEVEL_NORMAL,
+    LEVEL_SYSTEM,
+    FlowController,
+    FlowRejected,
+    LevelConfig,
+    classify_flow,
+)
+from kubeflow_tpu.apiserver.server import make_apiserver_app
+from kubeflow_tpu.apiserver.store import (
+    Expired,
+    Store,
+    TooManyRequests,
+)
+from kubeflow_tpu.runtime.informer import SharedInformer
+from kubeflow_tpu.runtime.manager import Request as WQRequest
+from kubeflow_tpu.runtime.manager import _WorkQueue
+from kubeflow_tpu.runtime.metrics import METRICS
+
+PODS = REGISTRY.for_kind("v1", "Pod")
+
+
+def mkpod(name, ns="default", labels=None):
+    return new_object("v1", "Pod", name, ns, labels=labels,
+                      spec={"containers": [{"name": "c"}]})
+
+
+def wait_for(cond, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# flow classification
+# ---------------------------------------------------------------------------
+class TestClassification:
+    def test_system_components_are_system(self):
+        assert classify_flow("system:scheduler") == LEVEL_SYSTEM
+        assert classify_flow("system:podlet") == LEVEL_SYSTEM
+        assert classify_flow("system:controller-manager") == LEVEL_SYSTEM
+
+    def test_anonymous_cannot_self_promote(self):
+        # system:anonymous / system:unauthenticated are NOT system components
+        assert classify_flow("system:anonymous") == LEVEL_NORMAL
+        assert classify_flow("system:unauthenticated") == LEVEL_NORMAL
+
+    def test_bulk_and_interactive_are_low(self):
+        for flow in ("bulk:reaper", "interactive:alice", "notebook:team-a",
+                     "batch:nightly"):
+            assert classify_flow(flow) == LEVEL_LOW, flow
+
+    def test_workload_default_is_normal(self):
+        assert classify_flow("tenant-a") == LEVEL_NORMAL
+        assert classify_flow("anonymous") == LEVEL_NORMAL
+
+    def test_resolve_flow_precedence(self):
+        fc = FlowController()
+        assert fc.resolve_flow("bulk:x", "system:sched") == "bulk:x"  # header wins
+        assert fc.resolve_flow(None, "system:sched") == "system:sched"
+        assert fc.resolve_flow(None, None) == "anonymous"
+
+
+# ---------------------------------------------------------------------------
+# seats / dispatch
+# ---------------------------------------------------------------------------
+class TestConcurrencyShares:
+    def test_seats_bound_concurrent_execution(self):
+        fc = FlowController(levels=(LevelConfig(LEVEL_NORMAL, seats=2, queues=2,
+                                                queue_length=8, hand_size=1),))
+        t1 = fc.acquire("a", LEVEL_NORMAL)
+        t2 = fc.acquire("a", LEVEL_NORMAL)
+        with pytest.raises(FlowRejected) as ei:
+            fc.acquire("a", LEVEL_NORMAL, timeout=0.05)
+        assert ei.value.retry_after_s >= 1.0
+        fc.release(t1)
+        t3 = fc.acquire("a", LEVEL_NORMAL, timeout=1.0)
+        fc.release(t2)
+        fc.release(t3)
+        snap = fc.snapshot()[LEVEL_NORMAL]
+        assert snap["executing"] == 0
+
+    def test_levels_do_not_share_seats(self):
+        # a saturated low level must not consume system capacity
+        fc = FlowController(levels=(
+            LevelConfig(LEVEL_SYSTEM, seats=1, queues=1, queue_length=4),
+            LevelConfig(LEVEL_LOW, seats=1, queues=1, queue_length=4),
+        ))
+        low = fc.acquire("bulk:x", LEVEL_LOW)
+        sys_t = fc.acquire("system:scheduler", LEVEL_SYSTEM)  # immediate
+        assert sys_t.level == LEVEL_SYSTEM
+        fc.release(low)
+        fc.release(sys_t)
+
+    def test_release_dispatches_queued_waiter(self):
+        fc = FlowController(levels=(LevelConfig(LEVEL_NORMAL, seats=1, queues=1,
+                                                queue_length=4),))
+        held = fc.acquire("a", LEVEL_NORMAL)
+        got = []
+
+        def waiter():
+            t = fc.acquire("b", LEVEL_NORMAL, timeout=5.0)
+            got.append(t)
+            fc.release(t)
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        assert wait_for(lambda: fc.snapshot()[LEVEL_NORMAL]["waiting"] == 1)
+        fc.release(held)
+        th.join(timeout=5.0)
+        assert got and got[0].flow == "b"
+        assert got[0].queued_s >= 0.0
+
+    def test_round_robin_across_queues_prevents_monopoly(self):
+        # flow A floods its queue; flow B's single waiter must be dispatched
+        # among the first dispatches, not after all of A's backlog.
+        cfg = LevelConfig(LEVEL_NORMAL, seats=1, queues=8, queue_length=64,
+                          hand_size=1)
+        fc = FlowController(levels=(cfg,))
+        a, b = _disjoint_flows(fc, LEVEL_NORMAL)
+        held = fc.acquire(a, LEVEL_NORMAL)
+        order = []
+        lock = threading.Lock()
+
+        def worker(flow):
+            t = fc.acquire(flow, LEVEL_NORMAL, timeout=10.0)
+            with lock:
+                order.append(flow)
+            fc.release(t)
+
+        threads = [threading.Thread(target=worker, args=(a,), daemon=True)
+                   for _ in range(6)]
+        for th in threads:
+            th.start()
+        assert wait_for(lambda: fc.snapshot()[LEVEL_NORMAL]["waiting"] == 6)
+        tb = threading.Thread(target=worker, args=(b,), daemon=True)
+        tb.start()
+        assert wait_for(lambda: fc.snapshot()[LEVEL_NORMAL]["waiting"] == 7)
+        fc.release(held)  # start the dispatch chain
+        for th in threads + [tb]:
+            th.join(timeout=10.0)
+        assert b in order[:2], f"flow B starved behind A's backlog: {order}"
+
+
+def _disjoint_flows(fc, level):
+    """Two flow names whose shuffle-shard hands don't overlap."""
+    base = fc.hand_of(level, "flow-a")
+    for i in range(1000):
+        cand = f"flow-b{i}"
+        if not set(fc.hand_of(level, cand)) & set(base):
+            return "flow-a", cand
+    raise AssertionError("no disjoint hand found")
+
+
+# ---------------------------------------------------------------------------
+# shuffle sharding
+# ---------------------------------------------------------------------------
+class TestShuffleShard:
+    def test_hand_is_deterministic_and_bounded(self):
+        fc = FlowController()
+        for flow in ("a", "bulk:x", "system:scheduler"):
+            for lvl in (LEVEL_SYSTEM, LEVEL_NORMAL, LEVEL_LOW):
+                hand = fc.hand_of(lvl, flow)
+                assert hand == fc.hand_of(lvl, flow)
+                assert 1 <= len(hand) <= 2
+                n = fc.snapshot()[lvl]["queues"]
+                assert all(0 <= q < n for q in hand)
+
+    def test_noisy_flow_overflow_spares_quiet_flow(self):
+        cfg = LevelConfig(LEVEL_LOW, seats=1, queues=8, queue_length=2,
+                          hand_size=1)
+        fc = FlowController(levels=(cfg,))
+        noisy, quiet = _disjoint_flows(fc, LEVEL_LOW)
+        held = fc.acquire(noisy, LEVEL_LOW)  # saturate the seat
+        threads = []
+        for _ in range(cfg.queue_length):  # fill noisy's entire hand
+            th = threading.Thread(
+                target=lambda: _swallow(lambda: fc.acquire(noisy, LEVEL_LOW, timeout=5.0), fc),
+                daemon=True)
+            th.start()
+            threads.append(th)
+        assert wait_for(
+            lambda: fc.snapshot()[LEVEL_LOW]["waiting"] == cfg.queue_length)
+        # noisy's next request overflows its (full) queue -> shed
+        with pytest.raises(FlowRejected):
+            fc.acquire(noisy, LEVEL_LOW)
+        # the quiet flow's hand is disjoint: still admitted to queue
+        tq = threading.Thread(
+            target=lambda: _swallow(lambda: fc.acquire(quiet, LEVEL_LOW, timeout=5.0), fc),
+            daemon=True)
+        tq.start()
+        assert wait_for(
+            lambda: fc.snapshot()[LEVEL_LOW]["waiting"] == cfg.queue_length + 1)
+        fc.release(held)  # drain everyone
+        for th in threads + [tq]:
+            th.join(timeout=10.0)
+        assert fc.snapshot()[LEVEL_LOW]["executing"] == 0
+
+
+def _swallow(fn, fc):
+    try:
+        fc.release(fn())
+    except FlowRejected:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# HTTP shedding: 429 + Retry-After over the real app surface
+# ---------------------------------------------------------------------------
+class TestHttpShedding:
+    def test_queue_overflow_returns_429_with_retry_after(self):
+        fc = FlowController(levels=(
+            LevelConfig(LEVEL_SYSTEM, seats=4, queues=2, queue_length=8),
+            LevelConfig(LEVEL_NORMAL, seats=4, queues=2, queue_length=8),
+            LevelConfig(LEVEL_LOW, seats=1, queues=1, queue_length=1),
+        ))
+        store = Store()
+        app = make_apiserver_app(store, fairness=fc)
+        # occupy low's only seat out-of-band, then fill its only queue slot
+        held = fc.acquire("bulk:abuser", LEVEL_LOW)
+        parked = threading.Thread(
+            target=lambda: _swallow(
+                lambda: fc.acquire("bulk:abuser", LEVEL_LOW, timeout=5.0), fc),
+            daemon=True)
+        parked.start()
+        assert wait_for(lambda: fc.snapshot()[LEVEL_LOW]["waiting"] == 1)
+        resp = app.call("GET", "/api/v1/pods",
+                        headers={"x-flow-client": "bulk:abuser"})
+        assert resp.status == 429
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert resp.body["reason"] == "TooManyRequests"
+        # other levels keep working while low is saturated
+        ok = app.call("GET", "/api/v1/pods",
+                      headers={"x-flow-client": "system:scheduler"})
+        assert ok.status == 200
+        fc.release(held)
+        parked.join(timeout=5.0)
+        rejected = METRICS.value("apiserver_flowcontrol_rejected_total",
+                                 priority_level=LEVEL_LOW, flow="bulk:abuser")
+        assert rejected >= 1
+
+    def test_debug_fairness_endpoint(self):
+        app = make_apiserver_app(Store(), fairness=FlowController())
+        resp = app.call("GET", "/debug/fairness")
+        assert resp.status == 200
+        assert set(resp.body) == {LEVEL_SYSTEM, LEVEL_NORMAL, LEVEL_LOW}
+
+    def test_no_fairness_means_open_admission(self):
+        app = make_apiserver_app(Store())
+        assert app.call("GET", "/api/v1/pods",
+                        headers={"x-flow-client": "bulk:x"}).status == 200
+
+
+# ---------------------------------------------------------------------------
+# paginated LIST
+# ---------------------------------------------------------------------------
+class TestPagination:
+    def _seed(self, store, n=10):
+        for i in range(n):
+            store.create(mkpod(f"pg-{i:02d}"))
+
+    def test_limit_continue_roundtrip_is_a_consistent_snapshot(self):
+        store = Store()
+        self._seed(store, 10)
+        items, rv, tok = store.list_page(PODS, limit=4)
+        assert len(items) == 4 and tok
+        # writes between pages must not leak into the snapshot
+        store.create(mkpod("pg-zz"))
+        store.delete(PODS, "pg-00", "default")
+        rest = []
+        while tok:
+            page, rv2, tok = store.list_page(PODS, limit=4, continue_token=tok)
+            assert rv2 == rv
+            rest.extend(page)
+        names = [p["metadata"]["name"] for p in items + rest]
+        assert names == [f"pg-{i:02d}" for i in range(10)]
+
+    def test_stale_and_malformed_tokens_are_410(self):
+        store = Store()
+        self._seed(store, 6)
+        _, _, tok = store.list_page(PODS, limit=2)
+        # drain to the end: the snapshot is dropped with the last page
+        while tok:
+            last = tok
+            _, _, tok = store.list_page(PODS, limit=2, continue_token=tok)
+        with pytest.raises(Expired):
+            store.list_page(PODS, limit=2, continue_token=last)
+        with pytest.raises(Expired):
+            store.list_page(PODS, limit=2, continue_token="not-a-token")
+
+    def test_http_list_pagination(self):
+        store = Store()
+        self._seed(store, 5)
+        app = make_apiserver_app(store)
+        resp = app.call("GET", "/api/v1/pods?limit=2")
+        assert resp.status == 200
+        tok = resp.body["metadata"]["continue"]
+        assert len(resp.body["items"]) == 2 and tok
+        seen = [p["metadata"]["name"] for p in resp.body["items"]]
+        while tok:
+            import urllib.parse
+
+            resp = app.call(
+                "GET", f"/api/v1/pods?limit=2&continue={urllib.parse.quote(tok)}")
+            assert resp.status == 200
+            seen += [p["metadata"]["name"] for p in resp.body["items"]]
+            tok = resp.body["metadata"].get("continue")
+        assert seen == [f"pg-{i:02d}" for i in range(5)]
+        assert app.call("GET", "/api/v1/pods?limit=bogus").status == 400
+        assert app.call("GET", "/api/v1/pods?limit=2&continue=stale").status == 410
+
+
+# ---------------------------------------------------------------------------
+# watch cache: ring replay + compaction -> 410 -> informer relist
+# ---------------------------------------------------------------------------
+class TestWatchCache:
+    def test_ring_serves_resume_on_journalless_backend(self):
+        s = Store(DictBackend())
+        s.create(mkpod("w1"))
+        rv = s.backend.current_rv()
+        s.create(mkpod("w2"))
+        s.delete(PODS, "w1", "default")
+        w = s.watch(PODS, since_rv=rv)
+        w.close()
+        evs = [(e.type, e.object["metadata"]["name"]) for e in w]
+        assert evs == [("ADDED", "w2"), ("DELETED", "w1")]
+
+    def test_compaction_raises_410(self):
+        s = Store(DictBackend(), watch_cache_size=4)
+        for i in range(8):  # ring holds the last 4 events only
+            s.create(mkpod(f"c{i}"))
+        with pytest.raises(Expired):
+            s.watch(PODS, since_rv=1)
+
+    def test_informer_recovers_from_compaction_via_relist(self):
+        store = Store(DictBackend(), watch_cache_size=4)
+        client = Client(store)
+        client.create(mkpod("base-0"))
+        relists0 = METRICS.value("informer_relists_total", kind="Pod")
+        inf = SharedInformer(client, "v1", "Pod").start()
+        try:
+            assert inf.wait_synced()
+            assert wait_for(lambda: len(inf) == 1)
+            # sever the stream, then churn far past the ring window so the
+            # resume rv is compacted away
+            inf._watcher.close()
+            for i in range(10):
+                client.create(mkpod(f"churn-{i}"))
+            client.delete("v1", "Pod", "base-0", "default")
+            # the informer must 410, relist through the paginated path, and
+            # converge on the live state with no missed events
+            assert wait_for(lambda: len(inf) == 10 and inf.get("base-0", "default") is None,
+                            timeout=10.0)
+            assert METRICS.value("informer_relists_total", kind="Pod") > relists0
+            # still live after recovery
+            client.create(mkpod("post-relist"))
+            assert wait_for(lambda: inf.get("post-relist", "default") is not None)
+        finally:
+            inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# client retry discipline
+# ---------------------------------------------------------------------------
+class _SheddingStore:
+    """Store stand-in whose list() sheds n times before succeeding."""
+
+    def __init__(self, rejections, retry_after_s=None):
+        self.rejections = rejections
+        self.retry_after_s = retry_after_s
+        self.calls = 0
+
+    def list(self, res, namespace=None, label_selector=None, field_selector=None):
+        self.calls += 1
+        if self.calls <= self.rejections:
+            err = TooManyRequests("shed", retry_after_s=self.retry_after_s)
+            raise err
+        return []
+
+
+class TestClientBackoff:
+    def _client(self, store, **kw):
+        sleeps = []
+        c = Client(store, retry_sleep=sleeps.append,
+                   retry_rng=random.Random(42), **kw)
+        return c, sleeps
+
+    def test_full_jitter_bounds(self):
+        store = _SheddingStore(rejections=3)
+        c, sleeps = self._client(store)
+        assert c.list("v1", "Pod") == []
+        assert store.calls == 4 and len(sleeps) == 3
+        for attempt, d in enumerate(sleeps):
+            assert 0.0 <= d <= min(c.backoff_cap_s,
+                                   c.backoff_base_s * (2.0 ** attempt))
+
+    def test_retry_after_is_the_floor(self):
+        store = _SheddingStore(rejections=2, retry_after_s=7.0)
+        c, sleeps = self._client(store)
+        assert c.list("v1", "Pod") == []
+        assert sleeps == [7.0, 7.0]  # jitter caps at 5s; Retry-After floors it
+
+    def test_retry_after_clamp(self):
+        c, _ = self._client(_SheddingStore(0))
+        assert c.backoff_delay(0, 10_000.0) <= RETRY_AFTER_CLAMP_S
+
+    def test_exhausted_retries_reraise(self):
+        store = _SheddingStore(rejections=99)
+        c, sleeps = self._client(store, max_retries=3)
+        with pytest.raises(TooManyRequests):
+            c.list("v1", "Pod")
+        assert store.calls == 4 and len(sleeps) == 3
+
+    def test_fatal_errors_do_not_retry(self):
+        class Fatal:
+            calls = 0
+
+            def list(self, *a, **k):
+                self.calls += 1
+                raise ValueError("bad request")
+
+        store = Fatal()
+        c, sleeps = self._client(store)
+        with pytest.raises(ValueError):
+            c.list("v1", "Pod")
+        assert store.calls == 1 and sleeps == []
+
+
+# ---------------------------------------------------------------------------
+# sharded workqueue
+# ---------------------------------------------------------------------------
+class TestShardedWorkQueue:
+    def _req(self, i):
+        return WQRequest(name=f"r{i}", namespace="ns")
+
+    def test_dedup_within_and_across_shards(self):
+        q = _WorkQueue("t-dedup")
+        for i in range(32):
+            q.add(self._req(i))
+            q.add(self._req(i))  # duplicate collapses
+        got = set()
+        for _ in range(32):
+            got.add(q.get(timeout=1.0))
+            q.task_done()
+        assert len(got) == 32
+        assert q.get(timeout=0.05) is None
+
+    def test_concurrent_producers_single_consumer(self):
+        q = _WorkQueue("t-conc")
+        n_producers, per = 8, 50
+
+        def produce(p):
+            for i in range(per):
+                q.add(WQRequest(name=f"p{p}-{i}", namespace="ns"))
+
+        threads = [threading.Thread(target=produce, args=(p,), daemon=True)
+                   for p in range(n_producers)]
+        for t in threads:
+            t.start()
+        seen = set()
+        deadline = time.monotonic() + 10.0
+        while len(seen) < n_producers * per and time.monotonic() < deadline:
+            req = q.get(timeout=0.5)
+            if req is not None:
+                seen.add(req)
+                q.task_done()
+        assert len(seen) == n_producers * per
+        assert q.empty()
+
+    def test_add_after_fires_and_earlier_deadline_wins(self):
+        q = _WorkQueue("t-delay")
+        r = self._req(0)
+        q.add_after(r, 5.0)
+        q.add_after(r, 0.05)  # earlier deadline supersedes
+        t0 = time.monotonic()
+        assert q.get(timeout=2.0) == r
+        assert time.monotonic() - t0 < 2.0
+        q.task_done()
+
+    def test_rate_limited_backoff_and_forget(self):
+        q = _WorkQueue("t-rl")
+        r = self._req(0)
+        q.add_rate_limited(r)  # first failure: ~5ms
+        assert q.get(timeout=2.0) == r
+        q.task_done()
+        q.forget(r)
+        sh = q._shard(r)
+        assert r not in sh.failures
+
+    def test_shutdown_drains_then_returns_none(self):
+        q = _WorkQueue("t-shut")
+        q.add(self._req(1))
+        q.shutdown()
+        assert q.get(timeout=1.0) is not None
+        q.task_done()
+        assert q.get(timeout=1.0) is None

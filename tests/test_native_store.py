@@ -123,8 +123,10 @@ class TestJournal:
         names = [e.object["metadata"]["name"] for e in w]
         assert names == ["w1"]
 
-    def test_expired_window_raises_410(self, native_store):
-        s = native_store
+    def test_expired_window_raises_410(self):
+        # ring disabled: this test exercises the backend journal window
+        # itself, not the watch-cache layered above it
+        s = Store(NativeBackend(), watch_cache_size=0)
         s.backend.set_journal_cap(2)
         for i in range(6):
             s.create(mkpod(f"e{i}"))
@@ -137,9 +139,23 @@ class TestJournal:
         assert list(w) == []
 
     def test_dict_backend_rejects_since_rv(self):
-        s = Store(DictBackend())
+        # with the watch-cache ring disabled, a journal-less backend still
+        # refuses rv-resumed watches outright
+        s = Store(DictBackend(), watch_cache_size=0)
         with pytest.raises(Invalid):
             s.watch(PODS, since_rv=0)
+
+    def test_dict_backend_serves_since_rv_from_ring(self):
+        # the default watch-cache ring makes rv resume work even on a
+        # journal-less backend, as long as the rv is within the ring window
+        s = Store(DictBackend())
+        s.create(mkpod("r1"))
+        rv = s.backend.current_rv()
+        s.create(mkpod("r2"))
+        w = s.watch(PODS, since_rv=rv)
+        w.close()
+        names = [ev.object["metadata"]["name"] for ev in w]
+        assert names == ["r2"]
 
     def test_noop_update_not_journaled(self, native_store):
         s = native_store

@@ -19,6 +19,11 @@ Three stages per run:
 3. **Watch storm** — concurrent watch streams + mass relists against the
    same stack; apiserver list p99 comes from the server-side
    ``apiserver_request_seconds{verb="list"}`` series.
+4. **Abuse (ISSUE 13)** — the same stack behind the priority-and-fairness
+   gate while a seeded ``bulk:abuser`` flood hammers LIST through the real
+   HTTP path: ``bind_latency_p99_s_under_abuse`` (gang waves keep binding)
+   and ``apiserver_rejected_fraction_lowpri`` (the flood is shed with
+   429s) are the gated rows.
 
 Usage::
 
@@ -139,6 +144,87 @@ def run_stack(topology, gangs: int, storm_streams: int, storm_relists: int,
         mgr.stop()
 
 
+def run_abuse(topology, gangs: int, flood_s: float,
+              seed: int = SEED) -> Dict[str, Any]:
+    """Stage 4: the fairness-gated stack under a seeded low-priority flood.
+    The scheduler reconciles through the gate as ``system:scheduler`` (over
+    RemoteStore, like a split deployment) while the flood blasts LIST as
+    ``bulk:abuser``; the wave's bind p99 and the flood's rejected fraction
+    are the gated rows."""
+    import os
+
+    from kubeflow_tpu.apiserver.client import Client
+    from kubeflow_tpu.apiserver.fairness import (
+        DEFAULT_LEVELS,
+        LEVEL_LOW,
+        FlowController,
+        LevelConfig,
+    )
+    from kubeflow_tpu.apiserver.remote import RemoteStore
+    from kubeflow_tpu.apiserver.server import make_apiserver_app
+    from kubeflow_tpu.apiserver.store import Store
+    from kubeflow_tpu.controllers.builtin import PodletReconciler
+    from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import METRICS, quantile_from_counts
+    from kubeflow_tpu.scale.loadgen import LoadGenerator
+    from kubeflow_tpu.scale.topology import synth_gangs
+    from kubeflow_tpu.scheduler import SchedulerReconciler
+
+    METRICS.reset()
+    store = Store()
+    client = Client(store, event_retention=4096)
+    for node in topology.nodes():
+        client.create(node)
+    # low pinned to a sliver so a CPU-budget flood demonstrably overflows
+    levels = tuple(c for c in DEFAULT_LEVELS if c.name != LEVEL_LOW) + (
+        LevelConfig(LEVEL_LOW, seats=1, queues=4, queue_length=2, hand_size=1),)
+    app = make_apiserver_app(store, fairness=FlowController(levels=levels))
+    httpd = app.serve(0)
+    base = f"http://127.0.0.1:{httpd.port}"
+    mgr = Manager(RemoteStore(base, flow="system:scheduler"))
+    mgr.add(SchedulerReconciler(
+        assembly_timeout=10.0, reservation_ttl=5.0,
+        backoff_base=0.05, backoff_cap=0.5))
+    mgr.add(PodletReconciler())
+    mgr.start()
+    monkey = ChaosMonkey(client, ChaosSchedule([]), apiserver_url=base)
+    try:
+        gen = LoadGenerator(base, topology, seed=seed, flow="tenant-train")
+        warm = synth_gangs(topology, 1, seed=seed - 1, prefix="warm", max_size=2)
+        gen.gang_wave(warm)
+        gen.wait_gangs_bound([s.name for s in warm], timeout_s=90.0)
+
+        before = METRICS.histogram_counts("scheduler_bind_latency_seconds")
+        qps = 60.0 * min(os.cpu_count() or 1, 8)
+        monkey.flood_apiserver("bulk:abuser", qps=qps, duration_s=flood_s)
+        time.sleep(0.2)
+        shapes = synth_gangs(topology, gangs, seed=seed + 2, prefix="abuse",
+                             max_size=6)
+        gen.gang_wave(shapes)
+        gen.wait_gangs_bound([s.name for s in shapes], timeout_s=120.0)
+        after = METRICS.histogram_counts("scheduler_bind_latency_seconds")
+        monkey.join(timeout=flood_s + 15.0)
+        flood = monkey.flood_stats[0]
+
+        buckets, counts_a, total_a = after
+        counts_b, total_b = ([0] * len(counts_a), 0) if before is None else (
+            list(before[1]), before[2])
+        delta = [a - b for a, b in zip(counts_a, counts_b)]
+        p99 = quantile_from_counts(buckets, delta, total_a - total_b, 0.99) or 0.0
+        return {
+            "bind_p99_abuse_s": p99,
+            "rejected_fraction": (flood["rejected"] / flood["sent"]
+                                  if flood["sent"] else 0.0),
+            "flood": flood,
+            "pods_bound": sum(s.size for s in shapes),
+        }
+    finally:
+        monkey.stop()
+        mgr.stop()
+        httpd.close()
+
+
 def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
                storm_streams: int, storm_relists: int,
                flagship: bool) -> Dict[str, float]:
@@ -170,6 +256,13 @@ def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
          lists=stack["storm"]["lists"],
          watch_events=stack["storm"]["watch_events"],
          client_list_p99_ms=round(stack["storm"]["list_p99_ms"], 2))
+
+    abuse = run_abuse(topo, gangs=gangs, flood_s=4.0)
+    emit(f"bind_latency_p99_s_under_abuse{suffix}", abuse["bind_p99_abuse_s"],
+         nodes=topo.total_nodes, pods_bound=abuse["pods_bound"],
+         flood=abuse["flood"])
+    emit(f"apiserver_rejected_fraction_lowpri{suffix}", abuse["rejected_fraction"],
+         nodes=topo.total_nodes, flood=abuse["flood"])
     return {
         f"scheduler_cycles_per_sec{suffix}": round(indexed, 2),
         f"scheduler_cycles_per_sec_fullscan{suffix}": round(fullscan, 2),
@@ -177,6 +270,8 @@ def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
         f"bind_latency_p50_s{suffix}": round(stack["bind_p50_s"] or 0.0, 4),
         f"bind_latency_p99_s{suffix}": round(stack["bind_p99_s"] or 0.0, 4),
         f"apiserver_list_p99_ms_storm{suffix}": round(stack["apiserver_list_p99_ms"], 2),
+        f"bind_latency_p99_s_under_abuse{suffix}": round(abuse["bind_p99_abuse_s"], 4),
+        f"apiserver_rejected_fraction_lowpri{suffix}": round(abuse["rejected_fraction"], 4),
     }
 
 
