@@ -299,6 +299,26 @@ def monitoring_e2e() -> Dict:
 
 
 #: registry of buildable workflows (prow_config.yaml names resolve here)
+def trace_federation_e2e() -> Dict:
+    """The trace-federation job: one gang-bind journey traced across three
+    real processes — a traceparent minted at the loadgen edge must reappear
+    verbatim in the bound pods' creation and bind annotations and in the
+    serving retire span, the TraceCollector must assemble the trace from
+    the apiserver's and scheduler's /debug/traces buffers plus the client's
+    own ring (>= 3 services), critical_path() must reconstruct the recorded
+    bind latency within 10%, and tail sampling under a 2x-budget burst must
+    keep every error trace and the slowest gang bind inside the span bound
+    (e2e/trace_federation_driver.py asserts all of it), plus the
+    propagation / collector / critical-path unit suite."""
+    b = WorkflowBuilder("trace-federation-e2e")
+    b.run("trace-federation-driver",
+          ["python", "-m", "e2e.trace_federation_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("trace-federation-unit", "tests/test_trace_federation.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
     "platform-e2e": platform_e2e,
@@ -316,6 +336,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "bench-regression": bench_regression,
     "attribution-e2e": attribution_e2e,
     "monitoring-e2e": monitoring_e2e,
+    "trace-federation-e2e": trace_federation_e2e,
 }
 
 

@@ -32,6 +32,9 @@ from .store import Store
 
 def main() -> None:
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    from ..runtime.tracing import TRACER
+
+    TRACER.service = "apiserver"  # federated spans name their process
     store = Store()
     webhook_url = os.environ.get("WEBHOOK_URL", "")
     auth = auth_from_env(store)

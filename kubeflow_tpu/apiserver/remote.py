@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from ..api import meta as apimeta
 from ..api.meta import Resource
+from ..runtime.tracing import TRACER, format_traceparent
 from .store import (
     ApiError,
     Conflict,
@@ -167,6 +168,13 @@ class RemoteStore:
             headers["authorization"] = f"Bearer {self.token}"
         if self.flow:
             headers["x-flow-client"] = self.flow
+        # Propagate the caller's trace across the hop: the apiserver's
+        # dispatch span continues this header, so a reconcile's writes show
+        # up inside the reconcile trace instead of dying at the process
+        # boundary.
+        cur = TRACER.current_span()
+        if cur is not None:
+            headers["traceparent"] = format_traceparent(cur)
         req = urllib.request.Request(url, data=data, method=method, headers=headers)
         try:
             return urllib.request.urlopen(

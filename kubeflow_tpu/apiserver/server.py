@@ -30,7 +30,7 @@ from ..api import meta as apimeta
 from ..api.conversion import convert, convert_fragment, hub_resource
 from ..api.meta import REGISTRY, Resource
 from ..runtime.metrics import METRICS
-from ..runtime.tracing import TRACER
+from ..runtime.tracing import TRACEPARENT_ANNOTATION, TRACER, format_traceparent
 from ..web.http import App, HttpError, JsonResponse, Request, StreamingResponse
 from .auth import ApiAuth, Identity, Unauthenticated
 from .fairness import FlowController, FlowRejected
@@ -235,6 +235,16 @@ def make_apiserver_app(
         obj.setdefault("kind", res.kind)
         if req.params.get("ns"):
             obj.setdefault("metadata", {}).setdefault("namespace", req.params["ns"])
+        # Stamp the creating request's trace context on the object: the hop
+        # from a client's POST to the watch-driven reconcile it causes has
+        # no header to carry, so the object itself carries it (a client's
+        # own traceparent survives verbatim via the dispatch span).
+        cur = TRACER.current_span()
+        if cur is not None:
+            md = obj.setdefault("metadata", {})
+            ann = dict(md.get("annotations") or {})
+            ann.setdefault(TRACEPARENT_ANNOTATION, format_traceparent(cur))
+            md["annotations"] = ann
         try:
             return JsonResponse(outbound(store.create(inbound(obj, res)), res), status=201)
         except ApiError as e:

@@ -18,6 +18,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Conflict
 from ..runtime.manager import Reconciler, Request, Result
+from ..runtime.tracing import BIND_TRACEPARENT_ANNOTATION, TRACER
 from ..scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION, requires_scheduling
 from ..tpu.topology import RESOURCE_TPU
 
@@ -258,6 +259,21 @@ class PodletReconciler(Reconciler):
                 # update re-triggers this reconciler.
                 return Result()
             # No nodes and no TPU request: run in place (unit-test mode).
+        return self._start(client, pod)
+
+    def _start(self, client: Client, pod: Dict[str, Any]) -> Result:
+        # pod.start joins the gang trace through the scheduler's bind
+        # annotation — the critical-path analyzer's post-bind segment.
+        with TRACER.span(
+            "pod.start",
+            traceparent=apimeta.annotations_of(pod).get(
+                BIND_TRACEPARENT_ANNOTATION),
+            pod=f"{apimeta.namespace_of(pod) or ''}/{apimeta.name_of(pod)}",
+            node=str((pod.get("spec") or {}).get("nodeName") or ""),
+        ):
+            return self._run_pod(client, pod)
+
+    def _run_pod(self, client: Client, pod: Dict[str, Any]) -> Result:
         pod["status"] = {
             "phase": "Running",
             "podIP": "10.1.0.1",

@@ -37,4 +37,5 @@ from .rules import (  # noqa: F401
     RuleEngine,
     SLOBurnRateAlert,
 )
+from .traces import TraceCollector, critical_path, traces_url  # noqa: F401
 from .plane import MonitoringPlane, install_cluster_collector  # noqa: F401

@@ -27,6 +27,7 @@ from ..runtime.metrics import METRICS, MetricsRegistry
 from ..web.http import App, JsonResponse, Request
 from .rules import RuleEngine
 from .scrape import Scraper, Target, _format_value
+from .traces import TraceCollector
 from .tsdb import TSDB
 
 log = logging.getLogger("kubeflow_tpu.monitoring")
@@ -43,6 +44,7 @@ class MonitoringPlane:
         registry: MetricsRegistry = METRICS,
         stale_after: int = 3,
         timeout_s: float = 5.0,
+        traces: Optional[TraceCollector] = None,
     ) -> None:
         self.tsdb = tsdb if tsdb is not None else TSDB()
         self.scraper = scraper if scraper is not None else Scraper(
@@ -52,6 +54,9 @@ class MonitoringPlane:
         self.rules = rules if rules is not None else RuleEngine(
             self.tsdb, client=client, registry=registry,
         )
+        # trace federation rides the same discovery + cadence as metrics;
+        # optional because not every plane consumer wants the span store
+        self.traces = traces
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -59,6 +64,8 @@ class MonitoringPlane:
         """One scrape pass then one rule evaluation; returns alert statuses."""
         now = time.time() if now is None else now
         self.scraper.scrape_once(now)
+        if self.traces is not None:
+            self.traces.collect_once()
         return self.rules.evaluate(now)
 
     def start(self, interval_s: float = 5.0) -> None:
@@ -121,6 +128,8 @@ class MonitoringPlane:
         from ..runtime.obs import EXPOSITION_CONTENT_TYPE, register_debug_source
 
         register_debug_source("alerts", lambda req: self.rules.snapshot())
+        if self.traces is not None:
+            self.traces.mount(app)
         if any(pattern == "/federate" for _m, pattern, _fn in app.iter_routes()):
             return app
 

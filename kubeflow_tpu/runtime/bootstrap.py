@@ -39,6 +39,12 @@ def serve_ops_endpoints(name: str, port: Optional[int] = None):
     promhttp on each Go binary — e.g. kfam routers.go:85-89; here the
     mount also brings /debug/traces + /debug/vars)."""
     from .obs import mount_observability
+    from .tracing import TRACER
+
+    # The process-global tracer takes the role's identity: federated spans
+    # carry service.name=<role> so the TraceCollector can tell which
+    # process each hop of an assembled trace ran in.
+    TRACER.service = name
 
     app = App(f"{name}-ops")
 

@@ -28,6 +28,7 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Expired
 from .metrics import METRICS
+from .tracing import TRACER
 
 log = logging.getLogger("kubeflow_tpu.informer")
 
@@ -269,7 +270,12 @@ class SharedInformer:
                 log.warning("informer %s: watch window expired (%s); relisting", self.kind, e)
                 METRICS.counter("informer_relists_total", kind=self.kind).inc()
                 try:
-                    self._relist()
+                    # Detached: a relist re-syncs the world for every
+                    # consumer; its paginated LISTs must not inherit (and
+                    # bill their latency to) whatever request's trace
+                    # happens to be current on this thread.
+                    with TRACER.detached():
+                        self._relist()
                 except Exception as e2:
                     log.warning("informer %s: relist failed: %s", self.kind, e2)
                     METRICS.counter("informer_watch_reconnects_total", kind=self.kind).inc()

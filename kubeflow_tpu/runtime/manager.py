@@ -24,7 +24,12 @@ from ..api import meta as apimeta
 from ..apiserver.client import Client
 from ..apiserver.store import Store
 from .metrics import METRICS
-from .tracing import TRACER
+from .tracing import (
+    TRACEPARENT_ANNOTATION,
+    TRACER,
+    format_traceparent,
+    parse_traceparent,
+)
 
 log = logging.getLogger("kubeflow_tpu.runtime")
 
@@ -68,7 +73,7 @@ class _Shard:
     delayed heap, deadline/failure/enqueue-time maps."""
 
     __slots__ = ("lock", "pending", "delayed", "deadlines", "failures",
-                 "added_at", "seq")
+                 "added_at", "traces", "seq")
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
@@ -80,6 +85,10 @@ class _Shard:
         self.failures: Dict[Request, int] = {}
         #: enqueue time per pending request (queue-duration histogram)
         self.added_at: Dict[Request, float] = {}
+        #: trace context per pending request (the Request key is frozen, so
+        #: the causing event's traceparent rides beside it; last enqueuer
+        #: wins — the dedup'd item parents to the freshest cause)
+        self.traces: Dict[Request, str] = {}
         self.seq = 0
 
 
@@ -113,6 +122,9 @@ class _WorkQueue:
         #: start times of in-flight items, FIFO-drained by task_done()
         self._inflight: Dict[int, float] = {}
         self._inflight_seq = 0
+        #: traceparent captured at get() per in-flight request, consumed by
+        #: trace_of() on the worker before it opens the reconcile span
+        self._popped_traces: Dict[Request, str] = {}
         self._shutdown = False
         # unfinished-work must grow while a reconcile hangs, so it is
         # computed at scrape time; keyed registration keeps remounts (and
@@ -153,9 +165,17 @@ class _WorkQueue:
             self._version += 1
             self._cond.notify()
 
-    def add(self, req: Request) -> None:
+    def add(self, req: Request, traceparent: Optional[str] = None) -> None:
+        if traceparent is None:
+            cur = TRACER.current_span()
+            traceparent = format_traceparent(cur) if cur is not None else None
         sh = self._shard(req)
         with sh.lock:
+            if traceparent:
+                # last-enqueuer wins: a dedup'd key carries the trace of the
+                # most recent event that (re)queued it, so the reconcile span
+                # parents to the cause the worker is actually reacting to
+                sh.traces[req] = traceparent
             if req in sh.pending:
                 return
             sh.pending[req] = None
@@ -189,10 +209,13 @@ class _WorkQueue:
         with sh.lock:
             sh.failures.pop(req, None)
 
-    def _try_pop(self, now: float) -> Tuple[Optional[Request], Optional[float]]:
+    def _try_pop(
+        self, now: float
+    ) -> Tuple[Optional[Request], Optional[str], Optional[float]]:
         """One pass over all shards from the rotation cursor: promote due
         delayed items, pop the first pending request. Returns (request or
-        None, earliest future delayed deadline or None)."""
+        None, its carried traceparent or None, earliest future delayed
+        deadline or None)."""
         n = len(self._shards)
         start = self._rr
         next_due: Optional[float] = None
@@ -215,13 +238,18 @@ class _WorkQueue:
                     req = next(iter(sh.pending))
                     del sh.pending[req]
                     added = sh.added_at.pop(req, None)
+                    trace = sh.traces.pop(req, None)
                     if added is not None:
+                        parsed = parse_traceparent(trace) if trace else None
+                        # exemplar: a bad queue-duration bucket links straight
+                        # to the trace of the event that sat in it
                         METRICS.histogram(
                             "workqueue_queue_duration_seconds", queue=self.name
-                        ).observe(now - added)
+                        ).observe(now - added,
+                                  trace_id=parsed[0] if parsed else None)
                     self._rr = (start + i + 1) % n
-                    return req, next_due
-        return None, next_due
+                    return req, trace, next_due
+        return None, None, next_due
 
     def get(self, timeout: Optional[float] = None) -> Optional[Request]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -229,12 +257,16 @@ class _WorkQueue:
             with self._cond:
                 v0 = self._version
             now = time.monotonic()
-            req, next_due = self._try_pop(now)
+            req, trace, next_due = self._try_pop(now)
             if req is not None:
                 with self._cond:
                     self._processing += 1
                     self._inflight_seq += 1
                     self._inflight[self._inflight_seq] = now
+                    if trace:
+                        self._popped_traces[req] = trace
+                    else:
+                        self._popped_traces.pop(req, None)
                 METRICS.gauge("workqueue_depth", queue=self.name).set(self._depth())
                 return req
             with self._cond:
@@ -251,6 +283,12 @@ class _WorkQueue:
                         return None
                     wait = rem if wait is None else min(wait, rem)
                 self._cond.wait(wait)
+
+    def trace_of(self, req: Request) -> Optional[str]:
+        """The trace context carried by the last ``get()`` of this request
+        (consumed — a second call returns None)."""
+        with self._cond:
+            return self._popped_traces.pop(req, None)
 
     def task_done(self) -> None:
         with self._cond:
@@ -340,8 +378,14 @@ class _Controller:
                 try:
                     for event in watcher:
                         try:
+                            # The object's creation traceparent (stamped by
+                            # the apiserver) is the causing trace: carry it
+                            # through the queue so the reconcile span joins
+                            # the client call that made the object.
+                            tp = apimeta.annotations_of(event.object).get(
+                                TRACEPARENT_ANNOTATION)
                             for req in mapper(event.object) or []:
-                                self.queue.add(req)
+                                self.queue.add(req, traceparent=tp)
                         except Exception:  # mapper bugs must not kill the pump
                             log.exception("%s: watch mapper failed", self.name)
                 finally:
@@ -383,6 +427,7 @@ class _Controller:
             try:
                 with TRACER.span(
                     "reconcile",
+                    traceparent=self.queue.trace_of(req),
                     controller=self.name,
                     request=f"{req.namespace or ''}/{req.name}",
                 ) as span:

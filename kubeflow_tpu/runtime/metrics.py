@@ -42,7 +42,9 @@ def _current_trace_id() -> Optional[str]:
     add ~one getattr per observation when tracing is idle."""
     global _tracing
     if _tracing is None:
-        from . import tracing as _t  # no cycle: tracing imports stdlib only
+        # no import-time cycle: tracing reaches back here just as lazily
+        # (the abandoned-span sweep's counter)
+        from . import tracing as _t
 
         _tracing = _t
     span = getattr(_tracing._local, "span", None)

@@ -8,7 +8,8 @@ adds, idempotently, to any ``web.http.App``:
 - ``GET /metrics``        — Prometheus/OpenMetrics text exposition (with
   trace-id exemplars on histogram buckets and the stdlib process collector),
 - ``GET /debug/traces``   — recent spans as OTLP-shaped JSON, filterable by
-  ``?trace_id=`` / ``?name=`` / ``?limit=`` (most recent last),
+  ``?trace_id=`` / ``?name=`` / ``?service=`` / ``?limit=`` (most recent
+  last),
 - ``GET /debug/vars``     — expvar-style process snapshot (pid, uptime,
   RSS, threads, GC, trace-buffer depth, metric families).
 
@@ -59,10 +60,16 @@ def register_debug_source(name: str, handler: Callable[[Request], Any]) -> None:
 
 
 def otlp_traces(tracer: Tracer, trace_id: Optional[str] = None,
-                name: Optional[str] = None, limit: int = 256) -> dict:
+                name: Optional[str] = None, limit: int = 256,
+                service: Optional[str] = None) -> dict:
     """The ring buffer's tail as one OTLP-shaped resourceSpans document —
-    loadable by OTLP-adjacent tooling and by the e2e assertions."""
+    loadable by OTLP-adjacent tooling and by the e2e assertions. ``service``
+    filters by each span's ``service.name`` attribute (a fleet replica's
+    decode path federates under its engine's service identity)."""
     spans = tracer.finished_spans(name=name, trace_id=trace_id)
+    if service is not None:
+        spans = [s for s in spans
+                 if s.attributes.get("service.name") == service]
     spans = spans[-max(0, min(limit, MAX_TRACE_SPANS)):]
     return {
         "resourceSpans": [
@@ -70,7 +77,9 @@ def otlp_traces(tracer: Tracer, trace_id: Optional[str] = None,
                 "resource": {
                     "attributes": [
                         {"key": "service.name",
-                         "value": {"stringValue": tracer.service}}
+                         "value": {"stringValue": tracer.service}},
+                        {"key": "service.instance.id",
+                         "value": {"stringValue": tracer.instance}},
                     ]
                 },
                 "scopeSpans": [
@@ -113,6 +122,7 @@ def mount_observability(
             trace_id=req.query1("trace_id") or None,
             name=req.query1("name") or None,
             limit=limit,
+            service=req.query1("service") or None,
         )
 
     @app.route("/debug/vars")

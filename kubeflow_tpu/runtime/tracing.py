@@ -21,6 +21,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import socket
 import threading
 import time
 from contextlib import contextmanager
@@ -28,6 +29,21 @@ from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, Iterator, List, Optional
 
 _local = threading.local()
+
+#: annotation carrying the creating request's W3C ``traceparent`` — stamped
+#: by the apiserver on create, so watch-driven reconciles (and the gang
+#: lifecycle trace) parent to the client call that caused the object
+TRACEPARENT_ANNOTATION = "tracing.kubeflow.org/traceparent"
+
+#: annotation the scheduler stamps on the bind write (same update that sets
+#: ``spec.nodeName``): the gang trace's bind span, so podlet/engine/training
+#: spans started off the bound pod join the same trace
+BIND_TRACEPARENT_ANNOTATION = "tracing.kubeflow.org/bind-traceparent"
+
+#: default TTL for ``start_span()`` spans never ended (a crashed worker):
+#: past it the sweep force-closes them as ERROR and counts
+#: ``tracing_spans_abandoned_total``
+OPEN_SPAN_TTL_S = 600.0
 
 
 def _rand_hex(nbytes: int) -> str:
@@ -87,10 +103,23 @@ class Tracer:
     """Span factory + ring-buffer store (+ optional JSON-lines export)."""
 
     def __init__(self, service: str = "kubeflow-tpu", capacity: int = 4096,
-                 export_path: Optional[str] = None):
+                 export_path: Optional[str] = None,
+                 instance: Optional[str] = None,
+                 open_span_ttl_s: float = OPEN_SPAN_TTL_S):
         self.service = service
+        #: OTLP resource identity (service.instance.id): which process a
+        #: federated span came from — the TraceCollector's assembly key
+        self.instance = instance or f"{socket.gethostname()}:{os.getpid()}"
         self._spans: Deque[Span] = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # cross-thread open-span map: every start_span() registers here and
+        # end_span() removes; bounded by the TTL sweep plus a hard cap so a
+        # caller that never calls end_span can't grow memory forever
+        self.open_span_ttl_s = open_span_ttl_s
+        self.max_open_spans = capacity
+        self._open: Dict[str, Span] = {}
+        self._open_lock = threading.Lock()
+        self._last_sweep = time.monotonic()
         self._export_path = export_path or os.environ.get("KUBEFLOW_TPU_TRACE_FILE")
         self._export_file = None  # opened lazily, kept for the tracer's life
         # export serializes on its OWN lock: a slow disk must stall at most
@@ -123,7 +152,7 @@ class Tracer:
                 parent = Span("remote", trace_id, parent_span_id)
         if parent is None:
             parent = self.current_span()
-        return Span(
+        span = Span(
             name=name,
             trace_id=parent.trace_id if parent else _rand_hex(16),
             span_id=_rand_hex(8),
@@ -131,15 +160,69 @@ class Tracer:
             start_ns=time.time_ns(),
             attributes={"service.name": self.service, **attributes},
         )
+        with self._open_lock:
+            self._open[span.span_id] = span
+            over = len(self._open) - self.max_open_spans
+            oldest = (sorted(self._open.values(), key=lambda s: s.start_ns)[:over]
+                      if over > 0 else [])
+            for stale in oldest:
+                del self._open[stale.span_id]
+        if oldest:
+            self._abandon(oldest, f"evicted: >{self.max_open_spans} open spans")
+        self._maybe_sweep()
+        return span
 
     def end_span(self, span: Span, error: Optional[BaseException] = None) -> Span:
         """Close and record a ``start_span()`` span (idempotence is the
         caller's business)."""
+        with self._open_lock:
+            self._open.pop(span.span_id, None)
         if error is not None:
             span.record_error(error)
         span.end_ns = time.time_ns()
         self._record(span)
         return span
+
+    def open_spans(self) -> List[Span]:
+        """Spans started but not yet ended (debug/test view)."""
+        with self._open_lock:
+            return list(self._open.values())
+
+    def _maybe_sweep(self) -> None:
+        # amortized: at most one sweep per quarter-TTL, checked with one
+        # monotonic read on the start_span hot path
+        if time.monotonic() - self._last_sweep < self.open_span_ttl_s / 4:
+            return
+        self.sweep_abandoned()
+
+    def sweep_abandoned(self, ttl_s: Optional[float] = None) -> int:
+        """Force-close open spans older than the TTL (their worker crashed or
+        forgot end_span): recorded as ERROR and counted by
+        ``tracing_spans_abandoned_total`` so the leak is visible, while the
+        open-span map stays bounded."""
+        ttl = self.open_span_ttl_s if ttl_s is None else ttl_s
+        self._last_sweep = time.monotonic()
+        cutoff = time.time_ns() - int(ttl * 1e9)
+        with self._open_lock:
+            stale = [s for s in self._open.values() if s.start_ns <= cutoff]
+            for s in stale:
+                del self._open[s.span_id]
+        self._abandon(stale, f"abandoned: not ended within {ttl:.0f}s")
+        return len(stale)
+
+    def _abandon(self, spans: List[Span], message: str) -> None:
+        if not spans:
+            return
+        # metrics is imported lazily: no import-time cycle (metrics reaches
+        # back into this module for exemplar trace ids the same way)
+        from .metrics import METRICS
+
+        for s in spans:
+            s.status = "ERROR"
+            s.status_message = message
+            s.end_ns = time.time_ns()
+            self._record(s)
+            METRICS.counter("tracing_spans_abandoned_total").inc()
 
     def emit_span(
         self,
@@ -191,6 +274,19 @@ class Tracer:
             _local.span = prev
             self.end_span(span)
 
+    @contextmanager
+    def detached(self) -> Iterator[None]:
+        """Run with NO current span: for work triggered from inside a
+        request's context that is not part of that request (an informer
+        410-relist re-syncs the world for everyone — its outbound LISTs
+        must not inherit the triggering stream's trace)."""
+        prev = self.current_span()
+        _local.span = None
+        try:
+            yield
+        finally:
+            _local.span = prev
+
     # -- storage / export ----------------------------------------------------
     def _record(self, span: Span) -> None:
         with self._lock:
@@ -239,6 +335,8 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self._spans.clear()
+        with self._open_lock:
+            self._open.clear()
 
 
 # -- Chrome trace events (the Perfetto-loadable export) -----------------------

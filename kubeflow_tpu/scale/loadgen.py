@@ -20,6 +20,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, Iterable, List, Optional
 
+from ..runtime.tracing import TRACER, format_traceparent
 from ..scheduler.gang import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION
 from ..tpu.topology import RESOURCE_TPU
 from .topology import GangShape, SyntheticTopology
@@ -36,7 +37,8 @@ def _percentile(samples: List[float], q: float) -> float:
 class LoadGenerator:
     def __init__(self, base_url: str, topology: SyntheticTopology,
                  seed: int = 0, namespace: str = "default",
-                 timeout_s: float = 30.0, flow: Optional[str] = None) -> None:
+                 timeout_s: float = 30.0, flow: Optional[str] = None,
+                 traceparent: Optional[str] = None) -> None:
         self.base = base_url.rstrip("/")
         self.topology = topology
         self.namespace = namespace
@@ -47,6 +49,11 @@ class LoadGenerator:
         #: apiserver's fairness gate can classify this generator's traffic —
         #: the abuse harness runs one loadgen per tenant persona
         self.flow = flow
+        #: W3C trace context for this generator's traffic: gang submits open
+        #: a client-side ``gang.submit`` span continuing it, so the trace
+        #: federation e2e can inject a known trace id at the user edge and
+        #: find it again in the bound pod's annotation
+        self.traceparent = traceparent
 
     # -- raw HTTP -------------------------------------------------------------
 
@@ -55,6 +62,11 @@ class LoadGenerator:
         headers = {"content-type": "application/json"} if data else {}
         if self.flow:
             headers["x-flow-client"] = self.flow
+        cur = TRACER.current_span()
+        if cur is not None:
+            headers["traceparent"] = format_traceparent(cur)
+        elif self.traceparent:
+            headers["traceparent"] = self.traceparent
         req = urllib.request.Request(
             self.base + path, data=data, headers=headers, method=method)
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
@@ -100,6 +112,14 @@ class LoadGenerator:
         return f"{gang}-{i}"
 
     def submit_gang(self, shape: GangShape) -> List[str]:
+        # The user edge of the gang journey: every member POST runs under
+        # one client-side span (continuing self.traceparent when set), so
+        # the federated trace starts in THIS process, not at the apiserver.
+        with TRACER.span("gang.submit", traceparent=self.traceparent,
+                         gang=shape.name, size=shape.size):
+            return self._submit_gang(shape)
+
+    def _submit_gang(self, shape: GangShape) -> List[str]:
         names = []
         for i in range(shape.size):
             name = self.pod_name(shape.name, i)

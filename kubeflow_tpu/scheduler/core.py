@@ -37,7 +37,13 @@ from ..apiserver.client import Client
 from ..apiserver.store import Conflict, NotFound
 from ..runtime.manager import Reconciler, Request, Result
 from ..runtime.metrics import METRICS
-from ..runtime.tracing import TRACER
+from ..runtime.tracing import (
+    BIND_TRACEPARENT_ANNOTATION,
+    TRACEPARENT_ANNOTATION,
+    TRACER,
+    Span,
+    format_traceparent,
+)
 from ..tpu.topology import chips_in_quota, pod_tpu_chips
 from .flight import (
     Decision,
@@ -138,6 +144,11 @@ class SchedulerReconciler(Reconciler):
         #: victim gang → in-flight drain (docs/ELASTICITY.md): who asked,
         #: the grace deadline, and the pods/chips the eviction will free
         self._draining: Dict[GangKey, Dict[str, Any]] = {}
+        #: gang → its lifecycle root span: opened at gang submit (first
+        #: scheduling attempt), parented to the submitting client's trace
+        #: via the creation-traceparent annotation, closed by _gang_done /
+        #: _pod_gone; every cycle/quota/preempt/bind span hangs under it
+        self._gang_spans: Dict[GangKey, Span] = {}
 
     def watches(self):
         def wake_pending(_node: Dict[str, Any]) -> List[Request]:
@@ -193,8 +204,22 @@ class SchedulerReconciler(Reconciler):
         with self._lock:
             self._gang_of_pod[(req.namespace, req.name)] = key
             self._first_attempt.setdefault(key, time.monotonic())
+            root = self._gang_spans.get(key)
+        if root is None:
+            # Gang submit: open the lifecycle root. Parent preference is the
+            # pod's creation traceparent (the client call that submitted the
+            # gang), falling back to the current reconcile span — either way
+            # the whole gang journey shares one trace id.
+            root = TRACER.start_span(
+                "gang.lifecycle",
+                traceparent=apimeta.annotations_of(pod).get(
+                    TRACEPARENT_ANNOTATION),
+                gang=f"{key[0]}/{key[1]}", size=gang.size)
+            with self._lock:
+                root = self._gang_spans.setdefault(key, root)
         with TRACER.span(
-            "schedule", controller=type(self).__name__, gang=f"{key[0]}/{key[1]}"
+            "schedule", parent=root, controller=type(self).__name__,
+            gang=f"{key[0]}/{key[1]}"
         ) as span:
             outcome, delay = self._schedule_gang(client, gang, pod, span)
             span.set("outcome", outcome)
@@ -227,24 +252,29 @@ class SchedulerReconciler(Reconciler):
         # gang's ask must fit the Profile's hard TPU limit.
         needed = sum(pod_tpu_chips(p) for p in unbound)
         if needed:
-            hard = self._quota_hard(client, gang.namespace)
-            if hard is not None:
-                bound_ns = self.ledger.used_in_namespace(gang.namespace)
-                if bound_ns + needed > hard:
-                    msg = (
-                        f"namespace TPU quota exceeded: {bound_ns} chips bound + "
-                        f"{needed} requested > {hard} allowed"
-                    )
-                    self._mark_unschedulable(client, unbound, msg)
-                    self._note_pending(key, unbound[0])
-                    delay = self.backoff.next_delay(key)
-                    self._record(
-                        client, gang, unbound, "quota_denied", "quota", msg, delay,
-                        quota={"boundChips": bound_ns, "requestedChips": needed,
-                               "hardLimit": hard, "admitted": False},
-                        failed_event=True,
-                    )
-                    return "quota_denied", delay
+            with TRACER.span("schedule.quota", namespace=str(gang.namespace),
+                             chips=needed) as qspan:
+                hard = self._quota_hard(client, gang.namespace)
+                denied = False
+                if hard is not None:
+                    bound_ns = self.ledger.used_in_namespace(gang.namespace)
+                    denied = bound_ns + needed > hard
+                qspan.set("admitted", not denied)
+            if denied:
+                msg = (
+                    f"namespace TPU quota exceeded: {bound_ns} chips bound + "
+                    f"{needed} requested > {hard} allowed"
+                )
+                self._mark_unschedulable(client, unbound, msg)
+                self._note_pending(key, unbound[0])
+                delay = self.backoff.next_delay(key)
+                self._record(
+                    client, gang, unbound, "quota_denied", "quota", msg, delay,
+                    quota={"boundChips": bound_ns, "requestedChips": needed,
+                           "hardLimit": hard, "admitted": False},
+                    failed_event=True,
+                )
+                return "quota_denied", delay
 
         requirements = [
             (pod_tpu_chips(p), (p.get("spec") or {}).get("nodeSelector") or {})
@@ -252,7 +282,8 @@ class SchedulerReconciler(Reconciler):
         ]
         placement = self.ledger.place_and_reserve(key, requirements, self.reservation_ttl)
         if placement is None:
-            preemption = self._try_preempt(client, gang, requirements, span)
+            with TRACER.span("schedule.preempt", gang=f"{key[0]}/{key[1]}"):
+                preemption = self._try_preempt(client, gang, requirements, span)
             if preemption.get("victim"):
                 # Victim evicted; its chips free asynchronously while our
                 # reservation (taken before the eviction) holds the claim.
@@ -341,32 +372,44 @@ class SchedulerReconciler(Reconciler):
         members: Optional[List[Dict[str, Any]]] = None,
     ) -> Tuple[str, float]:
         gang = gang_of(unbound[0])
-        for target, node in zip(unbound, placement):
-            ns, name = apimeta.namespace_of(target), apimeta.name_of(target)
-            fresh = client.get_opt("v1", "Pod", name, ns)
-            if fresh is None or (fresh.get("spec") or {}).get("nodeName"):
-                continue
-            fresh["spec"]["nodeName"] = node
-            try:
-                bound = client.update(fresh)
-            except Conflict:
-                # Raced a concurrent write; the reservation keeps the gang's
-                # chips held while we retry the remainder next cycle.
-                self._record(
-                    client, gang, [], "bind_conflict", "conflict",
-                    f"optimistic-concurrency conflict binding {ns}/{name}; retrying",
-                    self.backoff.base,
+        with TRACER.span("schedule.bind", gang=f"{key[0]}/{key[1]}",
+                         pods=len(unbound)) as bspan:
+            for target, node in zip(unbound, placement):
+                ns, name = apimeta.namespace_of(target), apimeta.name_of(target)
+                fresh = client.get_opt("v1", "Pod", name, ns)
+                if fresh is None or (fresh.get("spec") or {}).get("nodeName"):
+                    continue
+                fresh["spec"]["nodeName"] = node
+                # The bind traceparent rides the same write that sets
+                # nodeName: podlet/engine/training spans started off the
+                # bound pod join the gang's trace through this annotation.
+                md = fresh.setdefault("metadata", {})
+                ann = dict(md.get("annotations") or {})
+                ann[BIND_TRACEPARENT_ANNOTATION] = format_traceparent(bspan)
+                md["annotations"] = ann
+                try:
+                    bound = client.update(fresh)
+                except Conflict:
+                    # Raced a concurrent write; the reservation keeps the
+                    # gang's chips held while we retry the remainder next
+                    # cycle.
+                    self._record(
+                        client, gang, [], "bind_conflict", "conflict",
+                        f"optimistic-concurrency conflict binding {ns}/{name}; retrying",
+                        self.backoff.base,
+                    )
+                    return "bind_conflict", self.backoff.base
+                self.ledger.record_bind(bound)
+                client.emit_event(
+                    bound, "Scheduled",
+                    f"Successfully assigned {ns}/{name} to {node}",
+                    component=COMPONENT,
                 )
-                return "bind_conflict", self.backoff.base
-            self.ledger.record_bind(bound)
-            client.emit_event(
-                bound, "Scheduled",
-                f"Successfully assigned {ns}/{name} to {node}",
-                component=COMPONENT,
-            )
         self.ledger.release(key)
+        with self._lock:
+            root = self._gang_spans.get(key)
+        self._observe_bind_latency(members or unbound, root)
         self._gang_done(key, bound=True)
-        self._observe_bind_latency(members or unbound)
         span.set("nodes", ",".join(sorted(set(placement))))
         self._record(
             client, gang, [], "bound", "scheduled",
@@ -719,6 +762,12 @@ class SchedulerReconciler(Reconciler):
         with self._lock:
             self._pending.pop(key, None)
             first = self._first_attempt.pop(key, None)
+            root = self._gang_spans.pop(key, None)
+        if root is not None and not root.end_ns:
+            # end_ns set means the abandoned-span sweep beat us to it (an
+            # hour-pending gang) — don't record the root twice
+            root.set("gang.bound", bound)
+            TRACER.end_span(root)
         if bound and first is not None:
             SCHED.histogram("time_to_bind_seconds").observe(time.monotonic() - first)
 
@@ -726,13 +775,18 @@ class SchedulerReconciler(Reconciler):
     #: 1 s resolution, so the sub-second buckets catch same-second binds
     BIND_LATENCY_BUCKETS = (0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
-    def _observe_bind_latency(self, members: List[Dict[str, Any]]) -> None:
+    def _observe_bind_latency(
+        self, members: List[Dict[str, Any]], root: Optional[Span] = None
+    ) -> None:
         """End-to-end bind SLI: earliest gang member creationTimestamp (the
         submit, stamped by the apiserver in wall time) → last pod bound
         (now). Unlike time_to_bind_seconds — first *attempt* to bind — this
         includes apiserver/informer/workqueue time before the scheduler ever
         saw the gang, which is exactly the control-plane latency the scale
-        harness loads."""
+        harness loads. The gang root span gets the same anchors as
+        attributes (and the histogram an exemplar with its trace id) so the
+        critical-path analyzer can reconstruct this exact observation from
+        the assembled trace."""
         submitted: Optional[float] = None
         for p in members:
             stamp = (p.get("metadata") or {}).get("creationTimestamp")
@@ -745,9 +799,13 @@ class SchedulerReconciler(Reconciler):
             submitted = ts if submitted is None else min(submitted, ts)
         if submitted is None:
             return
+        latency = max(0.0, time.time() - submitted)
+        if root is not None:
+            root.set("gang.submitted_unix", submitted)
+            root.set("gang.bind_latency_s", round(latency, 6))
         SCHED.histogram(
             "bind_latency_seconds", buckets=self.BIND_LATENCY_BUCKETS
-        ).observe(max(0.0, time.time() - submitted))
+        ).observe(latency, trace_id=root.trace_id if root else None)
 
     def _collect_cycle_rate(self) -> None:
         """Scrape-time collector: scheduling cycles completed per second
@@ -771,7 +829,11 @@ class SchedulerReconciler(Reconciler):
             with self._lock:
                 self._pending.pop(gkey, None)
                 self._first_attempt.pop(gkey, None)
+                root = self._gang_spans.pop(gkey, None)
                 SCHED.gauge("pending_gangs").set(len(self._pending))
+            if root is not None and not root.end_ns:
+                root.set("gang.bound", False)
+                TRACER.end_span(root)
 
     def _cancel_drains_for(self, key: GangKey) -> None:
         """Preemptor bound or vanished: forget drains it requested so the

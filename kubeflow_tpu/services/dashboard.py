@@ -162,9 +162,31 @@ class TpuMetricsService:
             "targets": sorted(targets, key=lambda t: t["instance"]),
             "serving": serving,
             "scheduler": scheduler,
+            "tracing": self._tracing_overview(),
             "alerts": alerts,
             "series": self.tsdb.stats(),
         }
+
+    def _tracing_overview(self) -> Optional[Dict[str, Any]]:
+        """Slowest gang binds from the plane's TraceCollector, each carrying
+        its critical-path decomposition — the answer to 'WHERE did that p99
+        bind latency go' next to the histogram that says it exists.  None
+        when the plane federates metrics but not traces."""
+        collector = getattr(self.monitoring, "traces", None)
+        if collector is None:
+            return None
+        from ..monitoring.traces import critical_path
+
+        slowest = []
+        for row in collector.slowest_binds(5):
+            assembled = collector.trace(row["traceId"])
+            if assembled is not None:
+                path = critical_path(assembled)
+                if path is not None:
+                    row = dict(row, criticalPath=path)
+            slowest.append(row)
+        return {"slowestBinds": slowest,
+                "tracesFederated": len(collector.trace_ids())}
 
 
 def make_dashboard_app(

@@ -757,7 +757,10 @@ class ContinuousBatcher:
             "serving.request", traceparent=traceparent,
             **{"prompt_tokens": int(len(prompt)),
                "max_new_tokens": int(max_new_tokens),
-               "priority": priority})
+               "priority": priority,
+               # federated queries isolate one fleet replica's decode path
+               # by this label (the /debug/traces?service= counterpart)
+               "replica": self.engine_id})
         req.submit_at = time.perf_counter()
         _ev(req, "enqueued")
         METRICS.counter("serving_tokens_in_total").inc(len(prompt))

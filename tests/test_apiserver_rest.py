@@ -27,6 +27,7 @@ from kubeflow_tpu.controllers.builtin import (
 )
 from kubeflow_tpu.controllers.notebook import NotebookReconciler
 from kubeflow_tpu.runtime.manager import Manager
+from kubeflow_tpu.runtime.tracing import TRACEPARENT_ANNOTATION
 from kubeflow_tpu.webhook.__main__ import make_webhook_app
 
 PODS = REGISTRY.for_kind("v1", "Pod")
@@ -82,7 +83,10 @@ class TestRestCrud:
         store, remote, base = rest
         remote.create(mkpod("m1"))
         out = remote.patch(PODS, "m1", {"metadata": {"annotations": {"k": "v"}}}, "default")
-        assert out["metadata"]["annotations"] == {"k": "v"}
+        assert out["metadata"]["annotations"]["k"] == "v"
+        # HTTP-created objects also carry the creating request's trace
+        # context (stamped by the apiserver create path)
+        assert TRACEPARENT_ANNOTATION in out["metadata"]["annotations"]
         out = remote.patch(PODS, "m1", {"metadata": {"annotations": {"k": None}}}, "default")
         assert "k" not in (out["metadata"].get("annotations") or {})
 
@@ -452,9 +456,9 @@ class TestVersionConversion:
         hub = REGISTRY.for_kind("kubeflow.org/v1beta1", "Notebook")
         stored = store.get(hub, "pv", "default")
         assert stored["apiVersion"] == "kubeflow.org/v1beta1"
-        assert stored["metadata"]["annotations"] == {"a": "1"}
+        assert stored["metadata"]["annotations"]["a"] == "1"
         # still reachable/patachable again at the spoke (storage key intact)
-        assert remote.get(v1, "pv", "default")["metadata"]["annotations"] == {"a": "1"}
+        assert remote.get(v1, "pv", "default")["metadata"]["annotations"]["a"] == "1"
 
     def test_registered_mapper_runs_on_spoke_patch_fragment(self, rest):
         """A real (partial-tolerant) field mapper must apply to merge-patch

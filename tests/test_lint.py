@@ -251,6 +251,44 @@ def test_metric_names_are_cataloged():
     )
 
 
+_SPAN_METHODS = {"span", "start_span", "emit_span"}
+
+
+def _registered_span_names():
+    """(name, path, lineno) for every constant-name span opened under
+    kubeflow_tpu/. Dynamic names (StepClock's per-step emits, f-strings)
+    have no constant to check and are skipped, same policy as metrics."""
+    pkg = ROOT / "kubeflow_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPAN_METHODS
+                    and node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                yield node.args[0].value, path, node.lineno
+
+
+def test_span_names_are_cataloged():
+    """docs/OBSERVABILITY.md is the catalog of record for span names too:
+    federated traces are only navigable if the names that appear in an
+    assembled gang-bind journey mean something to the reader."""
+    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
+    import re
+
+    documented = set(re.findall(r"`([A-Za-z0-9_.]+)`", catalog))
+    missing = []
+    for name, path, lineno in _registered_span_names():
+        if name not in documented:
+            missing.append(
+                f"{path.relative_to(ROOT)}:{lineno}: span {name!r} "
+                "not documented in docs/OBSERVABILITY.md")
+    assert not missing, (
+        "add these span names to the docs/OBSERVABILITY.md catalog "
+        "(name, emitting process, parent, meaning):\n" + "\n".join(missing)
+    )
+
+
 def test_no_f32_matmuls_outside_sanctioned_islands():
     """Model forward passes keep matmul/einsum inputs bf16; fp32 appears
     only in the allowlisted islands above. A new f32 contraction must either

@@ -1,0 +1,445 @@
+"""Trace federation (ISSUE 14): cross-process propagation of the gang
+lifecycle trace, the TraceCollector's assembly + tail sampling, and
+critical-path attribution of `scheduler_bind_latency_seconds`."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import annotations_of, new_object
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.remote import RemoteStore
+from kubeflow_tpu.apiserver.server import make_apiserver_app
+from kubeflow_tpu.apiserver.store import Store
+from kubeflow_tpu.controllers.builtin import PodletReconciler, make_tpu_node
+from kubeflow_tpu.monitoring.traces import (
+    MAX_FEDERATED_SPANS,
+    TraceCollector,
+    critical_path,
+    traces_url,
+)
+from kubeflow_tpu.runtime.informer import SharedInformer
+from kubeflow_tpu.runtime.manager import Manager, Reconciler, Request, Result, _WorkQueue
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.runtime.obs import mount_observability, otlp_traces
+from kubeflow_tpu.runtime.tracing import (
+    BIND_TRACEPARENT_ANNOTATION,
+    TRACEPARENT_ANNOTATION,
+    TRACER,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from kubeflow_tpu.scheduler import POD_GROUP_LABEL, POD_GROUP_SIZE_ANNOTATION, SchedulerReconciler
+from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+from kubeflow_tpu.web.http import App
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    TRACER.reset()
+    yield
+    TRACER.reset()
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02, desc="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    assert predicate(), f"timed out waiting for {desc}"
+
+
+def mkpod(name, ns="default", chips=0, gang=None, size=1, annotations=None):
+    spec = {"containers": [{"name": "c"}]}
+    if chips:
+        spec["containers"][0]["resources"] = {"limits": {RESOURCE_TPU: str(chips)}}
+    labels = {POD_GROUP_LABEL: gang} if gang else {}
+    ann = dict(annotations or {})
+    if gang:
+        ann[POD_GROUP_SIZE_ANNOTATION] = str(size)
+    return new_object("v1", "Pod", name, ns, labels=labels,
+                      annotations=ann, spec=spec)
+
+
+# -- propagation: the client → apiserver → object hop -------------------------
+
+
+class TestPropagation:
+    def test_remote_store_preserves_trace_id_over_real_http(self):
+        """A span open at the RemoteStore call site must surface in the
+        apiserver with the SAME trace id (header injection by remote.py,
+        continuation by the HTTP dispatcher) and be stamped onto the
+        created object as the creation traceparent annotation."""
+        store = Store()
+        server = make_apiserver_app(store).serve(0)
+        remote = RemoteStore(f"http://127.0.0.1:{server.port}")
+        try:
+            with TRACER.span("client-call") as client_span:
+                remote.create(new_object("v1", "Pod", "traced", "default"))
+            stored = Client(store).get("v1", "Pod", "traced", "default")
+            header = annotations_of(stored).get(TRACEPARENT_ANNOTATION)
+            assert header, "apiserver create must stamp the creation traceparent"
+            trace_id, _ = parse_traceparent(header)
+            assert trace_id == client_span.trace_id
+            # the apiserver-side spans joined the same trace
+            server_spans = [s for s in TRACER.finished_spans(trace_id=trace_id)
+                            if s.name == "apiserver.create"]
+            assert server_spans, "apiserver.create span missing from the trace"
+        finally:
+            server.close()
+
+    def test_create_without_active_span_stays_unannotated(self):
+        store = Store()
+        client = Client(store)
+        client.create(new_object("v1", "Pod", "plain", "default"))
+        ann = annotations_of(client.get("v1", "Pod", "plain", "default"))
+        assert TRACEPARENT_ANNOTATION not in ann
+
+    def test_workqueue_carries_last_enqueuer_trace(self):
+        q = _WorkQueue("test")
+        req = Request("default", "x")
+        tp1 = "00-" + "a" * 32 + "-" + "1" * 16 + "-01"
+        tp2 = "00-" + "b" * 32 + "-" + "2" * 16 + "-01"
+        q.add(req, traceparent=tp1)
+        q.add(req, traceparent=tp2)  # dedup keeps one item; last trace wins
+        popped = q.get(timeout=1.0)
+        assert popped == req
+        assert q.trace_of(req) == tp2
+        assert q.trace_of(req) is None  # consumed exactly once
+        q.task_done()
+
+    def test_reconcile_span_parents_to_creation_annotation(self):
+        seen = []
+
+        class Spy(Reconciler):
+            FOR = ("v1", "Pod")
+
+            def reconcile(self, client, req):
+                seen.append(req.name)
+                return Result()
+
+        tp = "00-" + "c" * 32 + "-" + "3" * 16 + "-01"
+        mgr = Manager()
+        mgr.add(Spy())
+        mgr.start()
+        try:
+            mgr.client.create(mkpod("evt", annotations={TRACEPARENT_ANNOTATION: tp}))
+            wait_for(lambda: "evt" in seen, desc="reconcile")
+            wait_for(lambda: any(
+                s.trace_id == "c" * 32
+                for s in TRACER.finished_spans(name="reconcile")),
+                desc="reconcile span joins creation trace")
+        finally:
+            mgr.stop()
+
+    def test_informer_relist_runs_detached(self):
+        """A 410 relist re-syncs the world for everyone: its paginated
+        LISTs must not inherit a trace that happens to be current on the
+        pump thread (e.g. leaked by a buggy handler)."""
+        from kubeflow_tpu.runtime import tracing as tracing_mod
+
+        relist_contexts = []
+
+        class SpyClient(Client):
+            def list_paged(self, *args, **kwargs):
+                relist_contexts.append(TRACER.current_span())
+                return super().list_paged(*args, **kwargs)
+
+        from kubeflow_tpu.apiserver.store import DictBackend
+
+        # journal-less backend: a compacted ring window has no fallback, so
+        # the resume raises Expired and the pump takes the relist path
+        store = Store(backend=DictBackend())
+        client = SpyClient(store)
+        client.create(new_object("v1", "Pod", "p0", "ns1"))
+        inf = SharedInformer(client, "v1", "Pod").start()
+        leaked = []
+
+        def leak(_type, _obj):
+            # simulate a handler that opens a span and never restores the
+            # thread-local — the worst case detached() defends against
+            if not leaked:
+                leaked.append(TRACER.start_span("leaky-handler"))
+                tracing_mod._local.span = leaked[0]
+
+        inf.add_event_handler(leak)
+        try:
+            assert inf.wait_synced()
+            client.create(new_object("v1", "Pod", "p1", "ns1"))  # fire the handler
+            wait_for(lambda: leaked, desc="handler leak")
+            # compact the watch window out from under the resume RV, then
+            # kill the stream: reconnect → Expired → detached relist
+            store._wc_trimmed_rv = store.backend.current_rv() + 10_000
+            inf._watcher.close()
+            wait_for(lambda: relist_contexts, desc="relist")
+            assert all(ctx is None for ctx in relist_contexts)
+        finally:
+            inf.stop()
+            tracing_mod._local.span = None
+
+
+# -- open-span hygiene (satellite: bounded cross-thread span map) -------------
+
+
+class TestOpenSpanHygiene:
+    def test_ttl_sweep_abandons_and_counts(self):
+        t = Tracer("t")
+        before = METRICS.value("tracing_spans_abandoned_total")
+        s = t.start_span("orphan")
+        s.start_ns -= int(3600 * 1e9)  # pretend it started an hour ago
+        assert t.sweep_abandoned(ttl_s=600.0) == 1
+        assert t.open_spans() == []
+        (rec,) = t.finished_spans(name="orphan")
+        assert rec.status == "ERROR" and "abandoned" in rec.status_message
+        assert METRICS.value("tracing_spans_abandoned_total") == before + 1
+
+    def test_ended_spans_leave_the_open_map(self):
+        t = Tracer("t")
+        s = t.start_span("brief")
+        assert [x.span_id for x in t.open_spans()] == [s.span_id]
+        t.end_span(s)
+        assert t.open_spans() == []
+        assert t.sweep_abandoned(ttl_s=0.0) == 0  # nothing left to abandon
+
+    def test_hard_cap_evicts_oldest_open_span(self):
+        t = Tracer("t", capacity=4)
+        spans = [t.start_span(f"s{i}") for i in range(6)]
+        assert len(t.open_spans()) <= 4
+        evicted = [s for s in t.finished_spans() if s.status == "ERROR"]
+        assert evicted and all("evicted" in s.status_message for s in evicted)
+        assert spans[0].span_id in {s.span_id for s in evicted}
+
+
+# -- the gang lifecycle trace end to end (in-process platform) ----------------
+
+
+@pytest.fixture()
+def cluster():
+    mgr = Manager()
+    mgr.add(SchedulerReconciler(backoff_base=0.02, backoff_cap=0.5))
+    mgr.add(PodletReconciler())
+    mgr.client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+    mgr.client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+    mgr.start()
+    try:
+        yield mgr
+    finally:
+        mgr.stop()
+
+
+def _phase(client, name):
+    return (client.get("v1", "Pod", name, "default").get("status") or {}).get("phase")
+
+
+class TestGangLifecycleTrace:
+    def test_injected_traceparent_survives_to_bind_and_pod_start(self, cluster):
+        """The tentpole journey: a caller-minted trace id rides the creation
+        annotation into the scheduler's gang.lifecycle root, out through the
+        bind annotation, and into the podlet's pod.start span — one trace
+        across every hop, with the critical path reconstructing the bind
+        latency the scheduler observed."""
+        trace_id = "f" * 32
+        tp = f"00-{trace_id}-{'9' * 16}-01"
+        for i in range(2):
+            cluster.client.create(mkpod(
+                f"fed-{i}", chips=4, gang="fed", size=2,
+                annotations={TRACEPARENT_ANNOTATION: tp}))
+        wait_for(lambda: all(_phase(cluster.client, f"fed-{i}") == "Running"
+                             for i in range(2)), desc="gang Running")
+        wait_for(lambda: TRACER.finished_spans(name="gang.lifecycle",
+                                               trace_id=trace_id),
+                 desc="lifecycle root recorded")
+
+        (root,) = TRACER.finished_spans(name="gang.lifecycle", trace_id=trace_id)
+        assert root.attributes["gang.bound"] is True
+        assert root.attributes["gang"] == "default/fed"
+        assert root.attributes["gang.bind_latency_s"] >= 0.0
+        assert "gang.submitted_unix" in root.attributes
+
+        # the bind write stamped its span onto the bound pods
+        for i in range(2):
+            pod = cluster.client.get("v1", "Pod", f"fed-{i}", "default")
+            bind_tp = annotations_of(pod).get(BIND_TRACEPARENT_ANNOTATION)
+            assert bind_tp and parse_traceparent(bind_tp)[0] == trace_id
+
+        # scheduler children + podlet joined the same trace
+        names = {s.name for s in TRACER.finished_spans(trace_id=trace_id)}
+        assert {"schedule", "schedule.bind", "pod.start"} <= names
+
+        # exemplars: the SLI histograms link back to this trace
+        rendered = METRICS.render()
+        assert f'scheduler_bind_latency_seconds_bucket' in rendered
+        assert f'trace_id="{trace_id}"' in rendered
+
+        # federate this process's buffer and attribute the critical path
+        collector = TraceCollector()
+        collector.ingest(otlp_traces(TRACER, limit=4096))
+        assembled = collector.trace(trace_id)
+        assert assembled is not None
+        path = critical_path(assembled)
+        assert path is not None
+        measured = path["measuredBindLatencySeconds"]
+        assert measured == root.attributes["gang.bind_latency_s"]
+        assert {s["name"] for s in path["segments"]} == {"queue", "cycle", "bind"}
+        # segments must reconstruct the SLI within 10% (absolute floor:
+        # sub-ms binds bottom out on clock granularity plus thread-wakeup
+        # jitter between spans on a loaded box)
+        assert path["reconstructionError"] <= max(0.1 * measured, 0.05)
+        assert path["postBindPodStart"]["pods"] == 2
+        assert collector.slowest_binds(1)[0]["traceId"] == trace_id
+
+    def test_queue_duration_exemplar_present(self, cluster):
+        tp = f"00-{'d' * 32}-{'4' * 16}-01"
+        cluster.client.create(mkpod("exq", annotations={TRACEPARENT_ANNOTATION: tp}))
+        wait_for(lambda: _phase(cluster.client, "exq") is not None or True)
+        wait_for(lambda: 'trace_id="' + "d" * 32 + '"' in METRICS.render(),
+                 desc="queue-duration exemplar")
+        rendered = METRICS.render()
+        assert "workqueue_queue_duration_seconds_bucket" in rendered
+
+
+# -- the collector: assembly, filters, tail sampling --------------------------
+
+
+def _synthetic_doc(service, instance, spans):
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name", "value": {"stringValue": service}},
+            {"key": "service.instance.id", "value": {"stringValue": instance}},
+        ]},
+        "scopeSpans": [{"scope": {"name": "test"}, "spans": spans}],
+    }]}
+
+
+def _span(trace_id, span_id, name="op", status="OK", attrs=None,
+          start_ns=1_000, end_ns=2_000):
+    return {
+        "traceId": trace_id, "spanId": span_id, "name": name,
+        "startTimeUnixNano": start_ns, "endTimeUnixNano": end_ns,
+        "status": {"code": status, "message": ""},
+        "attributes": {"service.name": "svc", **(attrs or {})},
+    }
+
+
+class TestTraceCollector:
+    def test_assembles_across_processes_and_dedups(self):
+        t_client = Tracer(service="client", instance="h1:1")
+        t_sched = Tracer(service="scheduler", instance="h2:2")
+        with t_client.span("gang.submit") as sub:
+            header = format_traceparent(sub)
+        with t_sched.span("gang.lifecycle", traceparent=header):
+            pass
+        collector = TraceCollector()
+        collector.ingest(otlp_traces(t_client))
+        collector.ingest(otlp_traces(t_sched))
+        first = collector.trace(sub.trace_id)["spanCount"]
+        collector.ingest(otlp_traces(t_sched))  # repeated pull: idempotent
+        assembled = collector.trace(sub.trace_id)
+        assert assembled["spanCount"] == first == 2
+        assert assembled["services"] == ["client", "scheduler"]
+        starts = [s["startTimeUnixNano"] for s in assembled["spans"]]
+        assert starts == sorted(starts)
+        assert {s["instance"] for s in assembled["spans"]} == {"h1:1", "h2:2"}
+
+    def test_service_filter_on_debug_traces(self):
+        t = Tracer(service="ops", instance="h:1")
+        with t.span("a", **{"service.name": "engine-0"}):
+            pass
+        with t.span("b"):
+            pass
+        only = otlp_traces(t, service="engine-0")
+        names = [s["name"] for s in only["resourceSpans"][0]["scopeSpans"][0]["spans"]]
+        assert names == ["a"]
+        app = mount_observability(App("ops"), tracer=t)
+        resp = app.call("GET", "/debug/traces?service=engine-0")
+        assert resp.status == 200
+        spans = resp.body["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert [s["name"] for s in spans] == ["a"]
+
+    def test_trace_route_and_slowest_binds_source(self):
+        collector = TraceCollector()
+        collector.ingest(_synthetic_doc("scheduler", "h:1", [
+            _span("1" * 32, "a" * 16, name="gang.lifecycle",
+                  attrs={"gang": "default/g", "gang.bind_latency_s": 2.5,
+                         "gang.bound": True}),
+        ]))
+        app = mount_observability(App("monitor"))
+        collector.mount(app)
+        ok = app.call("GET", f"/debug/trace/{'1' * 32}")
+        assert ok.status == 200 and ok.body["spanCount"] == 1
+        assert app.call("GET", f"/debug/trace/{'0' * 32}").status == 404
+        binds = app.call("GET", "/debug/slowest-binds?n=5")
+        assert binds.status == 200
+        assert binds.body["binds"][0]["bindLatencySeconds"] == 2.5
+
+    def test_traces_url_rewrite(self):
+        assert traces_url("http://10.0.0.1:8080/metrics") == \
+            "http://10.0.0.1:8080/debug/traces?limit=4096"
+
+    def test_tail_sampling_keeps_errors_and_slowest_decile(self):
+        """Under a 2× burst over the span budget, every error trace and the
+        slowest decile of gang binds survive; boring traces are shed
+        oldest-first and the drop is counted."""
+        budget = 100
+        collector = TraceCollector(max_spans=budget)
+        error_ids, bind_ids = [], []
+        spans = []
+        for i in range(2 * budget):
+            tid = f"{i:032x}"
+            if i % 20 == 0:  # 10 error traces
+                error_ids.append(tid)
+                spans.append(_span(tid, f"{i:016x}", status="ERROR"))
+            elif i % 20 == 1:  # 10 gang binds, latency ramps with i
+                bind_ids.append((tid, float(i)))
+                spans.append(_span(
+                    tid, f"{i:016x}", name="gang.lifecycle",
+                    attrs={"gang.bind_latency_s": float(i), "gang": "g"}))
+            else:
+                spans.append(_span(tid, f"{i:016x}"))
+        before = METRICS.value("tracing_collector_traces_dropped_total",
+                               protected="false")
+        collector.ingest(_synthetic_doc("s", "h:1", spans))
+        dropped = collector._enforce_bound()
+        kept = set(collector.trace_ids())
+        assert len(kept) <= budget
+        assert dropped == 2 * budget - len(kept)
+        for tid in error_ids:
+            assert tid in kept, "tail sampling must keep every error trace"
+        slowest_decile = [tid for tid, _lat in
+                          sorted(bind_ids, key=lambda p: p[1])[-1:]]
+        for tid in slowest_decile:
+            assert tid in kept, "tail sampling must keep the slowest binds"
+        assert METRICS.value("tracing_collector_traces_dropped_total",
+                             protected="false") >= before + dropped
+
+    def test_bound_is_the_invariant_over_protection(self):
+        """If protected traces ALONE exceed the budget, they drop too —
+        a bounded store is the contract, sampling only the policy."""
+        collector = TraceCollector(max_spans=3)
+        spans = [_span(f"{i:032x}", f"{i:016x}", status="ERROR")
+                 for i in range(8)]
+        collector.ingest(_synthetic_doc("s", "h:1", spans))
+        collector._enforce_bound()
+        assert len(collector.trace_ids()) <= 3
+
+    def test_default_budget_is_generous(self):
+        assert TraceCollector().max_spans == MAX_FEDERATED_SPANS >= 10_000
+
+
+class TestCriticalPathEdgeCases:
+    def test_no_lifecycle_root_returns_none(self):
+        assert critical_path({"spans": [_span("1" * 32, "a" * 16)]}) is None
+
+    def test_missing_anchor_returns_none(self):
+        doc = {"spans": [_span("1" * 32, "a" * 16, name="gang.lifecycle")]}
+        assert critical_path(doc) is None
+
+    def test_unbound_gang_reports_queue_only(self):
+        span = _span("1" * 32, "a" * 16, name="gang.lifecycle",
+                     attrs={"gang.submitted_unix": 0.0},
+                     start_ns=int(2e9), end_ns=int(3e9))
+        path = critical_path({"spans": [span]})
+        assert [s["name"] for s in path["segments"]] == ["queue"]
+        assert path["segments"][0]["seconds"] == pytest.approx(2.0)
