@@ -252,6 +252,22 @@ def paged_kv_e2e() -> Dict:
     return b.build()
 
 
+def platlint() -> Dict:
+    """The lock-discipline job: tools/platlint (guarded-field inference,
+    lock-order cycle detection, blocking-under-lock) over the whole
+    package against the checked-in baseline — new findings and stale
+    baseline entries both fail (docs/STATIC_ANALYSIS.md), plus the
+    analyzer's own fixture suite. Pure stdlib-ast, sub-second on the
+    full tree, so it runs as a presubmit on every plane's changes."""
+    b = WorkflowBuilder("platlint")
+    b.run("platlint-gate",
+          ["python", "-m", "tools.platlint", "kubeflow_tpu",
+           "--baseline", "tools/platlint/baseline.json"])
+    b.pytest("platlint-unit", "tests/test_platlint.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 def bench_regression() -> Dict:
     """The bench-gate job: tools/bench_gate.py compares the newest committed
     bench round against the best earlier round per metric and fails on any
@@ -333,6 +349,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "serving-overload-e2e": serving_overload_e2e,
     "paged-kv-e2e": paged_kv_e2e,
     "elastic-e2e": elastic_e2e,
+    "platlint": platlint,
     "bench-regression": bench_regression,
     "attribution-e2e": attribution_e2e,
     "monitoring-e2e": monitoring_e2e,

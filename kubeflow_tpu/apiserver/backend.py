@@ -137,11 +137,17 @@ def _build_native() -> str:
         if have_so and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
             return _SO_PATH
         try:
+            # Bounded: a wedged compiler (NFS stall, OOM-thrashing cc1plus)
+            # would otherwise park every thread needing the native backend
+            # behind _build_lock forever.
             proc = subprocess.run(
-                ["make", "-C", _NATIVE_DIR], capture_output=True, text=True
+                ["make", "-C", _NATIVE_DIR], capture_output=True, text=True,
+                timeout=300,
             )
         except FileNotFoundError as e:  # no make on PATH
             raise NativeUnavailable(f"native build toolchain missing: {e}") from None
+        except subprocess.TimeoutExpired as e:
+            raise NativeUnavailable(f"native core build timed out: {e}") from None
         if proc.returncode != 0:
             raise NativeUnavailable(
                 f"native core build failed:\n{proc.stdout}\n{proc.stderr}"

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import signal
 import threading
 from typing import Optional
@@ -26,6 +27,59 @@ log = logging.getLogger("kubeflow_tpu.bootstrap")
 
 def apiserver_url() -> str:
     return os.environ.get("APISERVER_URL", DEFAULT_APISERVER)
+
+
+_NUM_RE = re.compile(r"\d+")
+
+
+def _thread_label(name: str) -> str:
+    """Collapse per-instance digits (``worker-3`` → ``worker-N``) so the
+    thread label stays bounded-cardinality."""
+    return _NUM_RE.sub("N", name or "unnamed")
+
+
+def install_thread_excepthook() -> None:
+    """Make silently-dying daemon threads observable.
+
+    Every role runs its real work on daemon threads (manager loops,
+    informers, elector, batcher); by default an uncaught exception there
+    prints to stderr and the process keeps serving /healthz with its
+    brain gone — the one failure mode static analysis (platlint) cannot
+    see. Hook ``threading.excepthook`` to log the crash and increment
+    ``runtime_thread_crashes_total{thread}`` so it alerts instead.
+
+    Idempotent; chains to the previously-installed hook.
+    """
+    if getattr(threading.excepthook, "_kubeflow_tpu_hook", False):
+        return
+    from .metrics import METRICS
+
+    prev = threading.excepthook
+
+    def hook(args, /):
+        if args.exc_type is SystemExit:
+            return  # normal thread teardown, not a crash
+        name = _thread_label(getattr(args.thread, "name", "") or "")
+        try:
+            METRICS.counter("runtime_thread_crashes_total", thread=name).inc()
+        except Exception:  # noqa: BLE001 — the hook must never raise
+            pass
+        log.error(
+            "thread %r crashed",
+            getattr(args.thread, "name", "?"),
+            exc_info=(args.exc_type, args.exc_value, args.exc_traceback),
+        )
+        # chain to a custom predecessor, but not the stock stderr printer —
+        # the log.error above already carries the traceback
+        if prev not in (None, threading.__excepthook__) and not getattr(
+                prev, "_kubeflow_tpu_hook", False):
+            try:
+                prev(args)
+            except Exception:  # noqa: BLE001 — a broken chained hook stays contained
+                pass
+
+    hook._kubeflow_tpu_hook = True
+    threading.excepthook = hook
 
 
 def connect(url: Optional[str] = None, timeout: float = 60.0) -> RemoteStore:
@@ -95,6 +149,7 @@ def run_webapp(name: str, factory, url: Optional[str] = None) -> None:
     from ..apiserver.client import Client
 
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    install_thread_excepthook()
     store = connect(url)
     app = factory(Client(store), auth_from_env())
     server = ops = None
@@ -136,6 +191,7 @@ def run_role(name: str, *reconcilers: Reconciler, url: Optional[str] = None) -> 
     from .leader import LeaderElector
 
     logging.basicConfig(level=os.environ.get("LOG_LEVEL", "INFO"))
+    install_thread_excepthook()
     store = connect(url)
     mgr = Manager(store=store)
     for rec in reconcilers:

@@ -252,8 +252,11 @@ class SharedInformer:
             # Resume from the last seen RV when we have one: reconnects replay
             # only the missed window (watch cache / journal) instead of
             # re-listing the world. A compacted window (Expired, 410) falls
-            # back to the paginated relist.
-            resume_rv = self._last_rv
+            # back to the paginated relist. Read under _rv_cond — _note_rv
+            # publishes under it, and a stale resume point replays (or with
+            # a torn read, skips) part of the window.
+            with self._rv_cond:
+                resume_rv = self._last_rv
             # a never-synced mirror may be mid-initial-list: resume could
             # permanently miss the unapplied remainder — relist instead
             initial = resume_rv <= 0 or not self._synced.is_set()

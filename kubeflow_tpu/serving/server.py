@@ -316,9 +316,13 @@ class GenerativeModel(ServedModel):
             return self._engine
 
     def close(self) -> None:
-        if self._engine is not None:
-            self._engine.close()
-            self._engine = None
+        # Swap under the lock (close() racing _continuous_engine() must not
+        # orphan a freshly-built engine), shut down outside it: engine close
+        # joins worker threads and must not stall new-engine construction.
+        with self._engine_lock:
+            engine, self._engine = self._engine, None
+        if engine is not None:
+            engine.close()
 
     def predict(self, instances: Sequence[Any],
                 deadline: Optional[float] = None,

@@ -1,29 +1,28 @@
-"""Source hygiene gate — the reference's CI lint tier (testing/
+"""Source hygiene + policy gates — the reference's CI lint tier (testing/
 test_flake8.py, test_jsonnet.py) re-built on stdlib ``ast`` since the image
 ships no flake8: every Python source must parse, carry no unused imports,
 and no `except:` bare handlers. Runs over the package, e2e harness, ci
 builders, and bench entrypoints.
+
+The AST scaffolding (file walker, qualname stack, constant-call scanner)
+lives in ``tools/platlint/core.py``, shared with the platlint analyzer —
+which also runs here as a tier-1 gate (see ``test_platlint_tree_is_clean``
+and docs/STATIC_ANALYSIS.md).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from pathlib import Path
 
 import pytest
 
-ROOT = Path(__file__).resolve().parent.parent
-SCOPES = ["kubeflow_tpu", "e2e", "ci", "tools", "bench.py", "__graft_entry__.py"]
+from tools.platlint import run_gate
+from tools.platlint.core import (REPO_ROOT, QualnameVisitor,
+                                 constant_call_names, python_sources)
 
-
-def python_sources():
-    for scope in SCOPES:
-        p = ROOT / scope
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob("*.py"))
-
+ROOT = REPO_ROOT
 
 SOURCES = list(python_sources())
 IDS = [str(p.relative_to(ROOT)) for p in SOURCES]
@@ -100,6 +99,26 @@ def test_source_hygiene(path: Path):
     assert not unused, "\n".join(unused)
 
 
+# -- platlint: lock discipline & deadlock order --------------------------------
+#
+# The full analyzer (guarded-field inference, lock-order graph,
+# blocking-under-lock) runs as a tier-1 gate. New findings either get fixed
+# or get a reason-annotated entry in tools/platlint/baseline.json; fixing a
+# baselined finding requires deleting its entry (stale entries fail too).
+
+PLATLINT_BASELINE = ROOT / "tools" / "platlint" / "baseline.json"
+
+
+def test_platlint_tree_is_clean():
+    result = run_gate([Path("kubeflow_tpu")], baseline=PLATLINT_BASELINE)
+    problems = [f.render() for f in result.new]
+    problems += [f"stale baseline entry: {s}" for s in result.stale]
+    assert result.ok, (
+        "platlint gate failed (see docs/STATIC_ANALYSIS.md; reproduce with "
+        "`python -m tools.platlint kubeflow_tpu`):\n" + "\n".join(problems)
+    )
+
+
 def _node_name_writes(tree: ast.AST):
     """AST sites that set ``nodeName``: subscript assigns
     (``pod["spec"]["nodeName"] = ...``) and dict literals carrying a
@@ -168,24 +187,18 @@ def _mentions_f32(node: ast.AST) -> bool:
     return False
 
 
-class _F32MatmulFinder(ast.NodeVisitor):
+class _F32MatmulFinder(QualnameVisitor):
     """(qualname, lineno) of every matmul-family op (einsum/matmul/dot/
-    dot_general/``@``) whose expression mentions float32."""
+    dot_general/``@``) whose expression mentions float32. Scope tracking
+    comes from the shared QualnameVisitor."""
 
     def __init__(self) -> None:
-        self.stack: list[str] = []
+        super().__init__()
         self.hits: list[tuple[str, int]] = []
-
-    def _scoped(self, node) -> None:
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _scoped
 
     def _check(self, node: ast.AST) -> None:
         if _mentions_f32(node):
-            self.hits.append((".".join(self.stack) or "<module>", node.lineno))
+            self.hits.append((self.qualname, node.lineno))
 
     def visit_BinOp(self, node: ast.BinOp) -> None:
         if isinstance(node.op, ast.MatMult):
@@ -198,95 +211,6 @@ class _F32MatmulFinder(ast.NodeVisitor):
         if name in _MATMUL_CALLEES:
             self._check(node)
         self.generic_visit(node)
-
-
-# -- metric-catalog gate: every metric name must be documented ----------------
-#
-# docs/OBSERVABILITY.md is the catalog of record for the observability plane.
-# A metric registered in code but absent there is invisible to operators and
-# rots the moment someone renames it — so the catalog is lint-enforced.
-
-_METRIC_METHODS = {"counter", "gauge", "histogram", "timer"}
-
-
-def _registered_metric_names():
-    """(name, namespace prefixes in the file, path, lineno) for every
-    constant-name metric registration under kubeflow_tpu/. f-string and
-    variable names (StepClock's ``step_{name}_seconds``, note() gauges)
-    have no constant to check and are skipped — the catalog documents
-    their patterns prose-side instead."""
-    pkg = ROOT / "kubeflow_tpu"
-    for path in sorted(pkg.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        prefixes = set()
-        calls = []
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
-                    and node.args and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                continue
-            if node.func.attr == "namespace":
-                prefixes.add(node.args[0].value)
-            elif node.func.attr in _METRIC_METHODS:
-                calls.append((node.args[0].value, node.lineno))
-        for name, lineno in calls:
-            yield name, prefixes, path, lineno
-
-
-def test_metric_names_are_cataloged():
-    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
-    import re
-
-    documented = set(re.findall(r"`([A-Za-z_:][A-Za-z0-9_:]*)`", catalog))
-    missing = []
-    for name, prefixes, path, lineno in _registered_metric_names():
-        candidates = {name} | {f"{p}_{name}" for p in prefixes}
-        if not candidates & documented:
-            missing.append(
-                f"{path.relative_to(ROOT)}:{lineno}: metric {name!r} "
-                "not documented in docs/OBSERVABILITY.md")
-    assert not missing, (
-        "add these metrics to the docs/OBSERVABILITY.md catalog "
-        "(name, type, labels, meaning):\n" + "\n".join(missing)
-    )
-
-
-_SPAN_METHODS = {"span", "start_span", "emit_span"}
-
-
-def _registered_span_names():
-    """(name, path, lineno) for every constant-name span opened under
-    kubeflow_tpu/. Dynamic names (StepClock's per-step emits, f-strings)
-    have no constant to check and are skipped, same policy as metrics."""
-    pkg = ROOT / "kubeflow_tpu"
-    for path in sorted(pkg.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _SPAN_METHODS
-                    and node.args and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                yield node.args[0].value, path, node.lineno
-
-
-def test_span_names_are_cataloged():
-    """docs/OBSERVABILITY.md is the catalog of record for span names too:
-    federated traces are only navigable if the names that appear in an
-    assembled gang-bind journey mean something to the reader."""
-    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
-    import re
-
-    documented = set(re.findall(r"`([A-Za-z0-9_.]+)`", catalog))
-    missing = []
-    for name, path, lineno in _registered_span_names():
-        if name not in documented:
-            missing.append(
-                f"{path.relative_to(ROOT)}:{lineno}: span {name!r} "
-                "not documented in docs/OBSERVABILITY.md")
-    assert not missing, (
-        "add these span names to the docs/OBSERVABILITY.md catalog "
-        "(name, emitting process, parent, meaning):\n" + "\n".join(missing)
-    )
 
 
 def test_no_f32_matmuls_outside_sanctioned_islands():
@@ -307,4 +231,75 @@ def test_no_f32_matmuls_outside_sanctioned_islands():
     assert not offenders, (
         "f32 matmul outside the sanctioned fp32 islands (make it bf16 or "
         "extend F32_MATMUL_ALLOWLIST with justification):\n" + "\n".join(offenders)
+    )
+
+
+# -- metric-catalog gate: every metric name must be documented ----------------
+#
+# docs/OBSERVABILITY.md is the catalog of record for the observability plane.
+# A metric registered in code but absent there is invisible to operators and
+# rots the moment someone renames it — so the catalog is lint-enforced. Both
+# catalog gates are one constant_call_names() query over the package.
+
+_METRIC_METHODS = {"counter", "gauge", "histogram", "timer"}
+_SPAN_METHODS = {"span", "start_span", "emit_span"}
+
+PKG_SOURCES = [p for p in SOURCES if (ROOT / "kubeflow_tpu") in p.parents]
+
+
+def _registered_metric_names():
+    """(name, namespace prefixes in the file, path, lineno) for every
+    constant-name metric registration under kubeflow_tpu/. f-string and
+    variable names (StepClock's ``step_{name}_seconds``, note() gauges)
+    have no constant to check and are skipped — the catalog documents
+    their patterns prose-side instead."""
+    for path in PKG_SOURCES:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        prefixes = set()
+        calls = []
+        for method, name, lineno in constant_call_names(
+                tree, _METRIC_METHODS | {"namespace"}):
+            if method == "namespace":
+                prefixes.add(name)
+            else:
+                calls.append((name, lineno))
+        for name, lineno in calls:
+            yield name, prefixes, path, lineno
+
+
+def test_metric_names_are_cataloged():
+    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(re.findall(r"`([A-Za-z_:][A-Za-z0-9_:]*)`", catalog))
+    missing = []
+    for name, prefixes, path, lineno in _registered_metric_names():
+        candidates = {name} | {f"{p}_{name}" for p in prefixes}
+        if not candidates & documented:
+            missing.append(
+                f"{path.relative_to(ROOT)}:{lineno}: metric {name!r} "
+                "not documented in docs/OBSERVABILITY.md")
+    assert not missing, (
+        "add these metrics to the docs/OBSERVABILITY.md catalog "
+        "(name, type, labels, meaning):\n" + "\n".join(missing)
+    )
+
+
+def test_span_names_are_cataloged():
+    """docs/OBSERVABILITY.md is the catalog of record for span names too:
+    federated traces are only navigable if the names that appear in an
+    assembled gang-bind journey mean something to the reader. Dynamic
+    names (StepClock's per-step emits, f-strings) have no constant to
+    check and are skipped, same policy as metrics."""
+    catalog = (ROOT / "docs" / "OBSERVABILITY.md").read_text()
+    documented = set(re.findall(r"`([A-Za-z0-9_.]+)`", catalog))
+    missing = []
+    for path in PKG_SOURCES:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for _method, name, lineno in constant_call_names(tree, _SPAN_METHODS):
+            if name not in documented:
+                missing.append(
+                    f"{path.relative_to(ROOT)}:{lineno}: span {name!r} "
+                    "not documented in docs/OBSERVABILITY.md")
+    assert not missing, (
+        "add these span names to the docs/OBSERVABILITY.md catalog "
+        "(name, emitting process, parent, meaning):\n" + "\n".join(missing)
     )

@@ -1,0 +1,460 @@
+"""platlint analyzer tests — seeded-bug fixtures, escape hatch, baseline
+ratchet, CLI schema, and a full-tree smoke pass.
+
+Each seeded fixture must be detected by *exactly* the intended finding
+kind (acceptance criterion in ISSUE 15); the clean equivalents prove the
+analyses don't fire on the disciplined version of the same code.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.platlint import (BaselineError, analyze_paths, apply_baseline,
+                            load_baseline, run_gate)
+from tools.platlint.__main__ import run as platlint_cli
+from tools.platlint.report import BaselineEntry, Finding
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _analyze(tmp_path: Path, source: str, name: str = "mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return analyze_paths([p], root=tmp_path)
+
+
+# -- seeded deadlock: two-lock ordering cycle ---------------------------------
+
+DEADLOCK_CYCLE = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._accounts = threading.Lock()
+            self._journal = threading.Lock()
+
+        def debit(self):
+            with self._accounts:
+                with self._journal:
+                    pass
+
+        def audit(self):
+            with self._journal:
+                with self._accounts:
+                    pass
+"""
+
+CLEAN_HIERARCHY = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._accounts = threading.Lock()
+            self._journal = threading.Lock()
+
+        def debit(self):
+            with self._accounts:
+                with self._journal:
+                    pass
+
+        def audit(self):
+            with self._accounts:
+                with self._journal:
+                    pass
+"""
+
+
+def test_two_lock_cycle_detected(tmp_path):
+    findings = _analyze(tmp_path, DEADLOCK_CYCLE)
+    assert [f.kind for f in findings] == ["lock-order-cycle"]
+    assert "_accounts" in findings[0].message and "_journal" in findings[0].message
+
+
+def test_consistent_hierarchy_is_clean(tmp_path):
+    assert _analyze(tmp_path, CLEAN_HIERARCHY) == []
+
+
+def test_self_deadlock_through_helper_call(tmp_path):
+    # outer() holds the non-reentrant Lock and calls inner(), which
+    # re-acquires it on the same instance: guaranteed deadlock.
+    findings = _analyze(tmp_path, """
+        import threading
+
+        class SelfDead:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert [f.kind for f in findings] == ["lock-order-cycle"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_rlock_reacquire_not_flagged(tmp_path):
+    # same shape, reentrant lock: legal, must not fire
+    findings = _analyze(tmp_path, """
+        import threading
+
+        class Reentrant:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert findings == []
+
+
+# -- seeded race: unguarded field ---------------------------------------------
+
+RACY_FIELD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+
+        def incr(self):
+            with self._lock:
+                self._count += 1
+
+        def decr(self):
+            with self._lock:
+                self._count -= 1
+
+        def peek(self):
+            return self._count
+"""
+
+
+def test_unguarded_field_detected(tmp_path):
+    findings = _analyze(tmp_path, RACY_FIELD)
+    assert [f.kind for f in findings] == ["unguarded-field"]
+    assert "self._count" in findings[0].message
+    assert "peek" in findings[0].message
+
+
+def test_fully_guarded_field_is_clean(tmp_path):
+    findings = _analyze(tmp_path, RACY_FIELD.replace(
+        "        def peek(self):\n            return self._count",
+        "        def peek(self):\n            with self._lock:\n"
+        "                return self._count"))
+    assert findings == []
+
+
+def test_constructor_and_lock_free_fields_not_flagged(tmp_path):
+    # a field only ever touched without the lock has no inferred guard,
+    # and __init__/__post_init__ writes never count as unguarded
+    findings = _analyze(tmp_path, """
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._config = "x"
+                self._hits = 0
+
+            def tick(self):
+                self._hits += 1
+
+            def read(self):
+                return self._hits, self._config
+    """)
+    assert findings == []
+
+
+def test_caller_holds_lock_helper_inference(tmp_path):
+    # _flush_locked is only called under the lock, so its accesses count
+    # as guarded — and a blocking call inside it is still under the lock
+    findings = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class Buffered:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+
+            def add(self, x):
+                with self._lock:
+                    self._buf.append(x)
+                    self._flush_locked()
+
+            def clear(self):
+                with self._lock:
+                    self._buf = []
+                    self._flush_locked()
+
+            def _flush_locked(self):
+                self._buf.sort()
+                time.sleep(0.1)
+    """)
+    assert [f.kind for f in findings] == ["blocking-under-lock"]
+    assert "time.sleep" in findings[0].message
+
+
+# -- seeded blocking-under-lock -----------------------------------------------
+
+
+def test_blocking_calls_under_lock_detected(tmp_path):
+    findings = _analyze(tmp_path, """
+        import threading
+        import time
+        from urllib.request import urlopen
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = None
+                self._fut = None
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(1.0)
+
+            def fetch(self):
+                with self._lock:
+                    return urlopen("http://example.com")
+
+            def drain(self):
+                with self._lock:
+                    return self._q.get()
+
+            def wait_done(self):
+                with self._lock:
+                    return self._fut.result()
+    """)
+    kinds = {f.kind for f in findings}
+    assert kinds == {"blocking-under-lock"}
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.sleep" in msgs
+    assert "urlopen" in msgs
+    assert ".get()" in msgs
+    assert "result()" in msgs
+    assert len(findings) == 4
+
+
+def test_bounded_calls_not_flagged(tmp_path):
+    # timeouts everywhere → nothing fires; also nothing fires outside locks
+    findings = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class Bounded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = None
+                self._fut = None
+
+            def drain(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+
+            def wait_done(self):
+                with self._lock:
+                    return self._fut.result(timeout=2.0)
+
+            def nap_unlocked(self):
+                time.sleep(1.0)
+    """)
+    assert findings == []
+
+
+def test_condition_wait_on_held_lock_exempt(tmp_path):
+    # cond.wait() releases the condition it waits on — the canonical
+    # idiom must not fire; the same wait under a SECOND lock must.
+    findings = _analyze(tmp_path, """
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._other = threading.Lock()
+
+            def idiomatic(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def wedged(self):
+                with self._other:
+                    with self._cond:
+                        self._cond.wait()
+    """)
+    assert [f.kind for f in findings] == ["blocking-under-lock"]
+    assert "wedged" in findings[0].message or findings[0].lineno > 10
+
+
+# -- escape hatch --------------------------------------------------------------
+
+
+def test_escape_hatch_suppresses_each_kind(tmp_path):
+    findings = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class Excused:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n -= 1
+                    time.sleep(0.01)  # platlint: blocking-ok(10ms bounded backoff)
+
+            def peek(self):
+                return self._n  # platlint: unguarded-ok(monitoring read, staleness fine)
+    """)
+    assert findings == []
+
+
+def test_escape_hatch_requires_reason(tmp_path):
+    # an empty reason does not suppress — the regex demands content
+    findings = _analyze(tmp_path, """
+        import threading
+        import time
+
+        class NotExcused:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def b(self):
+                with self._lock:
+                    time.sleep(0.01)  # platlint: blocking-ok()
+    """)
+    assert [f.kind for f in findings] == ["blocking-under-lock"]
+
+
+def test_lock_order_escape_breaks_the_cycle(tmp_path):
+    src = DEADLOCK_CYCLE.replace(
+        "            with self._journal:\n                with self._accounts:",
+        "            with self._journal:\n                "
+        "with self._accounts:  # platlint: lock-order-ok(audit-only path, documented)")
+    assert _analyze(tmp_path, src) == []
+
+
+# -- baseline workflow ---------------------------------------------------------
+
+
+def _finding(file="a.py", kind="blocking-under-lock", lineno=3):
+    return Finding(kind=kind, file=file, lineno=lineno, message="m")
+
+
+def test_baseline_covers_exact_count():
+    result = apply_baseline(
+        [_finding(), _finding(lineno=9)],
+        [BaselineEntry(file="a.py", kind="blocking-under-lock", count=2,
+                       reason="r")])
+    assert result.ok
+    assert result.suppressed == 2
+
+
+def test_stale_baseline_entry_fails():
+    # the excused finding no longer fires → the entry must die (ratchet)
+    result = apply_baseline(
+        [], [BaselineEntry(file="a.py", kind="blocking-under-lock", count=1,
+                           reason="r")])
+    assert not result.ok
+    assert len(result.stale) == 1
+    assert "ratchet" in result.stale[0]
+
+
+def test_baseline_does_not_cover_extra_findings():
+    result = apply_baseline(
+        [_finding(), _finding(lineno=9)],
+        [BaselineEntry(file="a.py", kind="blocking-under-lock", count=1,
+                       reason="r")])
+    assert not result.ok  # an entry is not a blanket per-file waiver
+
+
+def test_baseline_rejects_missing_reason(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"version": 1, "entries": [
+        {"file": "a.py", "kind": "blocking-under-lock", "count": 1,
+         "reason": "  "}]}))
+    with pytest.raises(BaselineError, match="reason"):
+        load_baseline(p)
+
+
+def test_baseline_rejects_unknown_kind(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"version": 1, "entries": [
+        {"file": "a.py", "kind": "nonsense", "count": 1, "reason": "r"}]}))
+    with pytest.raises(BaselineError, match="unknown kind"):
+        load_baseline(p)
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def test_cli_json_schema(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(RACY_FIELD))
+    rc = platlint_cli([str(tmp_path / "mod.py"), "--json", "--no-baseline"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["ok"] is False
+    assert payload["total"] == 1
+    assert payload["kinds"] == ["unguarded-field", "lock-order-cycle",
+                                "blocking-under-lock"]
+    (finding,) = payload["findings"]
+    assert set(finding) == {"kind", "file", "lineno", "message"}
+    assert finding["kind"] == "unguarded-field"
+
+
+def test_cli_stale_baseline_fails(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(CLEAN_HIERARCHY))
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"version": 1, "entries": [
+        {"file": "mod.py", "kind": "lock-order-cycle", "count": 1,
+         "reason": "was a real cycle once"}]}))
+    rc = platlint_cli([str(tmp_path / "mod.py"),
+                       "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "stale baseline entry" in out
+
+
+def test_cli_clean_exits_zero(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(textwrap.dedent(CLEAN_HIERARCHY))
+    rc = platlint_cli([str(tmp_path / "mod.py"), "--no-baseline"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+# -- full-tree smoke -----------------------------------------------------------
+
+
+def test_analyzer_parses_whole_package():
+    """Every file under kubeflow_tpu/ parses and runs through all three
+    analyses without crashing; the tree + checked-in baseline gate is
+    enforced separately in test_lint.py::test_platlint_tree_is_clean."""
+    findings = analyze_paths([Path("kubeflow_tpu")], root=ROOT)
+    assert isinstance(findings, list)
+
+
+def test_repo_gate_matches_checked_in_baseline():
+    result = run_gate([Path("kubeflow_tpu")],
+                      baseline=ROOT / "tools" / "platlint" / "baseline.json",
+                      root=ROOT)
+    assert result.ok

@@ -1,0 +1,4 @@
+"""Repo tooling: the bench gates (``bench_gate.py``,
+``bench_controlplane.py``) and the platlint static analyzer
+(``tools/platlint``). A package so ``python -m tools.platlint`` resolves
+from the repo root, the same way ``ci/`` and ``e2e/`` do."""

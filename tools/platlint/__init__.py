@@ -1,0 +1,65 @@
+"""platlint — lock-discipline & deadlock-order static analyzer.
+
+The control plane's Python analogue of ``go vet`` plus a lock-order
+``-race`` tier. Three analyses over stdlib ASTs, no third-party deps:
+
+- **unguarded-field** (:mod:`tools.platlint.locks`) — per class, infer
+  which ``self._*`` fields are predominantly accessed under a class lock
+  and flag the accesses that aren't,
+- **lock-order-cycle** (:mod:`tools.platlint.lockorder`) — the global
+  acquired-while-holding graph; cycles are static deadlocks,
+- **blocking-under-lock** (:mod:`tools.platlint.blocking`) — indefinitely
+  blocking calls (sleeps, deadline-less waits, network/subprocess I/O)
+  made while any lock is held.
+
+CLI: ``python -m tools.platlint [paths] [--json]
+[--baseline tools/platlint/baseline.json]`` — see __main__.py.
+Docs: docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+from .blocking import check_blocking
+from .core import REPO_ROOT, SourceModule, load_modules
+from .lockorder import check_lock_order
+from .locks import ModuleModel, build_module_model, check_unguarded
+from .report import (BaselineEntry, BaselineError, Finding, GateResult,
+                     apply_baseline, load_baseline)
+
+__all__ = [
+    "analyze_modules", "analyze_paths", "build_module_model",
+    "check_blocking", "check_lock_order", "check_unguarded",
+    "apply_baseline", "load_baseline", "run_gate",
+    "BaselineEntry", "BaselineError", "Finding", "GateResult",
+    "ModuleModel", "SourceModule", "REPO_ROOT",
+]
+
+
+def analyze_modules(modules: Sequence[SourceModule]) -> List[Finding]:
+    """Run all three analyses over parsed modules; findings sorted by
+    (file, line, kind) for deterministic output."""
+    models: List[ModuleModel] = [build_module_model(m) for m in modules]
+    findings: List[Finding] = []
+    for model in models:
+        findings.extend(check_unguarded(model))
+        findings.extend(check_blocking(model))
+    findings.extend(check_lock_order(models))
+    findings.sort(key=lambda f: (f.file, f.lineno, f.kind))
+    return findings
+
+
+def analyze_paths(paths: Iterable[Path],
+                  root: Path = REPO_ROOT) -> List[Finding]:
+    return analyze_modules(load_modules(paths, root))
+
+
+def run_gate(paths: Iterable[Path], baseline: Optional[Path] = None,
+             root: Path = REPO_ROOT) -> GateResult:
+    """The full gate as the pytest/CI entry point uses it: analyze, apply
+    the baseline, return the result (``result.ok`` is the pass/fail)."""
+    findings = analyze_paths(paths, root)
+    entries = load_baseline(baseline) if baseline else []
+    return apply_baseline(findings, entries)

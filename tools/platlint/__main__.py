@@ -1,0 +1,92 @@
+"""platlint CLI.
+
+Usage::
+
+    python -m tools.platlint [paths...] [--json] [--baseline FILE]
+                             [--dump-graph] [--no-baseline]
+
+Paths default to ``kubeflow_tpu``; the baseline defaults to
+``tools/platlint/baseline.json`` when that file exists. Exit codes:
+0 clean (all findings baselined, no stale entries), 1 findings or stale
+baseline entries, 2 usage/baseline-format errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from . import analyze_modules, apply_baseline, load_baseline
+from .core import REPO_ROOT, load_modules
+from .locks import build_module_model
+from .lockorder import edge_summary
+from .report import BaselineError, render_text, to_json
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.platlint",
+        description="lock-discipline & deadlock-order static analyzer")
+    parser.add_argument("paths", nargs="*", default=["kubeflow_tpu"],
+                        help="files or directories to analyze "
+                             "(default: kubeflow_tpu)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help="baseline file (default: tools/platlint/"
+                             "baseline.json when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline — report raw findings")
+    parser.add_argument("--dump-graph", action="store_true",
+                        help="print the lock-order edge list and exit")
+    args = parser.parse_args(argv)
+
+    try:
+        modules = load_modules([Path(p) for p in args.paths], REPO_ROOT)
+    except (FileNotFoundError, SyntaxError) as exc:
+        print(f"platlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.dump_graph:
+        for line in edge_summary([build_module_model(m) for m in modules]):
+            print(line)
+        return 0
+
+    findings = analyze_modules(modules)
+
+    baseline_path: Optional[Path] = None
+    if not args.no_baseline:
+        baseline_path = args.baseline
+        if baseline_path is None and DEFAULT_BASELINE.is_file():
+            baseline_path = DEFAULT_BASELINE
+    try:
+        entries = load_baseline(baseline_path) if baseline_path else []
+    except BaselineError as exc:
+        print(f"platlint: {exc}", file=sys.stderr)
+        return 2
+
+    result = apply_baseline(findings, entries)
+    rel_baseline = None
+    if baseline_path is not None:
+        try:
+            rel_baseline = str(baseline_path.resolve().relative_to(REPO_ROOT))
+        except ValueError:
+            rel_baseline = str(baseline_path)
+    if args.as_json:
+        print(to_json(result, total=len(findings), paths=list(args.paths),
+                      baseline=rel_baseline))
+    else:
+        print(render_text(result, total=len(findings)))
+    return 0 if result.ok else 1
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

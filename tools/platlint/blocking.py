@@ -1,0 +1,140 @@
+"""Blocking-call-under-lock detection.
+
+Flags calls that can block indefinitely while any lock is held — the
+latency/deadlock smell ``go vet`` can't see and stress tests only hit
+probabilistically. A call is "blocking" when it matches one of:
+
+- ``time.sleep(...)`` (canonicalized through the module's import table),
+- network / socket I/O: ``urllib.request.urlopen``,
+  ``socket.create_connection``, ``socket.getaddrinfo``, ``http.client.*``
+  and ``.recv/.recv_into/.accept`` method calls,
+- subprocess waits: ``subprocess.run/call/check_call/check_output`` and
+  ``.communicate()`` without a ``timeout=``, ``os.waitpid``,
+- ``.result()`` with no args — a Future wait with no deadline,
+- ``.wait()`` / ``.wait_for(pred)`` with no timeout — **except** the
+  idiomatic ``cond.wait()`` on the *sole held* Condition, which releases
+  that lock while sleeping and is the whole point of a Condition,
+- ``.join()`` with no args — thread/process join with no deadline,
+- zero-argument ``.get()`` without ``timeout=``/``block=False`` — a
+  ``queue.Queue`` wait (``dict.get`` always takes a key, so it never
+  matches).
+
+Held state includes inferred entry locks (a private helper whose callers
+all hold the fleet lock is analyzed as holding it), so a blocking call
+buried in a "caller holds the lock" helper is still caught.
+
+Escape hatch: ``# platlint: blocking-ok(reason)`` on the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import FrozenSet, List, Optional
+
+from .core import dotted_name
+from .locks import FuncModel, ModuleModel, RawCall
+from .report import Finding
+
+#: canonical dotted names that block unconditionally
+ALWAYS_BLOCKING = {
+    "time.sleep": "time.sleep()",
+    "urllib.request.urlopen": "urllib.request.urlopen() network I/O",
+    "socket.create_connection": "socket.create_connection() network I/O",
+    "socket.getaddrinfo": "socket.getaddrinfo() DNS lookup",
+    "os.waitpid": "os.waitpid() process wait",
+}
+
+#: subprocess entry points that block unless given timeout=
+SUBPROCESS_WAITS = {"subprocess.run", "subprocess.call",
+                    "subprocess.check_call", "subprocess.check_output"}
+
+#: method names that are socket reads/accepts regardless of receiver
+SOCKET_METHODS = {"recv", "recv_into", "accept"}
+
+
+def _has_kw(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def _kw_value(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def classify(node: ast.Call, mm: ModuleModel,
+             held: FrozenSet[str]) -> Optional[str]:
+    """Human-readable description if this call can block indefinitely,
+    else None. ``held`` is consulted only for the Condition.wait
+    exemption."""
+    name = dotted_name(node.func)
+    canonical = mm.module.symbols.canonical(name) if name else None
+
+    if canonical:
+        if canonical in ALWAYS_BLOCKING:
+            return ALWAYS_BLOCKING[canonical]
+        if canonical in SUBPROCESS_WAITS and not _has_kw(node, "timeout"):
+            return f"{canonical}() without timeout"
+        if canonical.startswith("http.client."):
+            return f"{canonical}() network I/O"
+
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+
+    if attr == "result" and not node.args and not _has_kw(node, "timeout"):
+        return "Future.result() without timeout"
+    if attr == "join" and not node.args and not _has_kw(node, "timeout"):
+        return ".join() without timeout"
+    if attr == "communicate" and not _has_kw(node, "timeout"):
+        return ".communicate() without timeout"
+    if attr in SOCKET_METHODS:
+        return f"socket .{attr}() I/O"
+    if attr in ("wait", "wait_for"):
+        needed = 1 if attr == "wait" else 2  # wait(timeout) / wait_for(pred, timeout)
+        if len(node.args) >= needed or _has_kw(node, "timeout"):
+            return None
+        receiver = dotted_name(node.func.value)
+        if receiver is not None and len(held) == 1:
+            info = mm.locks_by_id.get(next(iter(held)))
+            if info is not None and info.attr_path == receiver:
+                # cond.wait() on the one lock we hold *releases* it while
+                # sleeping — the canonical Condition idiom, not a block
+                return None
+        return f".{attr}() without timeout"
+    if (attr == "get" and not node.args and not _has_kw(node, "timeout")):
+        block = _kw_value(node, "block")
+        if isinstance(block, ast.Constant) and block.value is False:
+            return None
+        return ".get() without timeout (queue wait)"
+    return None
+
+
+def _held_of(func: FuncModel, rc: RawCall) -> FrozenSet[str]:
+    return func.entry_held | rc.held
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+def check_blocking(mm: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for func in mm.all_funcs():
+        for rc in func.raw_calls:
+            held = _held_of(func, rc)
+            if not held:
+                continue
+            desc = classify(rc.node, mm, held)
+            if desc is None:
+                continue
+            if mm.module.suppression_for("blocking-under-lock", rc.node):
+                continue
+            held_names = ", ".join(sorted(_short(h) for h in held))
+            findings.append(Finding(
+                kind="blocking-under-lock", file=mm.module.rel,
+                lineno=rc.lineno,
+                message=(f"{desc} while holding {held_names} "
+                         f"(in {func.qualname})")))
+    return findings

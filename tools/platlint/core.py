@@ -1,0 +1,212 @@
+"""Shared AST infrastructure for platlint and the repo's lint gates.
+
+Everything that walks Python sources lives here so the tier-1 lint gates
+(tests/test_lint.py: binding authority, f32 matmuls, metric/span catalogs)
+and the platlint analyses (locks.py, lockorder.py, blocking.py) share one
+file walker, one qualname-stack visitor, and one symbol/alias resolver
+instead of five hand-rolled copies.
+
+Pieces:
+
+- :func:`python_sources` — the canonical source walker over the repo's
+  lint scopes (package, e2e harness, ci builders, tools, bench entrypoints),
+- :class:`SourceModule` — one parsed file: source, AST, line table, and the
+  ``# platlint: <kind>-ok(reason)`` escape-hatch comments scanned out of it,
+- :class:`Symbols` — per-module import/alias resolution, so ``import time
+  as t; t.sleep(...)`` and ``from time import sleep; sleep(...)`` both
+  canonicalize to ``time.sleep``,
+- :class:`QualnameVisitor` — a NodeVisitor maintaining a dotted
+  class/function qualname stack (the scaffolding every scoped gate needs),
+- :func:`dotted_name` / :func:`constant_call_names` — small AST helpers
+  shared by the catalog gates and the lock analyses.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: repo root (tools/platlint/core.py → three parents up)
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+#: the repo's lint scopes — every Python source CI holds to hygiene rules
+DEFAULT_SCOPES = ("kubeflow_tpu", "e2e", "ci", "tools", "bench.py",
+                  "__graft_entry__.py")
+
+
+def python_sources(root: Path = REPO_ROOT,
+                   scopes: Sequence[str] = DEFAULT_SCOPES) -> Iterator[Path]:
+    """Every Python source under the given scopes (files yielded as-is,
+    directories recursed in sorted order — deterministic for test ids and
+    baseline stability)."""
+    for scope in scopes:
+        p = root / scope
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+
+
+# -- escape hatch --------------------------------------------------------------
+#
+# A finding is suppressed in place with a reason:
+#
+#     self._depth += 1  # platlint: unguarded-ok(single writer: worker thread)
+#
+# The token before ``-ok`` is the finding kind's escape token (see
+# ESCAPE_TOKENS). The reason inside the parens is mandatory — an empty
+# reason does not suppress.
+
+SUPPRESS_RE = re.compile(r"#\s*platlint:\s*([a-z][a-z-]*)-ok\(([^)]+)\)")
+
+#: finding kind → escape-comment token
+ESCAPE_TOKENS = {
+    "unguarded-field": "unguarded",
+    "blocking-under-lock": "blocking",
+    "lock-order-cycle": "lock-order",
+}
+
+
+@dataclass(frozen=True)
+class Suppression:
+    token: str
+    reason: str
+    lineno: int
+
+
+class SourceModule:
+    """One parsed source file plus the lexical facts the analyses need."""
+
+    def __init__(self, path: Path, root: Path = REPO_ROOT) -> None:
+        self.path = path
+        try:
+            self.rel = str(path.relative_to(root))
+        except ValueError:
+            self.rel = str(path)
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.symbols = Symbols(self.tree)
+        #: lineno → suppressions declared on that physical line
+        self.suppressions: Dict[int, List[Suppression]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            for m in SUPPRESS_RE.finditer(line):
+                self.suppressions.setdefault(lineno, []).append(
+                    Suppression(m.group(1), m.group(2).strip(), lineno))
+
+    def suppression_for(self, kind: str, node: ast.AST) -> Optional[Suppression]:
+        """The escape-hatch comment covering ``node`` for finding ``kind``,
+        if any — a matching comment on any physical line of the statement
+        (multi-line calls carry the comment wherever black put it)."""
+        token = ESCAPE_TOKENS.get(kind, kind)
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start) or start
+        for lineno in range(start, end + 1):
+            for sup in self.suppressions.get(lineno, []):
+                if sup.token == token:
+                    return sup
+        return None
+
+
+def load_modules(paths: Iterable[Path],
+                 root: Path = REPO_ROOT) -> List[SourceModule]:
+    """Parse every ``*.py`` under ``paths`` (files or directories) into
+    SourceModules, sorted by relative path."""
+    files: List[Path] = []
+    for p in paths:
+        p = p if p.is_absolute() else root / p
+        if p.is_file():
+            files.append(p)
+        elif p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            raise FileNotFoundError(f"no such path: {p}")
+    return [SourceModule(f, root) for f in sorted(set(files))]
+
+
+# -- symbols -------------------------------------------------------------------
+
+
+class Symbols:
+    """Import/alias table for one module: local name → canonical dotted
+    prefix. ``canonical("t.sleep")`` with ``import time as t`` returns
+    ``time.sleep``; names with no import binding pass through unchanged."""
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.imports: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        self.imports[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                if not node.module or node.level:
+                    continue  # relative imports resolve intra-repo, not stdlib
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{node.module}.{alias.name}"
+
+    def canonical(self, dotted: str) -> str:
+        head, _, rest = dotted.partition(".")
+        mapped = self.imports.get(head)
+        if mapped is None:
+            return dotted
+        return f"{mapped}.{rest}" if rest else mapped
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, None for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# -- shared visitor scaffolding ------------------------------------------------
+
+
+class QualnameVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains a dotted qualname stack across class and
+    function definitions — subclasses read ``self.qualname`` at any node to
+    know the enclosing ``Class.method`` scope."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def _scoped(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_ClassDef = _scoped
+
+
+def constant_call_names(
+    tree: ast.AST, methods: Set[str]
+) -> Iterator[Tuple[str, str, int]]:
+    """Every ``<recv>.<method>("literal", ...)`` call whose method name is in
+    ``methods`` and whose first argument is a string constant — yields
+    ``(method, literal, lineno)``. The metric- and span-catalog gates are
+    both exactly this query."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr in methods
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            yield node.func.attr, node.args[0].value, node.lineno

@@ -1,0 +1,246 @@
+"""Lock-order graph + static deadlock detection.
+
+Builds the global "acquired-while-holding" graph: an edge A → B means
+somewhere in the tree lock B is acquired while A is held — directly
+(nested ``with``), or transitively through same-module call edges
+(``self.m()``, module functions, ``self.attr.m()`` with the attr's class
+known, plus property getters). Lock identity is class-level
+(``module::Class.attr``): two instances of the same class share a node,
+which is exactly what a lock *hierarchy* is about — AB in one code path
+and BA in another is a deadlock waiting for the right pair of threads
+regardless of instance.
+
+Findings:
+
+- a strongly-connected component with ≥ 2 locks is a cross-lock ordering
+  cycle (the classic AB/BA deadlock),
+- a self-edge is reported only for non-reentrant kinds (``Lock``,
+  ``Condition``) and only when the analysis proves the held lock and the
+  re-acquired lock are the *same instance* (the hold and the re-acquire
+  both traveled ``self``-receiver paths) — cross-instance re-acquisition
+  of a sibling's lock is legal and common (breaker pools etc.).
+
+Escape hatch: ``# platlint: lock-order-ok(reason)`` on any edge's witness
+line breaks that edge out of the graph — suppressing one edge of a cycle
+dissolves the cycle, same as fixing it would.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Set, Tuple
+
+from .core import SourceModule
+from .locks import FuncModel, ModuleModel
+from .report import Finding
+
+#: lock kinds that deadlock when re-acquired by the holding thread
+NON_REENTRANT = ("Lock", "Condition")
+
+
+@dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    file: str
+    lineno: int
+    #: for self-edges: both hold and re-acquire proven same-instance
+    same_instance: bool
+
+
+@dataclass
+class _Witness:
+    module: SourceModule
+    node: ast.AST
+
+
+def _transitive_acqs(
+    mm: ModuleModel, func: FuncModel,
+    memo: Dict[int, Dict[str, bool]], stack: Set[int],
+) -> Dict[str, bool]:
+    """Locks ``func`` may acquire, directly or via resolvable callees —
+    lock_id → whether the acquisition path stayed on ``self`` receivers
+    end to end. Recursion through call cycles is cut (conservative)."""
+    key = id(func)
+    if key in memo:
+        return memo[key]
+    if key in stack:
+        return {}
+    stack.add(key)
+    out: Dict[str, bool] = {}
+    for acq in func.acquisitions:
+        prev = out.get(acq.lock_id)
+        out[acq.lock_id] = acq.via_self if prev is None else (prev or acq.via_self)
+    for cs in func.calls:
+        callee = mm.resolve_call(cs, func)
+        if callee is None:
+            continue
+        for lid, via in _transitive_acqs(mm, callee, memo, stack).items():
+            via2 = via and cs.receiver_is_self
+            prev = out.get(lid)
+            out[lid] = via2 if prev is None else (prev or via2)
+    stack.discard(key)
+    memo[key] = out
+    return out
+
+
+def collect_edges(
+    models: List[ModuleModel],
+) -> Tuple[Dict[Tuple[str, str], Edge], Dict[Tuple[str, str], _Witness]]:
+    """The global acquired-while-holding edge set, first witness wins.
+    Edges whose witness line carries ``# platlint: lock-order-ok(...)``
+    are dropped here."""
+    edges: Dict[Tuple[str, str], Edge] = {}
+    witnesses: Dict[Tuple[str, str], _Witness] = {}
+
+    def add(src: str, dst: str, mm: ModuleModel, node: ast.AST,
+            lineno: int, same_instance: bool) -> None:
+        if mm.module.suppression_for("lock-order-cycle", node):
+            return
+        key = (src, dst)
+        if key in edges:
+            if same_instance and not edges[key].same_instance:
+                edges[key] = Edge(src, dst, edges[key].file,
+                                  edges[key].lineno, True)
+            return
+        edges[key] = Edge(src=src, dst=dst, file=mm.module.rel,
+                          lineno=lineno, same_instance=same_instance)
+        witnesses[key] = _Witness(module=mm.module, node=node)
+
+    for mm in models:
+        memo: Dict[int, Dict[str, bool]] = {}
+        for func in mm.all_funcs():
+            base = func.entry_held
+            base_self = func.entry_held_self
+            for acq in func.acquisitions:
+                for held in base | acq.held:
+                    same = (acq.via_self
+                            and (held in acq.held or held in base_self))
+                    add(held, acq.lock_id, mm, acq.node, acq.lineno, same)
+            for cs in func.calls:
+                held_all = base | cs.held
+                if not held_all:
+                    continue
+                callee = mm.resolve_call(cs, func)
+                if callee is None:
+                    continue
+                for lid, via in _transitive_acqs(mm, callee, memo,
+                                                 set()).items():
+                    via2 = via and cs.receiver_is_self
+                    for held in held_all:
+                        same = via2 and (held in cs.held or held in base_self)
+                        add(held, lid, mm, cs.node, cs.lineno, same)
+    return edges, witnesses
+
+
+def _sccs(nodes: Set[str],
+          adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Tarjan strongly-connected components (iterative; deterministic
+    order for stable finding output)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+
+    def strongconnect(root: str) -> None:
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work.pop()
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            succs = adj.get(node, [])
+            for i in range(pi, len(succs)):
+                nxt = succs[i]
+                if nxt not in index:
+                    work.append((node, i + 1))
+                    work.append((nxt, 0))
+                    recurse = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if recurse:
+                continue
+            if low[node] == index[node]:
+                comp: List[str] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return out
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+def check_lock_order(models: List[ModuleModel]) -> List[Finding]:
+    locks_by_id = {}
+    for mm in models:
+        locks_by_id.update(mm.locks_by_id)
+
+    edges, _witnesses = collect_edges(models)
+    findings: List[Finding] = []
+
+    # self-edges: deadlock iff the lock is non-reentrant and provably the
+    # same instance on both sides; never part of the cycle graph
+    cycle_edges: Dict[Tuple[str, str], Edge] = {}
+    for key, edge in sorted(edges.items()):
+        if edge.src == edge.dst:
+            info = locks_by_id.get(edge.src)
+            kind = info.kind if info else "unknown"
+            if kind in NON_REENTRANT and edge.same_instance:
+                findings.append(Finding(
+                    kind="lock-order-cycle", file=edge.file,
+                    lineno=edge.lineno,
+                    message=(f"non-reentrant {kind} {_short(edge.src)} "
+                             f"re-acquired while already held by the same "
+                             f"instance — self-deadlock")))
+            continue
+        cycle_edges[key] = edge
+
+    adj: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for (src, dst) in sorted(cycle_edges):
+        adj.setdefault(src, []).append(dst)
+        nodes.add(src)
+        nodes.add(dst)
+
+    for comp in _sccs(nodes, adj):
+        if len(comp) < 2:
+            continue
+        members = set(comp)
+        involved = [e for k, e in sorted(cycle_edges.items())
+                    if e.src in members and e.dst in members]
+        desc = "; ".join(
+            f"{_short(e.src)} → {_short(e.dst)} ({e.file}:{e.lineno})"
+            for e in involved)
+        first = involved[0]
+        findings.append(Finding(
+            kind="lock-order-cycle", file=first.file, lineno=first.lineno,
+            message=(f"lock-order cycle across {len(comp)} locks "
+                     f"[{', '.join(_short(l) for l in comp)}]: {desc}")))
+    return findings
+
+
+def edge_summary(models: List[ModuleModel]) -> List[str]:
+    """Human-readable edge dump (``--dump-graph``) for triage."""
+    edges, _ = collect_edges(models)
+    return [f"{_short(e.src)} -> {_short(e.dst)}  ({e.file}:{e.lineno})"
+            for _, e in sorted(edges.items())]

@@ -1,0 +1,550 @@
+"""Lock model + guarded-field inference.
+
+This module builds platlint's picture of a source module's concurrency:
+
+- which attributes are locks (``self._lock = threading.Lock()`` and
+  friends, plus module-level and function-local locks),
+- which locks are held at every statement — syntactically from ``with
+  self._lock:`` blocks, and inter-procedurally through same-module call
+  edges: a private helper whose every resolvable call site holds a lock
+  is analyzed as running with that lock held (the ``_add_replica``
+  "caller holds the lock" convention, machine-checked instead of
+  docstring-checked),
+- which ``self._*`` fields each class access-pattern says are
+  lock-guarded.
+
+The **unguarded-field** check then flags every access of an inferred
+guarded field made outside the guard. Inference is deliberately
+conservative:
+
+- only fields *written* outside ``__init__`` are candidates (a field
+  assigned once at construction and read forever is immutable state, not
+  shared mutable state),
+- constructor accesses (``__init__``/``__post_init__``/``__new__``) never
+  count (the object is unpublished),
+- a field counts as guarded only when ≥ ``MIN_GUARDED`` of its accesses
+  hold a class lock AND guarded accesses are a strict majority — fields
+  intentionally read lock-free everywhere stay below the majority and are
+  never flagged.
+
+Escape hatch: ``# platlint: unguarded-ok(reason)`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import SourceModule, dotted_name
+from .report import Finding
+
+#: canonical constructor → lock kind; RLock/Semaphore are reentrant-safe
+#: for self-reacquisition, Lock/Condition deadlock on it
+LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "threading.Semaphore": "Semaphore",
+    "threading.BoundedSemaphore": "Semaphore",
+}
+
+#: a bare ``with self.X:`` whose name looks lock-ish is treated as a lock
+#: even without constructor evidence (locks passed in via parameters)
+LOCKISH_NAME = re.compile(r"lock|cond|mutex|sem\b|cv\b", re.I)
+
+#: guarded-field inference threshold: a field is inferred lock-guarded
+#: when a strict majority of its accesses hold a class lock and at least
+#: MIN_GUARDED do (a single ``with`` block proves nothing)
+MIN_GUARDED = 2
+
+#: methods that run before the object is published to other threads —
+#: accesses inside them are race-free by construction and never counted
+CONSTRUCTORS = frozenset({"__init__", "__post_init__", "__new__"})
+
+EMPTY: FrozenSet[str] = frozenset()
+
+
+@dataclass(frozen=True)
+class LockInfo:
+    lock_id: str      # globally unique: "<relpath>::<Class>.<attr>" / "<relpath>::<name>"
+    kind: str         # Lock | RLock | Condition | Semaphore | unknown
+    attr_path: str    # how code spells it: "self._lock" or a bare name
+
+    @property
+    def short(self) -> str:
+        return self.lock_id.split("::", 1)[-1]
+
+
+@dataclass
+class Access:
+    attr: str
+    lineno: int
+    node: ast.AST
+    held: FrozenSet[str]   # with-context only; add FuncModel.entry_held
+    is_write: bool
+    method: str            # enclosing top-level function/method name
+
+
+@dataclass
+class Acquisition:
+    lock_id: str
+    lineno: int
+    node: ast.AST
+    held: FrozenSet[str]   # held just before acquiring (with-context only)
+    via_self: bool         # spelled ``with self.X`` (same-instance evidence)
+
+
+@dataclass
+class CallSite:
+    target: Tuple[str, ...]  # ("self", m) | ("attr", a, m) | ("module", f)
+                             # | ("class", C, m) | ("init", C)
+    lineno: int
+    node: ast.Call
+    held: FrozenSet[str]
+
+    @property
+    def receiver_is_self(self) -> bool:
+        return self.target[0] == "self"
+
+
+@dataclass
+class RawCall:
+    node: ast.Call
+    lineno: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class FuncModel:
+    name: str
+    qualname: str
+    node: ast.AST
+    class_name: Optional[str] = None
+    is_property: bool = False
+    #: locks held at entry, inferred from call sites (full set, and the
+    #: subset that provably traveled through same-instance ``self.m()``
+    #: call chains — only the latter can justify a self-deadlock report)
+    entry_held: FrozenSet[str] = EMPTY
+    entry_held_self: FrozenSet[str] = EMPTY
+    accesses: List[Access] = field(default_factory=list)
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    raw_calls: List[RawCall] = field(default_factory=list)
+
+    @property
+    def is_private(self) -> bool:
+        return self.name.startswith("_") and not self.name.startswith("__")
+
+
+@dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    locks: Dict[str, LockInfo] = field(default_factory=dict)
+    methods: Dict[str, FuncModel] = field(default_factory=dict)
+    #: self.attr → same-module class name (from ``self.attr = ClassName(...)``)
+    attr_classes: Dict[str, str] = field(default_factory=dict)
+    #: methods referenced without a call (thread targets, callbacks) —
+    #: their entry lock state is unknowable, so never inferred
+    escaping: Set[str] = field(default_factory=set)
+
+    def lock_ids(self) -> FrozenSet[str]:
+        return frozenset(info.lock_id for info in self.locks.values())
+
+
+@dataclass
+class ModuleModel:
+    module: SourceModule
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    functions: Dict[str, FuncModel] = field(default_factory=dict)
+    module_locks: Dict[str, LockInfo] = field(default_factory=dict)
+    escaping_functions: Set[str] = field(default_factory=set)
+    #: every lock this module defines, by id (lockorder/blocking lookups)
+    locks_by_id: Dict[str, LockInfo] = field(default_factory=dict)
+
+    def all_funcs(self) -> List[FuncModel]:
+        out = list(self.functions.values())
+        for cls in self.classes.values():
+            out.extend(cls.methods.values())
+        return out
+
+    def resolve_call(self, site: CallSite,
+                     caller: FuncModel) -> Optional[FuncModel]:
+        """Same-module call resolution (the only kind platlint follows)."""
+        kind = site.target[0]
+        if kind == "self" and caller.class_name:
+            cls = self.classes.get(caller.class_name)
+            return cls.methods.get(site.target[1]) if cls else None
+        if kind == "attr" and caller.class_name:
+            owner = self.classes[caller.class_name].attr_classes.get(site.target[1])
+            if owner and owner in self.classes:
+                return self.classes[owner].methods.get(site.target[2])
+            return None
+        if kind == "module":
+            return self.functions.get(site.target[1])
+        if kind == "class":
+            cls = self.classes.get(site.target[1])
+            return cls.methods.get(site.target[2]) if cls else None
+        if kind == "init":
+            cls = self.classes.get(site.target[1])
+            return cls.methods.get("__init__") if cls else None
+        return None
+
+
+# -- model construction --------------------------------------------------------
+
+
+def _lock_ctor_kind(node: ast.AST, mod: SourceModule) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    return LOCK_CTORS.get(mod.symbols.canonical(name))
+
+
+def _is_property(node: ast.AST) -> bool:
+    decos = getattr(node, "decorator_list", [])
+    return any(dotted_name(d) in ("property", "functools.cached_property",
+                                  "cached_property")
+               for d in decos)
+
+
+class _BodyWalker:
+    """Walks one top-level function/method body tracking the with-held lock
+    set, recording accesses, acquisitions, call sites, and raw calls into
+    the FuncModel. Nested function/lambda bodies execute later, under
+    unknown locks — they are walked with an empty held set."""
+
+    def __init__(self, mm: ModuleModel, cls: Optional[ClassModel],
+                 func: FuncModel) -> None:
+        self.mm = mm
+        self.cls = cls
+        self.func = func
+        #: function-local locks (``stats_lock = threading.Lock()``)
+        self.local_locks: Dict[str, LockInfo] = {}
+
+    # -- lock resolution -----------------------------------------------------
+    def _lock_of(self, expr: ast.AST) -> Optional[LockInfo]:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        if name.startswith("self.") and self.cls is not None:
+            attr = name[len("self."):]
+            if "." in attr:
+                return None  # a member's lock — foreign instance, unmodeled
+            info = self.cls.locks.get(attr)
+            if info is None and LOCKISH_NAME.search(attr):
+                info = LockInfo(
+                    lock_id=f"{self.mm.module.rel}::{self.cls.name}.{attr}",
+                    kind="unknown", attr_path=f"self.{attr}")
+                self.cls.locks[attr] = info
+                self.mm.locks_by_id[info.lock_id] = info
+            return info
+        if "." not in name:
+            return self.local_locks.get(name) or self.mm.module_locks.get(name)
+        return None
+
+    # -- traversal -----------------------------------------------------------
+    def walk(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.func.acquisitions.append(Acquisition(
+                        lock_id=lock.lock_id, lineno=item.context_expr.lineno,
+                        node=node, held=inner,
+                        via_self=lock.attr_path.startswith("self.")))
+                    inner = inner | {lock.lock_id}
+                else:
+                    self.walk(item.context_expr, inner)
+                if item.optional_vars is not None:
+                    self.walk(item.optional_vars, inner)
+            for stmt in node.body:
+                self.walk(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                self.walk(deco, held)
+            for stmt in node.body:
+                self.walk(stmt, EMPTY)  # deferred execution: locks unknown
+            return
+        if isinstance(node, ast.Lambda):
+            self.walk(node.body, EMPTY)
+            return
+        if isinstance(node, ast.ClassDef):
+            return  # nested classes: out of scope
+        if isinstance(node, ast.Call):
+            self._walk_call(node, held)
+            return
+        if isinstance(node, ast.Assign):
+            # function-local lock: NAME = threading.Lock()
+            kind = _lock_ctor_kind(node.value, self.mm.module)
+            if kind and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                self.local_locks[name] = LockInfo(
+                    lock_id=f"{self.mm.module.rel}::{self.func.qualname}.{name}",
+                    kind=kind, attr_path=name)
+        if isinstance(node, ast.Attribute):
+            self._record_attribute(node, held)
+            self.walk(node.value, held)
+            return
+        if isinstance(node, ast.Name):
+            if (node.id in self.mm.functions
+                    and isinstance(node.ctx, ast.Load)):
+                self.mm.escaping_functions.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+    def _walk_call(self, node: ast.Call, held: FrozenSet[str]) -> None:
+        self.func.raw_calls.append(RawCall(node=node, lineno=node.lineno,
+                                           held=held))
+        target = self._resolve_target(node.func)
+        if target is not None:
+            self.func.calls.append(CallSite(target=target, lineno=node.lineno,
+                                            node=node, held=held))
+        # walk the receiver chain below the terminal attribute (so
+        # ``self._queue.append(x)`` records the self._queue access) but not
+        # the terminal Name/Attribute itself — a called method is a call,
+        # not an escaping reference
+        if isinstance(node.func, ast.Attribute):
+            self.walk(node.func.value, held)
+        elif not isinstance(node.func, ast.Name):
+            self.walk(node.func, held)
+        # wait_for(lambda: ...) runs its predicate WITH the condition held —
+        # the one lambda whose body executes under the call site's locks
+        is_wait_for = (isinstance(node.func, ast.Attribute)
+                       and node.func.attr == "wait_for")
+        for arg in node.args:
+            if is_wait_for and isinstance(arg, ast.Lambda):
+                self.walk(arg.body, held)
+            else:
+                self.walk(arg, held)
+        for kw in node.keywords:
+            self.walk(kw.value, held)
+
+    def _resolve_target(self, func: ast.AST) -> Optional[Tuple[str, ...]]:
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 2 and parts[1] in self.cls.methods:
+                return ("self", parts[1])
+            if len(parts) == 3 and parts[1] in self.cls.attr_classes:
+                return ("attr", parts[1], parts[2])
+            return None
+        if len(parts) == 1:
+            if parts[0] in self.mm.functions:
+                return ("module", parts[0])
+            if parts[0] in self.mm.classes:
+                return ("init", parts[0])
+            return None
+        if len(parts) == 2 and parts[0] in self.mm.classes:
+            return ("class", parts[0], parts[1])
+        return None
+
+    def _record_attribute(self, node: ast.Attribute,
+                          held: FrozenSet[str]) -> None:
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            return
+        if self.cls is None:
+            return
+        attr = node.attr
+        if attr in self.cls.locks:
+            return  # the lock object itself, not guarded state
+        meth = self.cls.methods.get(attr)
+        if meth is not None:
+            if meth.is_property:
+                # a property access runs the getter: model it as a call so
+                # its lock acquisitions count (self.state under a held
+                # Lock re-acquiring that Lock is a real deadlock)
+                self.func.calls.append(CallSite(
+                    target=("self", attr), lineno=node.lineno,
+                    node=ast.Call(func=node, args=[], keywords=[]),
+                    held=held))
+            elif isinstance(node.ctx, ast.Load):
+                self.cls.escaping.add(attr)  # thread target / callback
+            return
+        self.func.accesses.append(Access(
+            attr=attr, lineno=node.lineno, node=node, held=held,
+            is_write=isinstance(node.ctx, (ast.Store, ast.Del)),
+            method=self.func.name))
+
+
+def build_module_model(mod: SourceModule) -> ModuleModel:
+    """Parse one SourceModule into the lock/call model. Two passes: first
+    discover classes, methods, lock attributes, and attr→class bindings
+    (the walker needs the full table to resolve calls); then walk bodies."""
+    mm = ModuleModel(module=mod)
+
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = ClassModel(name=node.name, node=node)
+            mm.classes[node.name] = cls
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cls.methods[item.name] = FuncModel(
+                        name=item.name,
+                        qualname=f"{node.name}.{item.name}",
+                        node=item, class_name=node.name,
+                        is_property=_is_property(item))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mm.functions[node.name] = FuncModel(
+                name=node.name, qualname=node.name, node=node)
+        elif isinstance(node, ast.Assign):
+            kind = _lock_ctor_kind(node.value, mod)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        info = LockInfo(lock_id=f"{mod.rel}::{t.id}",
+                                        kind=kind, attr_path=t.id)
+                        mm.module_locks[t.id] = info
+                        mm.locks_by_id[info.lock_id] = info
+
+    # lock attributes + attr→class bindings, from every method body
+    for cls in mm.classes.values():
+        for meth in cls.methods.values():
+            for node in ast.walk(meth.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    kind = _lock_ctor_kind(node.value, mod)
+                    if kind:
+                        info = LockInfo(
+                            lock_id=f"{mod.rel}::{cls.name}.{t.attr}",
+                            kind=kind, attr_path=f"self.{t.attr}")
+                        cls.locks[t.attr] = info
+                        mm.locks_by_id[info.lock_id] = info
+                    elif (isinstance(node.value, ast.Call)
+                          and isinstance(node.value.func, ast.Name)
+                          and node.value.func.id in mm.classes):
+                        cls.attr_classes.setdefault(t.attr,
+                                                    node.value.func.id)
+
+    for cls in mm.classes.values():
+        for meth in cls.methods.values():
+            walker = _BodyWalker(mm, cls, meth)
+            for stmt in meth.node.body:
+                walker.walk(stmt, EMPTY)
+    for fn in mm.functions.values():
+        walker = _BodyWalker(mm, None, fn)
+        for stmt in fn.node.body:
+            walker.walk(stmt, EMPTY)
+
+    propagate_entry_held(mm)
+    return mm
+
+
+def propagate_entry_held(mm: ModuleModel, max_rounds: int = 10) -> None:
+    """Infer locks held at entry of private helpers: if every resolvable
+    same-module call site of ``_helper`` holds lock L, the helper runs with
+    L held. Least fixpoint from ∅ (monotone: entry sets only grow), so a
+    helper is never *assumed* guarded without call-site evidence. Public
+    methods, dunders, and escaping methods (referenced as values — thread
+    targets, callbacks) always start from ∅: anyone may call them bare."""
+    funcs = mm.all_funcs()
+    entry: Dict[int, FrozenSet[str]] = {id(f): EMPTY for f in funcs}
+    entry_self: Dict[int, FrozenSet[str]] = {id(f): EMPTY for f in funcs}
+
+    def eligible(f: FuncModel) -> bool:
+        if not f.is_private or f.is_property:
+            return False
+        if f.class_name is not None:
+            return f.name not in mm.classes[f.class_name].escaping
+        return f.name not in mm.escaping_functions
+
+    for _ in range(max_rounds):
+        sites: Dict[int, List[Tuple[FrozenSet[str], FrozenSet[str]]]] = {}
+        for caller in funcs:
+            base = entry[id(caller)]
+            base_self = entry_self[id(caller)]
+            for cs in caller.calls:
+                callee = mm.resolve_call(cs, caller)
+                if callee is None:
+                    continue
+                full = base | cs.held
+                selfish = (base_self | cs.held) if cs.receiver_is_self else EMPTY
+                sites.setdefault(id(callee), []).append((full, selfish))
+        changed = False
+        for f in funcs:
+            if not eligible(f):
+                continue
+            fsites = sites.get(id(f))
+            if not fsites:
+                continue
+            new = frozenset.intersection(*(s[0] for s in fsites))
+            new_self = frozenset.intersection(*(s[1] for s in fsites))
+            if new != entry[id(f)] or new_self != entry_self[id(f)]:
+                entry[id(f)], entry_self[id(f)] = new, new_self
+                changed = True
+        if not changed:
+            break
+    for f in funcs:
+        f.entry_held = entry[id(f)]
+        f.entry_held_self = entry_self[id(f)]
+
+
+# -- the unguarded-field check -------------------------------------------------
+
+
+def _short_lock(mm: ModuleModel, lock_id: str) -> str:
+    info = mm.locks_by_id.get(lock_id)
+    return info.short if info else lock_id.split("::", 1)[-1]
+
+
+def check_unguarded(mm: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in mm.classes.values():
+        class_locks = cls.lock_ids()
+        if not class_locks:
+            continue
+        per_field: Dict[str, List[Tuple[Access, bool]]] = {}
+        written_outside_init: Set[str] = set()
+        for meth in cls.methods.values():
+            for acc in meth.accesses:
+                if meth.name in CONSTRUCTORS:
+                    continue  # unpublished object: constructor is race-free
+                guarded = bool((acc.held | meth.entry_held) & class_locks)
+                per_field.setdefault(acc.attr, []).append((acc, guarded))
+                if acc.is_write:
+                    written_outside_init.add(acc.attr)
+        for attr in sorted(per_field):
+            if attr not in written_outside_init:
+                continue  # immutable-after-init config, not shared state
+            rows = per_field[attr]
+            guarded_rows = [a for a, g in rows if g]
+            total = len(rows)
+            if len(guarded_rows) < MIN_GUARDED:
+                continue
+            if len(guarded_rows) <= total - len(guarded_rows):
+                continue  # not a strict majority: not an inferred guard
+            dominant = Counter(
+                lid for a in guarded_rows
+                for lid in (a.held | cls.methods[a.method].entry_held)
+                if lid in class_locks).most_common(1)[0][0]
+            for acc, guarded in rows:
+                if guarded:
+                    continue
+                if mm.module.suppression_for("unguarded-field", acc.node):
+                    continue
+                findings.append(Finding(
+                    kind="unguarded-field",
+                    file=mm.module.rel,
+                    lineno=acc.lineno,
+                    message=(
+                        f"self.{attr} ({'write' if acc.is_write else 'read'} in "
+                        f"{cls.name}.{acc.method}) is guarded by "
+                        f"{_short_lock(mm, dominant)} in "
+                        f"{len(guarded_rows)}/{total} accesses but not here"),
+                ))
+    return findings

@@ -1,0 +1,176 @@
+"""Findings, baseline, and rendering.
+
+The baseline (``tools/platlint/baseline.json``) works like the bench
+gate's waivers: a suppression is pinned to ``(file, kind)`` with an exact
+expected count and a mandatory reason. The gate fails when
+
+- a finding fires with no covering baseline entry (new findings fail CI),
+- a baseline entry expects more findings than fire (stale entry — the code
+  it excused was fixed, so the excuse must be deleted: a ratchet),
+- a baseline entry expects fewer findings than fire (the entry is not a
+  blanket waiver for the file — new instances of an excused kind still
+  fail).
+
+Baseline file shape::
+
+    {
+      "version": 1,
+      "entries": [
+        {"file": "kubeflow_tpu/serving/fleet.py",
+         "kind": "blocking-under-lock",
+         "count": 1,
+         "reason": "why this is acceptable"}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+BASELINE_VERSION = 1
+
+#: every finding kind the analyzer can emit (schema + docs anchor)
+FINDING_KINDS = ("unguarded-field", "lock-order-cycle", "blocking-under-lock")
+
+
+@dataclass(frozen=True)
+class Finding:
+    kind: str
+    file: str
+    lineno: int
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.file, self.kind)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"kind": self.kind, "file": self.file, "lineno": self.lineno,
+                "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.file}:{self.lineno}: [{self.kind}] {self.message}"
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    file: str
+    kind: str
+    count: int
+    reason: str
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.file, self.kind)
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — fail loudly, never silently ignore."""
+
+
+def load_baseline(path: Path) -> List[BaselineEntry]:
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        raise BaselineError(f"cannot read baseline {path}: {exc}") from exc
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: expected {{'version': {BASELINE_VERSION}, 'entries': [...]}}")
+    entries: List[BaselineEntry] = []
+    seen: set = set()
+    for i, raw in enumerate(data.get("entries", [])):
+        if not isinstance(raw, dict):
+            raise BaselineError(f"{path}: entry #{i} is not an object")
+        missing = {"file", "kind", "count", "reason"} - set(raw)
+        if missing:
+            raise BaselineError(
+                f"{path}: entry #{i} missing {sorted(missing)}")
+        if raw["kind"] not in FINDING_KINDS:
+            raise BaselineError(
+                f"{path}: entry #{i} has unknown kind {raw['kind']!r}")
+        if not isinstance(raw["count"], int) or raw["count"] < 1:
+            raise BaselineError(f"{path}: entry #{i} count must be a positive int")
+        if not str(raw["reason"]).strip():
+            raise BaselineError(
+                f"{path}: entry #{i} needs a non-empty reason — baselines "
+                "without justification are just silenced bugs")
+        entry = BaselineEntry(file=raw["file"], kind=raw["kind"],
+                              count=raw["count"], reason=str(raw["reason"]))
+        if entry.key in seen:
+            raise BaselineError(
+                f"{path}: duplicate entry for {entry.file} / {entry.kind}")
+        seen.add(entry.key)
+        entries.append(entry)
+    return entries
+
+
+@dataclass
+class GateResult:
+    new: List[Finding]          # findings not covered by the baseline
+    stale: List[str]            # human-readable stale-entry complaints
+    suppressed: int             # findings the baseline covered
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.stale
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   entries: Sequence[BaselineEntry]) -> GateResult:
+    counts = Counter(f.key for f in findings)
+    covered: set = set()
+    stale: List[str] = []
+    suppressed = 0
+    for entry in entries:
+        actual = counts.get(entry.key, 0)
+        if actual == entry.count:
+            covered.add(entry.key)
+            suppressed += actual
+        elif actual < entry.count:
+            stale.append(
+                f"{entry.file}: {entry.kind} — baseline expects {entry.count}, "
+                f"tree has {actual}; the excused finding was fixed, delete or "
+                f"shrink the entry (ratchet)")
+        else:
+            stale.append(
+                f"{entry.file}: {entry.kind} — baseline covers {entry.count} "
+                f"but {actual} fire; the new instances need fixing or their "
+                f"own review")
+    new = [f for f in findings if f.key not in covered]
+    return GateResult(new=new, stale=stale, suppressed=suppressed)
+
+
+def render_text(result: GateResult, total: int) -> str:
+    lines: List[str] = []
+    for f in sorted(result.new, key=lambda f: (f.file, f.lineno, f.kind)):
+        lines.append(f.render())
+    for s in result.stale:
+        lines.append(f"stale baseline entry: {s}")
+    verdict = "clean" if result.ok else "FAIL"
+    lines.append(
+        f"platlint: {total} finding(s), {result.suppressed} baselined, "
+        f"{len(result.new)} new, {len(result.stale)} stale baseline "
+        f"entr{'y' if len(result.stale) == 1 else 'ies'} — {verdict}")
+    return "\n".join(lines)
+
+
+def to_json(result: GateResult, total: int, paths: Sequence[str],
+            baseline: Optional[str]) -> str:
+    payload = {
+        "version": BASELINE_VERSION,
+        "paths": list(paths),
+        "baseline": baseline,
+        "kinds": list(FINDING_KINDS),
+        "total": total,
+        "suppressed": result.suppressed,
+        "findings": [f.to_dict() for f in sorted(
+            result.new, key=lambda f: (f.file, f.lineno, f.kind))],
+        "stale": list(result.stale),
+        "ok": result.ok,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
