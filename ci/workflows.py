@@ -335,6 +335,26 @@ def trace_federation_e2e() -> Dict:
     return b.build()
 
 
+def ha_chaos_e2e() -> Dict:
+    """The durable-control-plane HA job: an apiserver on the WAL+snapshot
+    backend plus two scheduler replicas under leader election, both
+    kill -9'd mid-gang-wave — the restarted apiserver must recover every
+    object and the monotonic RV counter from snapshot+replay, the surviving
+    scheduler's informers must heal through watch reconnect + paginated
+    relist from their durable RVs, the standby must take over the Lease and
+    finish the wave with zero dropped work, and the rebuilt ledger must
+    stay within chip capacity (e2e/ha_chaos_driver.py asserts all of it),
+    plus the WAL crash-matrix and leader fault-matrix unit suites."""
+    b = WorkflowBuilder("ha-chaos-e2e")
+    b.run("ha-kill9-driver", ["python", "-m", "e2e.ha_chaos_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("wal-crash-matrix", "tests/test_wal.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("leader-fault-matrix", "tests/test_leader.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    return b.build()
+
+
 WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     **{f"{c}-presubmit": (lambda c=c: component_presubmit(c)) for c in COMPONENTS},
     "platform-e2e": platform_e2e,
@@ -354,6 +374,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "attribution-e2e": attribution_e2e,
     "monitoring-e2e": monitoring_e2e,
     "trace-federation-e2e": trace_federation_e2e,
+    "ha-chaos-e2e": ha_chaos_e2e,
 }
 
 

@@ -5,7 +5,10 @@ MutatingWebhookConfiguration object for the external PodDefault webhook —
 admission is ALWAYS driven by those stored objects, apiserver/admission.py;
 unset + no objects = in-process admission, the all-in-one default),
 KUBEFLOW_TPU_NATIVE
-(storage backend selection), APISERVER_AUTH=token (+ APISERVER_TOKENS /
+(storage backend selection), APISERVER_WAL_DIR to run on the durable
+WAL+snapshot backend (wal.py; APISERVER_WAL_SNAPSHOT_EVERY tunes
+compaction) so state and the RV counter survive a restart,
+APISERVER_AUTH=token (+ APISERVER_TOKENS /
 APISERVER_TOKEN_FILE) for the deny-by-default bearer/RBAC gate (auth.py),
 APISERVER_TLS_CERT_FILE + APISERVER_TLS_KEY_FILE to serve HTTPS (the
 reference substrate is TLS-only; clients verify via APISERVER_CA_FILE —
@@ -35,7 +38,17 @@ def main() -> None:
     from ..runtime.tracing import TRACER
 
     TRACER.service = "apiserver"  # federated spans name their process
-    store = Store()
+    backend = None
+    wal_dir = os.environ.get("APISERVER_WAL_DIR", "")
+    if wal_dir:
+        from .wal import SNAPSHOT_EVERY_DEFAULT, DurableBackend
+
+        backend = DurableBackend(
+            wal_dir,
+            snapshot_every=int(os.environ.get(
+                "APISERVER_WAL_SNAPSHOT_EVERY", str(SNAPSHOT_EVERY_DEFAULT))),
+        )
+    store = Store(backend=backend)
     webhook_url = os.environ.get("WEBHOOK_URL", "")
     auth = auth_from_env(store)
     fairness = None

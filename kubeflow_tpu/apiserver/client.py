@@ -10,14 +10,22 @@ TooManyRequests / 503 ServiceUnavailable — the retryable pair, never the
 fatal 4xx family) with capped exponential backoff and FULL jitter
 (delay ~ U(0, min(cap, base·2^attempt)), the AWS-architecture-blog variant
 that de-synchronizes a thundering herd), honoring a server-sent
-``Retry-After`` as the floor. The in-process Store never sheds, so the
-wrapper only bites against a fairness-gated remote apiserver.
+``Retry-After`` as the floor. Transient *connection* failures — refused or
+reset while the apiserver restarts, surfaced by RemoteStore as raw
+URLError/ConnectionResetError rather than the ApiError taxonomy — ride the
+same jittered schedule, so controllers and informers span a restart window
+instead of surfacing handler failures. Timeouts are NOT retried (a hung
+server is not a restarting one; stacking full client timeouts would park a
+reconciler far past the leader-election deadline). The in-process Store
+never sheds, so the wrapper only bites against a remote apiserver.
 """
 
 from __future__ import annotations
 
+import http.client
 import random
 import time
+import urllib.error
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..api import meta as apimeta
@@ -31,6 +39,22 @@ RETRY_BASE_S = 0.1
 RETRY_CAP_S = 5.0
 #: a malicious/buggy Retry-After must not park a controller for an hour
 RETRY_AFTER_CLAMP_S = 30.0
+
+
+def is_transient_conn_error(exc: BaseException) -> bool:
+    """True for connection-refused/reset/aborted-mid-response failures — the
+    apiserver-restart window. HTTPError (a real server response) and
+    timeouts (a hung, not restarting, server) are excluded on purpose."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return False
+    if isinstance(exc, urllib.error.URLError):
+        exc = exc.reason if isinstance(exc.reason, BaseException) else exc
+    if isinstance(exc, TimeoutError):  # socket.timeout is an alias
+        return False
+    return isinstance(exc, (ConnectionRefusedError, ConnectionResetError,
+                            BrokenPipeError, ConnectionAbortedError,
+                            http.client.RemoteDisconnected,
+                            http.client.BadStatusLine))
 
 
 class Client:
@@ -79,6 +103,17 @@ class Client:
                                 code=str(e.code)).inc()
                 self._retry_sleep(self.backoff_delay(
                     attempt, getattr(e, "retry_after_s", None)))
+                attempt += 1
+            except (urllib.error.URLError, http.client.BadStatusLine, OSError) as e:
+                # connection refused/reset while the apiserver restarts:
+                # same jittered schedule, no Retry-After to honor
+                if attempt >= self.max_retries or not is_transient_conn_error(e):
+                    raise
+                from ..runtime.metrics import METRICS  # lazy: import-cycle guard
+
+                METRICS.counter("apiserver_client_retries_total",
+                                code="conn").inc()
+                self._retry_sleep(self.backoff_delay(attempt, None))
                 attempt += 1
 
     # -- verbs --------------------------------------------------------------

@@ -1,0 +1,451 @@
+"""Write-ahead log + snapshots: durable storage under the apiserver Store.
+
+The reference control plane gets durability from etcd: every mutation is
+fsynced to a raft log before the revision is exposed, compaction folds the
+log into snapshots, and a restarted member replays the tail to recover both
+state and the revision counter. This module rebuilds that bottom layer for
+the in-process Store — stdlib-only, one directory on local disk:
+
+- ``WriteAheadLog`` — length-prefixed, crc32-framed journal records,
+  fsynced on append; periodic snapshots written with the checkpointer's
+  disk discipline (``training/checkpoint.py``): tmp file → fsync → rename
+  → fsync parent, newest-complete-wins on recovery, torn tails truncated.
+- ``DurableBackend`` — wraps any storage backend (``DictBackend`` by
+  default) with the Store's backend protocol. Every ``put``/``delete``
+  appends a WAL record and fsyncs **before** the mutation reaches the
+  inner backend, so a resourceVersion is never observable (watch event,
+  list, /healthz) unless it is already durable. On open it recovers
+  bucket state and the monotonic RV counter from the newest complete
+  snapshot plus segment replay, and serves ``journal_since`` from the
+  replayed + live record window so watches and informers resume from
+  their durable RVs across a restart.
+
+Layout of a WAL directory::
+
+    snapshot_<rv>.bin   one framed record: full bucket state as of <rv>
+    wal_<rv>.log        framed mutation records with rv > <rv>
+    _tmp.*              in-flight snapshot droppings, reclaimed on open
+
+A snapshot at rv S is written (tmp+rename) *before* the segment rolls to
+``wal_<S>.log``, so recovery is always "newest complete snapshot + its own
+segment" — a crash between the two leaves the previous pair intact and
+loses nothing. GC keeps the newest ``keep_snapshots`` complete snapshots
+(never fewer than the newest one) and deletes older snapshot/segment pairs.
+
+Frame format (all integers big-endian)::
+
+    [4 bytes payload length][4 bytes crc32(payload)][payload JSON]
+
+A short read or crc mismatch marks the torn tail: everything before it is
+the durable prefix, everything from it on is truncated on open (etcd's
+WAL does the same for a partially-synced final record).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import uuid
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import meta as apimeta
+from .backend import DictBackend, JournalExpired, JournalRecord
+
+_FRAME = struct.Struct(">II")  # payload length, crc32(payload)
+_SNAP_PREFIX = "snapshot_"
+_SEG_PREFIX = "wal_"
+_TMP_PREFIX = "_tmp."
+
+#: records appended between snapshots (APISERVER_WAL_SNAPSHOT_EVERY)
+SNAPSHOT_EVERY_DEFAULT = 4096
+#: in-memory watch-resume window, records (matches the native journal cap)
+JOURNAL_CAP_DEFAULT = 8192
+#: complete snapshots retained by GC (the newest is never deleted)
+KEEP_SNAPSHOTS = 2
+
+#: fsync-dominated: the default 1ms-floor ladder can't resolve an append
+_APPEND_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.5, 1.0)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it are durable."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def encode_frame(payload: bytes) -> bytes:
+    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def scan_frames(data: bytes) -> Tuple[List[bytes], int]:
+    """(payloads, durable_prefix_length) — stops at the first torn or
+    corrupt frame; bytes past the returned offset are the torn tail."""
+    payloads: List[bytes] = []
+    off = 0
+    while off + _FRAME.size <= len(data):
+        length, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        end = start + length
+        if end > len(data):
+            break  # short final record: the crash interrupted the write
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            break  # torn or bit-rotted: nothing past here is trustworthy
+        payloads.append(payload)
+        off = end
+    return payloads, off
+
+
+class WriteAheadLog:
+    """Framed journal segments + snapshots in one directory.
+
+    Opening performs recovery: tmp droppings are reclaimed, the newest
+    *complete* snapshot is chosen (crc-validated, incomplete ones are
+    skipped, not trusted), its segment's torn tail is truncated in place,
+    and the surviving records are exposed as ``base_rv`` / ``state`` /
+    ``tail`` for the caller to rebuild from.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        snapshot_every: int = SNAPSHOT_EVERY_DEFAULT,
+        keep_snapshots: int = KEEP_SNAPSHOTS,
+    ) -> None:
+        self.dir = os.path.abspath(directory)
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.keep_snapshots = max(1, int(keep_snapshots))
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = None  # active segment file handle
+        self._since_snapshot = 0
+        #: recovery surface, consumed by DurableBackend
+        self.base_rv = 0
+        self.state: Optional[Dict[str, Any]] = None
+        self.tail: List[Dict[str, Any]] = []
+        self._recover()
+
+    # -- recovery -------------------------------------------------------------
+
+    def _snapshot_rvs(self) -> List[int]:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(_SNAP_PREFIX) and name.endswith(".bin"):
+                try:
+                    out.append(int(name[len(_SNAP_PREFIX):-len(".bin")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _snap_path(self, rv: int) -> str:
+        return os.path.join(self.dir, f"{_SNAP_PREFIX}{rv}.bin")
+
+    def _seg_path(self, rv: int) -> str:
+        return os.path.join(self.dir, f"{_SEG_PREFIX}{rv}.log")
+
+    def _read_snapshot(self, rv: int) -> Optional[Dict[str, Any]]:
+        """Parse a snapshot file; None unless it is one complete frame."""
+        try:
+            with open(self._snap_path(rv), "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        payloads, good = scan_frames(data)
+        if len(payloads) != 1 or good != len(data):
+            return None  # torn/corrupt: fall through to an older snapshot
+        try:
+            snap = json.loads(payloads[0])
+        except ValueError:
+            return None
+        return snap if snap.get("rv") == rv else None
+
+    def _recover(self) -> None:
+        # reclaim in-flight snapshot droppings from a crashed writer
+        for name in os.listdir(self.dir):
+            if name.startswith(_TMP_PREFIX):
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        # newest complete snapshot wins; incomplete ones are skipped
+        for rv in reversed(self._snapshot_rvs()):
+            snap = self._read_snapshot(rv)
+            if snap is not None:
+                self.base_rv, self.state = rv, snap
+                break
+        # replay the chosen base's segment, truncating any torn tail
+        seg = self._seg_path(self.base_rv)
+        if os.path.exists(seg):
+            with open(seg, "rb") as f:
+                data = f.read()
+            payloads, good = scan_frames(data)
+            if good < len(data):
+                with open(seg, "r+b") as f:
+                    f.truncate(good)
+                    f.flush()
+                    os.fsync(f.fileno())
+            for payload in payloads:
+                try:
+                    rec = json.loads(payload)
+                except ValueError:
+                    continue
+                if rec.get("rv", 0) > self.base_rv:  # dup/stale replay guard
+                    self.tail.append(rec)
+        with self._lock:
+            self._fh = open(seg, "ab")
+        _fsync_dir(self.dir)
+
+    def drop_recovery_state(self) -> None:
+        """Free the recovery surface once the caller has consumed it."""
+        self.state, self.tail = None, []
+
+    # -- append / snapshot ----------------------------------------------------
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """Frame, write, and fsync one record; durable when this returns."""
+        from ..runtime.metrics import METRICS  # lazy: mirrors store.py
+
+        payload = json.dumps(record, separators=(",", ":")).encode()
+        frame = encode_frame(payload)
+        hist = METRICS.histogram("wal_append_seconds", buckets=_APPEND_BUCKETS)
+        start = time.perf_counter()
+        with self._lock:
+            self._fh.write(frame)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._since_snapshot += 1
+        hist.observe(time.perf_counter() - start)
+
+    def should_snapshot(self) -> bool:
+        with self._lock:
+            return self._since_snapshot >= self.snapshot_every
+
+    def snapshot(self, rv: int, objects: List[Tuple[str, str, str, Dict[str, Any]]]) -> None:
+        """Write a snapshot at ``rv``, roll the segment, GC old pairs.
+
+        ``objects`` is the full bucket state: (bucket, ns, name, obj).
+        The snapshot must be durable before the segment rolls — a crash
+        between the two recovers from the *new* snapshot with an empty
+        segment; a crash before the rename recovers from the old pair.
+        """
+        from ..runtime.metrics import METRICS  # lazy: mirrors store.py
+
+        payload = json.dumps(
+            {"rv": rv, "objects": [[b, ns, n, o] for b, ns, n, o in objects]},
+            separators=(",", ":"),
+        ).encode()
+        with self._lock:
+            tmp = os.path.join(self.dir, f"{_TMP_PREFIX}{rv}.{uuid.uuid4().hex}")
+            with open(tmp, "wb") as f:
+                f.write(encode_frame(payload))
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, self._snap_path(rv))
+            _fsync_dir(self.dir)
+            # roll the segment only after the snapshot is durable
+            self._fh.close()
+            self._fh = open(self._seg_path(rv), "ab")
+            _fsync_dir(self.dir)
+            self.base_rv = rv
+            self._since_snapshot = 0
+            self._gc_locked()
+        METRICS.counter("wal_snapshots_total").inc()
+
+    def _gc_locked(self) -> None:
+        """Delete snapshot/segment pairs older than the newest
+        ``keep_snapshots`` *complete* snapshots. The newest complete
+        snapshot is never a deletion candidate — without it the log
+        cannot bound replay."""
+        complete = [rv for rv in self._snapshot_rvs()
+                    if self._read_snapshot(rv) is not None]
+        keep = set(complete[-self.keep_snapshots:])
+        keep.add(self.base_rv)  # the active segment's base stays
+        floor = min(keep)
+        for rv in complete:
+            if rv in keep:
+                continue
+            for path in (self._snap_path(rv), self._seg_path(rv)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+        # stray segments below the retention floor with no snapshot pair
+        # (e.g. wal_0.log from before the first snapshot)
+        for name in os.listdir(self.dir):
+            if not (name.startswith(_SEG_PREFIX) and name.endswith(".log")):
+                continue
+            try:
+                rv = int(name[len(_SEG_PREFIX):-len(".log")])
+            except ValueError:
+                continue
+            if rv < floor and rv not in keep:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class DurableBackend:
+    """WAL-backed storage backend: fsync-before-RV-exposure, snapshot
+    compaction, and restart recovery of state + the monotonic RV counter.
+
+    Wraps an ``inner`` backend (``DictBackend`` unless given) for the
+    in-memory representation; this class owns the RV counter and the
+    watch-resume journal so durability semantics never depend on which
+    inner backend is active.
+    """
+
+    journal_capable = True
+
+    def __init__(
+        self,
+        directory: str,
+        inner=None,
+        snapshot_every: int = SNAPSHOT_EVERY_DEFAULT,
+        journal_cap: int = JOURNAL_CAP_DEFAULT,
+        keep_snapshots: int = KEEP_SNAPSHOTS,
+    ) -> None:
+        from ..runtime.metrics import METRICS  # lazy: mirrors store.py
+
+        self._inner = inner if inner is not None else DictBackend()
+        self._wal = WriteAheadLog(
+            directory, snapshot_every=snapshot_every, keep_snapshots=keep_snapshots
+        )
+        self._lock = threading.Lock()
+        self._journal: deque = deque()
+        self._journal_cap = max(1, int(journal_cap))
+        # --- recover: snapshot state, then replay the segment tail ---
+        rv = self._wal.base_rv
+        self._journal_floor = rv  # resume covers everything after the base
+        if self._wal.state is not None:
+            for bucket, ns, name, obj in self._wal.state.get("objects", []):
+                self._inner.put(bucket, ns, name, obj, 0, "ADDED")
+        replayed = 0
+        for rec in self._wal.tail:
+            rec_rv = int(rec["rv"])
+            if rec["op"] == "DELETED":
+                self._inner.delete(rec["bucket"], rec["ns"], rec["name"],
+                                   rec["obj"], rec_rv)
+            else:
+                self._inner.put(rec["bucket"], rec["ns"], rec["name"],
+                                rec["obj"], rec_rv, rec["op"])
+            self._journal.append(JournalRecord(
+                rec_rv, rec["op"], rec["bucket"], rec["ns"], rec["name"],
+                rec["obj"]))
+            rv = max(rv, rec_rv)
+            replayed += 1
+        self._rv = rv
+        self._wal.drop_recovery_state()
+        if replayed:
+            METRICS.counter("wal_replayed_records_total").inc(replayed)
+
+    # -- rv counter -----------------------------------------------------------
+
+    def next_rv(self) -> int:
+        with self._lock:
+            self._rv += 1
+            return self._rv
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- mutations: WAL first, then the inner backend -------------------------
+
+    def _record(self, rv: int, op: str, bucket: str, ns: str, name: str,
+                obj: Dict[str, Any]) -> None:
+        # fsync happens inside append(): the record is durable before the
+        # inner backend (and so any watcher or reader) can observe the RV
+        self._wal.append(
+            {"rv": rv, "op": op, "bucket": bucket, "ns": ns, "name": name, "obj": obj}
+        )
+        with self._lock:
+            self._rv = max(self._rv, rv)
+            self._journal.append(
+                JournalRecord(rv, op, bucket, ns, name, apimeta.deepcopy(obj)))
+            while len(self._journal) > self._journal_cap:
+                self._journal_floor = self._journal.popleft().rv
+
+    def put(self, bucket: str, ns: str, name: str, obj: Dict[str, Any],
+            rv: int, op: str) -> None:
+        self._record(rv, op, bucket, ns, name, obj)
+        self._inner.put(bucket, ns, name, obj, rv, op)
+        self._maybe_snapshot()
+
+    def delete(self, bucket: str, ns: str, name: str,
+               final_obj: Dict[str, Any], rv: int) -> None:
+        self._record(rv, "DELETED", bucket, ns, name, final_obj)
+        self._inner.delete(bucket, ns, name, final_obj, rv)
+        self._maybe_snapshot()
+
+    def _maybe_snapshot(self) -> None:
+        if not self._wal.should_snapshot():
+            return
+        self.snapshot()
+
+    def snapshot(self) -> None:
+        """Fold current state into a snapshot and truncate the tail."""
+        objects = [(bucket, apimeta.namespace_of(obj), apimeta.name_of(obj), obj)
+                   for bucket, obj in self._inner.list_all()]
+        self._wal.snapshot(self.current_rv(), objects)
+
+    # -- reads delegate to the inner backend ----------------------------------
+
+    def contains(self, bucket: str, ns: str, name: str) -> bool:
+        return self._inner.contains(bucket, ns, name)
+
+    def get(self, bucket: str, ns: str, name: str) -> Optional[Dict[str, Any]]:
+        return self._inner.get(bucket, ns, name)
+
+    def list(
+        self, bucket: str, ns: Optional[str] = None,
+        selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        return self._inner.list(bucket, ns, selector)
+
+    def list_all(self) -> List[Tuple[str, Dict[str, Any]]]:
+        return self._inner.list_all()
+
+    def count(self, bucket: str) -> int:
+        return self._inner.count(bucket)
+
+    # -- watch resume ---------------------------------------------------------
+
+    def journal_since(
+        self, since_rv: int, max_records: int = 0, bucket: Optional[str] = None
+    ) -> List[JournalRecord]:
+        with self._lock:
+            if since_rv < self._journal_floor:
+                raise JournalExpired(
+                    f"journal window expired before rv {since_rv} "
+                    f"(floor: {self._journal_floor})")
+            out = []
+            for rec in self._journal:
+                if rec.rv <= since_rv:
+                    continue
+                if bucket is not None and rec.bucket != bucket:
+                    continue
+                out.append(JournalRecord(
+                    rec.rv, rec.type, rec.bucket, rec.namespace, rec.name,
+                    apimeta.deepcopy(rec.object)))
+                if max_records and len(out) >= max_records:
+                    break
+            return out
+
+    def close(self) -> None:
+        self._wal.close()

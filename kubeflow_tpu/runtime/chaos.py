@@ -40,12 +40,23 @@ ISSUE 13 adds the tenant-abuse kind (pass ``apiserver_url=``):
   gate (apiserver/fairness.py) classifies it. 429s are expected and
   counted, not errors — shedding the flood is the point.
 
+ISSUE 16 adds process-level kinds for the multi-process HA harness (pass
+``procs=``, a mapping of role name → subprocess.Popen or a zero-arg
+callable returning one, so the harness can swap in restarted processes):
+
+- ``kill9_apiserver``  — SIGKILL the apiserver process: no shutdown hook
+  runs, the WAL's durable prefix is all that survives;
+- ``kill9_scheduler``  — SIGKILL a scheduler replica (``target`` selects
+  the procs key, default ``"scheduler"``); the standby must take over the
+  Lease and finish the gang wave.
+
 Every firing bumps ``chaos_faults_injected_total{kind}``.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
@@ -59,7 +70,7 @@ LOG = logging.getLogger(__name__)
 
 KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver",
          "slow_replica", "crash_replica_mid_decode", "client_abandon",
-         "flood_apiserver")
+         "flood_apiserver", "kill9_apiserver", "kill9_scheduler")
 
 #: chaos components stamp Events under this source
 COMPONENT = "chaos-monkey"
@@ -140,6 +151,7 @@ class ChaosMonkey:
         informers: Sequence[Any] = (),
         fleet: Any = None,
         apiserver_url: Optional[str] = None,
+        procs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._client = client
         self._schedule = schedule
@@ -150,6 +162,9 @@ class ChaosMonkey:
         self._fleet = fleet
         #: base URL of a live apiserver — the target of flood_apiserver
         self._apiserver_url = apiserver_url.rstrip("/") if apiserver_url else None
+        #: role name → Popen (or zero-arg callable returning one) for the
+        #: process-level kill9 kinds
+        self._procs = dict(procs or {})
         #: (sent, rejected) tallies of completed floods, for harness asserts
         self.flood_stats: List[Dict[str, int]] = []
         self._stop = threading.Event()
@@ -307,6 +322,30 @@ class ChaosMonkey:
         t = threading.Thread(target=hold, name="chaos-apiserver-delay", daemon=True)
         self._threads.append(t)
         t.start()
+
+    # -- process-level injectors ----------------------------------------------
+    def _kill9_proc(self, key: str) -> None:
+        """SIGKILL the process registered under ``key`` — no signal handler,
+        no atexit, no graceful lease release: the crash the durable control
+        plane must absorb. The entry may be a live Popen or a zero-arg
+        callable resolving to one (harnesses that restart processes)."""
+        import signal
+
+        proc = self._procs.get(key)
+        if proc is None:
+            raise RuntimeError(f"no process registered for {key!r}")
+        if callable(proc) and not hasattr(proc, "pid"):
+            proc = proc()
+        if proc is None or proc.poll() is not None:
+            raise RuntimeError(f"process {key!r} is not running")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10.0)
+
+    def _kill9_apiserver(self, fault: Fault) -> None:
+        self._kill9_proc(fault.target or "apiserver")
+
+    def _kill9_scheduler(self, fault: Fault) -> None:
+        self._kill9_proc(fault.target or "scheduler")
 
     # -- serving injectors ---------------------------------------------------
     def _find_replica(self, target: Optional[str]):

@@ -70,11 +70,15 @@ class LeaderElector:
         renew_deadline: Optional[float] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
+        role: Optional[str] = None,
     ):
         if renew_interval >= lease_duration:
             raise ValueError("renew_interval must be < lease_duration")
         self.client = client
         self.name = name
+        # Metric identity: bootstrap names leases "<role>-leader", so the
+        # default recovers the role for the {role} label series.
+        self.role = role or (name[: -len("-leader")] if name.endswith("-leader") else name)
         self.namespace = namespace
         self.identity = identity or default_identity()
         self.lease_duration = lease_duration
@@ -105,6 +109,9 @@ class LeaderElector:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "LeaderElector":
+        # Register the standby state up front: a scraper must be able to
+        # tell "standby" (0) from "not running an elector at all" (absent).
+        METRICS.gauge("leader_election_state", role=self.role).set(0.0)
         self._thread = threading.Thread(
             target=self._run, name=f"leader-{self.name}", daemon=True
         )
@@ -208,6 +215,9 @@ class LeaderElector:
                 return
             self._leading = leading
             METRICS.gauge("leader_is_leader", lease=self.name).set(1.0 if leading else 0.0)
+            METRICS.gauge("leader_election_state", role=self.role).set(1.0 if leading else 0.0)
+            if leading:
+                METRICS.counter("leader_transitions_total", role=self.role).inc()
             log.info(
                 "leader %s: %s (%s)",
                 self.name,
@@ -254,7 +264,6 @@ class LeaderElector:
         lease = apimeta.deepcopy(lease)
         prev = lease["spec"].get("leaseTransitions", 0) or 0
         lease["spec"] = self._lease_spec(transitions=prev + 1)
-        METRICS.counter("leader_transitions_total", lease=self.name).inc()
         return self.client.update(lease)
 
     def _release(self) -> None:

@@ -7,9 +7,11 @@ ring (410 on compaction), the informer's relist recovery, the client's
 full-jitter retry discipline, and the sharded controller workqueue.
 """
 
+import http.client
 import random
 import threading
 import time
+import urllib.error
 
 import pytest
 
@@ -450,6 +452,74 @@ class TestClientBackoff:
         with pytest.raises(ValueError):
             c.list("v1", "Pod")
         assert store.calls == 1 and sleeps == []
+
+
+class _RefusingStore:
+    """Store stand-in raising transient connection errors n times — the
+    apiserver-restart window as RemoteStore surfaces it."""
+
+    def __init__(self, rejections, exc_factory):
+        self.rejections = rejections
+        self.exc_factory = exc_factory
+        self.calls = 0
+
+    def list(self, res, namespace=None, label_selector=None, field_selector=None):
+        self.calls += 1
+        if self.calls <= self.rejections:
+            raise self.exc_factory()
+        return []
+
+
+class TestTransientConnRetry:
+    """ISSUE 16: the retry discipline must span an apiserver restart —
+    refused/reset connections ride the same jittered schedule as 429/503,
+    while timeouts and real HTTP errors stay fatal."""
+
+    def _client(self, store, **kw):
+        sleeps = []
+        c = Client(store, retry_sleep=sleeps.append,
+                   retry_rng=random.Random(42), **kw)
+        return c, sleeps
+
+    @pytest.mark.parametrize("make_exc", [
+        lambda: urllib.error.URLError(ConnectionRefusedError(111, "refused")),
+        lambda: ConnectionResetError(104, "reset"),
+        lambda: http.client.RemoteDisconnected("closed mid-response"),
+        lambda: http.client.BadStatusLine(""),
+    ], ids=["urlerror-refused", "reset", "remote-disconnected", "bad-status"])
+    def test_restart_window_errors_retry_with_jitter(self, make_exc):
+        store = _RefusingStore(2, make_exc)
+        c, sleeps = self._client(store)
+        assert c.list("v1", "Pod") == []
+        assert store.calls == 3 and len(sleeps) == 2
+        for attempt, d in enumerate(sleeps):
+            assert 0.0 <= d <= min(c.backoff_cap_s,
+                                   c.backoff_base_s * (2.0 ** attempt))
+        assert METRICS.value("apiserver_client_retries_total", code="conn") == 2.0
+
+    def test_timeout_is_not_retried(self):
+        # a hung server is not a restarting one: stacking client timeouts
+        # would park a reconciler past the leader-election deadline
+        store = _RefusingStore(1, lambda: urllib.error.URLError(TimeoutError()))
+        c, sleeps = self._client(store)
+        with pytest.raises(urllib.error.URLError):
+            c.list("v1", "Pod")
+        assert store.calls == 1 and sleeps == []
+
+    def test_http_error_is_not_a_conn_error(self):
+        store = _RefusingStore(1, lambda: urllib.error.HTTPError(
+            "http://x", 500, "boom", {}, None))
+        c, sleeps = self._client(store)
+        with pytest.raises(urllib.error.HTTPError):
+            c.list("v1", "Pod")
+        assert store.calls == 1 and sleeps == []
+
+    def test_dead_apiserver_exhausts_and_reraises(self):
+        store = _RefusingStore(99, lambda: ConnectionRefusedError(111, "refused"))
+        c, sleeps = self._client(store, max_retries=3)
+        with pytest.raises(ConnectionRefusedError):
+            c.list("v1", "Pod")
+        assert store.calls == 4 and len(sleeps) == 3
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,264 @@
+"""WAL + snapshot durability: the crash matrix (ISSUE 16).
+
+Every test here is a crash rehearsal: mutate through the DurableBackend,
+simulate a kill -9 by abandoning the process state (never calling any
+shutdown path), then re-open the same directory and assert the recovered
+world. The matrix the durable control plane must survive:
+
+- a torn final record (crash mid-fsync) is truncated on open,
+- duplicate/stale-RV records replay idempotently,
+- snapshot+tail recovery is byte-identical to pure replay,
+- the RV counter is strictly monotonic across restart,
+- GC never deletes the newest complete snapshot.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.api.meta import new_object
+from kubeflow_tpu.apiserver.backend import JournalExpired
+from kubeflow_tpu.apiserver.client import Client
+from kubeflow_tpu.apiserver.store import Store
+from kubeflow_tpu.apiserver.wal import (
+    DurableBackend,
+    WriteAheadLog,
+    encode_frame,
+    scan_frames,
+)
+from kubeflow_tpu.runtime.metrics import METRICS
+
+
+def mkobj(i, ns="default"):
+    return new_object("v1", "ConfigMap", f"cm-{i:03d}", ns,
+                      data={"k": f"v{i}"})
+
+
+def snapshot_state(backend):
+    """Canonical serialization of full bucket state, for equivalence
+    asserts between differently-recovered backends."""
+    return json.dumps(sorted(
+        (bucket, obj["metadata"].get("namespace", ""), obj["metadata"]["name"], obj)
+        for bucket, obj in backend.list_all()), sort_keys=True)
+
+
+def write_n(backend, n, start=0):
+    """Drive n creates through the backend the way the Store would."""
+    for i in range(start, start + n):
+        obj = mkobj(i)
+        rv = backend.next_rv()
+        obj["metadata"]["resourceVersion"] = str(rv)
+        backend.put("v1/configmaps", "default", obj["metadata"]["name"],
+                    obj, rv, "ADDED")
+
+
+class TestFraming:
+    def test_roundtrip(self):
+        frames = b"".join(encode_frame(json.dumps({"i": i}).encode())
+                          for i in range(5))
+        payloads, good = scan_frames(frames)
+        assert [json.loads(p)["i"] for p in payloads] == list(range(5))
+        assert good == len(frames)
+
+    def test_short_tail_marks_durable_prefix(self):
+        whole = encode_frame(b'{"a":1}')
+        torn = encode_frame(b'{"b":2}')[:-3]  # crash mid-write
+        payloads, good = scan_frames(whole + torn)
+        assert payloads == [b'{"a":1}']
+        assert good == len(whole)
+
+    def test_crc_mismatch_stops_scan(self):
+        whole = encode_frame(b'{"a":1}')
+        rotted = bytearray(encode_frame(b'{"b":2}'))
+        rotted[-1] ^= 0xFF  # bit rot inside the payload
+        payloads, good = scan_frames(whole + bytes(rotted) + encode_frame(b'{"c":3}'))
+        # nothing past the corrupt frame is trustworthy, even a valid frame
+        assert payloads == [b'{"a":1}']
+        assert good == len(whole)
+
+
+class TestCrashMatrix:
+    def test_torn_final_record_truncated_on_open(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=10_000)
+        write_n(b, 3)
+        b.close()
+        seg = os.path.join(d, "wal_0.log")
+        intact = os.path.getsize(seg)
+        with open(seg, "ab") as f:  # kill -9 mid-append: half a frame
+            f.write(encode_frame(b'{"rv":99,"op":"ADDED"}')[: -5])
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        assert os.path.getsize(seg) == intact, "torn tail must be truncated"
+        assert b2.current_rv() == 3
+        assert len(b2.list("v1/configmaps")) == 3
+
+    def test_duplicate_rv_replay_is_idempotent(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=2)  # snapshots at rv 2, 4
+        write_n(b, 5)
+        b.close()
+        base = max(int(n[len("snapshot_"):-len(".bin")])
+                   for n in os.listdir(d) if n.startswith("snapshot_"))
+        # a retried writer duplicated an already-snapshotted record into the
+        # live segment: replay must skip records at/below the snapshot base
+        stale = {"rv": base, "op": "ADDED", "bucket": "v1/configmaps",
+                 "ns": "default", "name": "cm-000",
+                 "obj": mkobj(0) | {"metadata": {"name": "cm-000",
+                                                 "namespace": "default",
+                                                 "resourceVersion": "1"}}}
+        with open(os.path.join(d, f"wal_{base}.log"), "ab") as f:
+            f.write(encode_frame(json.dumps(stale).encode()))
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        assert b2.current_rv() == 5
+        objs = b2.list("v1/configmaps")
+        assert len(objs) == 5
+        by_name = {o["metadata"]["name"]: o for o in objs}
+        # the stale duplicate did not clobber the snapshotted object
+        assert by_name["cm-000"]["data"] == {"k": "v0"}
+
+    def test_snapshot_plus_tail_equals_pure_replay(self, tmp_path):
+        da, db = str(tmp_path / "a"), str(tmp_path / "b")
+        compacting = DurableBackend(da, snapshot_every=3)
+        replay_only = DurableBackend(db, snapshot_every=10_000)
+        for b in (compacting, replay_only):
+            write_n(b, 8)
+            # a delete mid-stream: the tombstone must survive either path
+            rv = b.next_rv()
+            b.delete("v1/configmaps", "default", "cm-002", mkobj(2), rv)
+            write_n(b, 2, start=8)
+            b.close()
+        assert any(n.startswith("snapshot_") for n in os.listdir(da))
+        assert not any(n.startswith("snapshot_") for n in os.listdir(db))
+        ra = DurableBackend(da, snapshot_every=10_000)
+        rb = DurableBackend(db, snapshot_every=10_000)
+        assert snapshot_state(ra) == snapshot_state(rb)
+        assert ra.current_rv() == rb.current_rv() == 11
+        assert ra.get("v1/configmaps", "default", "cm-002") is None
+
+    def test_rv_strictly_monotonic_across_restart(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=4)
+        write_n(b, 6)
+        pre_crash = b.current_rv()
+        b.close()
+        b2 = DurableBackend(d, snapshot_every=4)
+        assert b2.current_rv() == pre_crash
+        minted = b2.next_rv()
+        assert minted == pre_crash + 1, "a recovered counter must never reuse an RV"
+
+    def test_rv_recovers_from_snapshot_alone(self, tmp_path):
+        """Crash right after a snapshot (empty tail): the counter comes
+        from the snapshot rv, not from replayed records."""
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=10_000)
+        write_n(b, 4)
+        b.snapshot()  # folds everything; segment rolls to wal_4.log (empty)
+        b.close()
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        assert b2.current_rv() == 4
+        assert b2.next_rv() == 5
+
+    def test_gc_never_deletes_newest_complete_snapshot(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=2, keep_snapshots=2)
+        write_n(b, 20)
+        snaps = sorted(int(n[len("snapshot_"):-len(".bin")])
+                       for n in os.listdir(d) if n.startswith("snapshot_"))
+        assert len(snaps) <= 2, "GC must bound retained snapshots"
+        assert snaps and snaps[-1] == b._wal.base_rv
+        # pre-first-snapshot stray segment reclaimed too
+        assert not os.path.exists(os.path.join(d, "wal_0.log"))
+        b.close()
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        assert b2.current_rv() == 20
+        assert len(b2.list("v1/configmaps")) == 20
+
+    def test_incomplete_newest_snapshot_falls_back_to_older(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=3, keep_snapshots=3)
+        write_n(b, 9)
+        b.close()
+        snaps = sorted(int(n[len("snapshot_"):-len(".bin")])
+                       for n in os.listdir(d) if n.startswith("snapshot_"))
+        newest = snaps[-1]
+        path = os.path.join(d, f"snapshot_{newest}.bin")
+        with open(path, "r+b") as f:  # crash tore the newest snapshot
+            f.truncate(os.path.getsize(path) - 4)
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        # an older complete snapshot + ITS OWN longer segment still covers
+        # everything: no object and no rv may be lost
+        assert b2.current_rv() == 9
+        assert len(b2.list("v1/configmaps")) == 9
+
+
+class TestDurableStoreIntegration:
+    def test_store_recovers_objects_and_watch_window(self, tmp_path):
+        d = str(tmp_path)
+        store = Store(backend=DurableBackend(d, snapshot_every=10_000))
+        client = Client(store)
+        for i in range(5):
+            client.create(mkobj(i))
+        client.delete("v1", "ConfigMap", "cm-001", "default")
+        rv = store.backend.current_rv()
+        store.backend.close()
+
+        recovered = Store(backend=DurableBackend(d, snapshot_every=10_000))
+        c2 = Client(recovered)
+        names = {o["metadata"]["name"] for o in c2.list("v1", "ConfigMap", "default")}
+        assert names == {f"cm-{i:03d}" for i in range(5)} - {"cm-001"}
+        assert recovered.backend.current_rv() == rv
+        # journal survives: a resume from mid-stream sees the tombstone
+        recs = recovered.backend.journal_since(2)
+        assert any(r.type == "DELETED" and r.name == "cm-001" for r in recs)
+        # and fresh writes mint strictly newer RVs
+        created = c2.create(mkobj(99))
+        assert int(created["metadata"]["resourceVersion"]) > rv
+
+    def test_journal_floor_raises_expired_below_snapshot(self, tmp_path):
+        d = str(tmp_path)
+        b = DurableBackend(d, snapshot_every=10_000)
+        write_n(b, 8)
+        b.snapshot()
+        b.close()
+        b2 = DurableBackend(d, snapshot_every=10_000)
+        # resume below the snapshot base: the log cannot reconstruct that
+        # window — the informer must take the 410 → paginated relist path
+        with pytest.raises(JournalExpired):
+            b2.journal_since(3)
+        assert b2.journal_since(8) == []
+
+    def test_wal_append_metric_observed(self, tmp_path):
+        b = DurableBackend(str(tmp_path), snapshot_every=10_000)
+        write_n(b, 3)
+        assert METRICS.quantile("wal_append_seconds", 0.5) is not None
+        b.snapshot()
+        assert METRICS.value("wal_snapshots_total") == 1.0
+        b.close()
+        DurableBackend(str(tmp_path), snapshot_every=10_000).close()
+        # snapshot folded everything: replay counter only counts tail records
+        assert METRICS.value("wal_replayed_records_total") == 0.0
+
+
+class TestChaosKill9:
+    def test_kill9_delivers_sigkill_and_reaps(self):
+        import subprocess
+        import sys
+
+        from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+
+        proc = subprocess.Popen([sys.executable, "-c",
+                                 "import time; time.sleep(60)"])
+        monkey = ChaosMonkey(None, ChaosSchedule([]),
+                             procs={"apiserver": lambda: proc})
+        monkey.inject(Fault(at=0.0, kind="kill9_apiserver"))
+        assert proc.poll() == -9, "SIGKILL, not a catchable signal"
+        assert METRICS.value("chaos_faults_injected_total",
+                             kind="kill9_apiserver") == 1.0
+
+    def test_kill9_unknown_target_is_skipped_not_fatal(self):
+        from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+
+        monkey = ChaosMonkey(None, ChaosSchedule([]), procs={})
+        monkey.inject(Fault(at=0.0, kind="kill9_scheduler"))  # logged, skipped
+        assert monkey.fired == []

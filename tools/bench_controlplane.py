@@ -24,6 +24,12 @@ Three stages per run:
    HTTP path: ``bind_latency_p99_s_under_abuse`` (gang waves keep binding)
    and ``apiserver_rejected_fraction_lowpri`` (the flood is shed with
    429s) are the gated rows.
+5. **Failover (ISSUE 16)** — the durable stack (Store on the WAL-backed
+   ``DurableBackend``) with two scheduler replicas under leader election;
+   each cycle crashes the active replica mid-wave and times kill → last
+   bind under the standby. Gated rows: ``failover_to_bind_p99_s``,
+   ``recovery_replay_seconds`` (re-open of the accumulated WAL), and
+   ``wal_append_p99_ms`` (the fsync-before-RV write tax).
 
 Usage::
 
@@ -225,6 +231,108 @@ def run_abuse(topology, gangs: int, flood_s: float,
         httpd.close()
 
 
+def run_failover(topology, cycles: int, seed: int = SEED) -> Dict[str, Any]:
+    """Stage 5: active/standby scheduler replicas over a WAL-durable Store;
+    each cycle SIGKILL-equivalently crashes the active replica (elector
+    stopped without releasing the Lease — the standby must wait out the
+    TTL), submits a gang, and times crash → gang fully bound. Afterwards the
+    accumulated WAL is re-opened cold to time recovery replay."""
+    import shutil
+    import tempfile
+
+    from kubeflow_tpu.apiserver.client import Client
+    from kubeflow_tpu.apiserver.server import make_apiserver_app
+    from kubeflow_tpu.apiserver.store import Store
+    from kubeflow_tpu.apiserver.wal import DurableBackend
+    from kubeflow_tpu.controllers.builtin import PodletReconciler
+    from kubeflow_tpu.runtime.leader import LeaderElector
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.scale.loadgen import LoadGenerator
+    from kubeflow_tpu.scale.topology import synth_gangs
+    from kubeflow_tpu.scheduler import SchedulerReconciler
+
+    METRICS.reset()
+    wal_dir = tempfile.mkdtemp(prefix="bench-fo-wal-")
+    # no compaction during the run: the cold re-open replays every record,
+    # which is exactly what recovery_replay_seconds prices
+    backend = DurableBackend(wal_dir, snapshot_every=1_000_000)
+    store = Store(backend=backend)
+    client = Client(store, event_retention=4096)
+    for node in topology.nodes():
+        client.create(node)
+    app = make_apiserver_app(store)
+    httpd = app.serve(0)
+    base = f"http://127.0.0.1:{httpd.port}"
+
+    def replica(tag: str) -> LeaderElector:
+        mgr = Manager(store)
+        mgr.add(SchedulerReconciler(
+            assembly_timeout=10.0, reservation_ttl=5.0,
+            backoff_base=0.05, backoff_cap=0.5))
+        mgr.add(PodletReconciler())
+        return LeaderElector(
+            Client(store), "bench-scheduler-leader", identity=tag,
+            lease_duration=1.0, renew_interval=0.1, retry_interval=0.1,
+            on_started_leading=mgr.start, on_stopped_leading=mgr.stop)
+
+    electors = {tag: replica(tag).start() for tag in ("a", "b")}
+
+    def active_tag() -> str:
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            for tag, e in electors.items():
+                if e.is_leader:
+                    return tag
+            time.sleep(0.02)
+        raise RuntimeError("no replica won the bench lease")
+
+    times: list = []
+    try:
+        gen = LoadGenerator(base, topology, seed=seed)
+        warm = synth_gangs(topology, 1, seed=seed - 1, prefix="fowarm",
+                           max_size=2)
+        gen.gang_wave(warm)
+        gen.wait_gangs_bound([s.name for s in warm], timeout_s=90.0)
+        for i in range(cycles):
+            victim = active_tag()
+            t0 = time.perf_counter()
+            # crash, not graceful handover: the lease is left to expire
+            electors[victim].stop(release=False)
+            shapes = synth_gangs(topology, 1, seed=seed + i,
+                                 prefix=f"fo{i}", max_size=4)
+            gen.gang_wave(shapes)
+            gen.wait_gangs_bound([s.name for s in shapes], timeout_s=60.0)
+            times.append(time.perf_counter() - t0)
+            # the crashed replica rejoins as the new standby
+            electors[victim] = replica(victim).start()
+    finally:
+        for e in electors.values():
+            e.stop()
+        httpd.close()
+
+    appends = METRICS.histogram_counts("wal_append_seconds")
+    wal_append_p99_ms = (METRICS.quantile("wal_append_seconds", 0.99) or 0.0) * 1000.0
+    backend.close()
+    replayed_before = METRICS.value("wal_replayed_records_total")
+    t0 = time.perf_counter()
+    reopened = DurableBackend(wal_dir, snapshot_every=1_000_000)
+    recovery_replay_s = time.perf_counter() - t0
+    reopened.close()
+    shutil.rmtree(wal_dir, ignore_errors=True)
+    times.sort()
+    return {
+        "failover_p99_s": times[min(len(times) - 1, int(0.99 * len(times)))],
+        "failover_p50_s": times[len(times) // 2],
+        "recovery_replay_s": recovery_replay_s,
+        "wal_append_p99_ms": wal_append_p99_ms,
+        "wal_appends": appends[2] if appends else 0,
+        "wal_records_replayed": int(
+            METRICS.value("wal_replayed_records_total") - replayed_before),
+        "cycles": len(times),
+    }
+
+
 def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
                storm_streams: int, storm_relists: int,
                flagship: bool) -> Dict[str, float]:
@@ -263,7 +371,20 @@ def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
          flood=abuse["flood"])
     emit(f"apiserver_rejected_fraction_lowpri{suffix}", abuse["rejected_fraction"],
          nodes=topo.total_nodes, flood=abuse["flood"])
-    return {
+
+    failover: Dict[str, Any] = {}
+    if flagship:
+        # failover latency is lease-TTL-bound, not topology-bound: one
+        # flagship row is the gate, smaller sizes skip the stage
+        failover = run_failover(topo, cycles=5)
+        emit("failover_to_bind_p99_s", failover["failover_p99_s"],
+             nodes=topo.total_nodes, cycles=failover["cycles"],
+             p50_s=round(failover["failover_p50_s"], 3))
+        emit("recovery_replay_seconds", failover["recovery_replay_s"],
+             records=failover["wal_records_replayed"])
+        emit("wal_append_p99_ms", failover["wal_append_p99_ms"],
+             appends=failover["wal_appends"])
+    out = {
         f"scheduler_cycles_per_sec{suffix}": round(indexed, 2),
         f"scheduler_cycles_per_sec_fullscan{suffix}": round(fullscan, 2),
         f"controlplane_index_speedup_x{suffix}": round(indexed / max(fullscan, 1e-9), 2),
@@ -273,6 +394,11 @@ def bench_size(num_nodes: int, tag: str, duration_s: float, gangs: int,
         f"bind_latency_p99_s_under_abuse{suffix}": round(abuse["bind_p99_abuse_s"], 4),
         f"apiserver_rejected_fraction_lowpri{suffix}": round(abuse["rejected_fraction"], 4),
     }
+    if failover:
+        out["failover_to_bind_p99_s"] = round(failover["failover_p99_s"], 4)
+        out["recovery_replay_seconds"] = round(failover["recovery_replay_s"], 4)
+        out["wal_append_p99_ms"] = round(failover["wal_append_p99_ms"], 4)
+    return out
 
 
 def main(argv: Optional[list] = None) -> int:

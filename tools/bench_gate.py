@@ -68,7 +68,13 @@ SPECS: Dict[str, Tuple[str, float]] = {
     "scheduler_cycles_per_sec_fullscan": ("higher", 0.35),
     "controlplane_index_speedup_x": ("higher", 0.35),
     "bind_latency_p99_s": ("lower", 0.50),
-    "bind_latency_p50_s": ("lower", 0.50),
+    # p50 interpolates a coarse sub-second bucket ladder over tens of binds:
+    # the committed history's own round-to-round band (r01: 0.67, r02: 0.21,
+    # r03: 0.75 at 1k, no scheduler change between) spans 3.5x, and
+    # best-of-earlier would ratchet on the luckiest draw forever. The wide
+    # band still catches the seconds-scale p50 a real binding stall produces;
+    # p99 above keeps the tight 50% band as the latency SLI.
+    "bind_latency_p50_s": ("lower", 3.00),
     # storm list p99 is interpolated from the apiserver_request_seconds
     # histogram's coarse sub-10ms buckets; at 1-5 ms absolute the committed
     # history's own noise spans adjacent bucket edges (r01: 4.19 ms at 1k vs
@@ -83,6 +89,14 @@ SPECS: Dict[str, Tuple[str, float]] = {
     # wide band because burst/seat phase alignment wobbles run to run.
     "bind_latency_p99_s_under_abuse": ("lower", 0.50),
     "apiserver_rejected_fraction_lowpri": ("higher", 0.50),
+    # ISSUE-16 durability rows (tools/bench_controlplane.py stage 5):
+    # failover is lease-TTL-dominated (1s lease + bind), with scheduling
+    # phase alignment wobble; recovery replay is a cold re-open of a few
+    # thousand fsynced records; the append p99 is raw fsync latency, which
+    # swings wildly with host disk contention — widest band of the three.
+    "failover_to_bind_p99_s": ("lower", 0.50),
+    "recovery_replay_seconds": ("lower", 0.50),
+    "wal_append_p99_ms": ("lower", 1.00),
 }
 
 #: summary-line keys lifted into standalone metrics (the final bench line
