@@ -172,33 +172,50 @@ def _bench(batch: int):
         return run_steps
 
     # BENCH_FUSED: 1 = Pallas fused bottlenecks, 0 = XLA composite,
-    # auto (default) = short head-to-head, keep the winner. Auto because
-    # the acceptance bar is "never slower than the composite" and BASELINE
-    # round 5 measured the kernel BEHIND XLA on the tunneled dev backend —
-    # the bench measures instead of assuming.
+    # auto (default) = measured head-to-head via the autotune sweep, keep
+    # the winner. Auto because the acceptance bar is "never slower than the
+    # composite" and BASELINE round 5 measured the kernel BEHIND XLA on the
+    # tunneled dev backend — the bench measures instead of assuming.
+    # BENCH_AUTOTUNE=0 skips the measurement and pins the backend default.
     fused_mode = os.environ.get("BENCH_FUSED", "auto")
+    autotune_on = os.environ.get("BENCH_AUTOTUNE", "1") != "0"
     calibration = None
+    autotune_row = None
     if fused_mode in ("0", "1"):
         use_fused = fused_mode == "1"
+        autotune_row = {"family": "resnet",
+                        "chosen": {"fused_blocks": use_fused},
+                        "pinned": f"BENCH_FUSED={fused_mode}"}
+    elif not autotune_on:
+        use_fused = jax.default_backend() == "tpu"
     else:
+        from kubeflow_tpu.training.autotune import sweep as _autotune_sweep
+
         calib_steps = max(4, min(10, timed_steps))
+
+        def _measure(knobs):
+            run = make_window(knobs["fused_blocks"], calib_steps)
+            loss, cs = run(state, images, labels)  # compile + warmup
+            _ = (float(loss), float(cs))
+            t0 = time.perf_counter()
+            loss, cs = run(state, images, labels)
+            _ = (float(loss), float(cs))
+            return (time.perf_counter() - t0) / calib_steps
+
+        result = _autotune_sweep(
+            "resnet",
+            [{"fused_blocks": False}, {"fused_blocks": True}],
+            measure=_measure, log=lambda s: print(s, file=sys.stderr))
+        use_fused = bool(result.chosen["fused_blocks"])
+        autotune_row = result.to_row()
+        # legacy row shape, kept for cross-round history comparisons
         calibration = {}
-        for fused in (False, True):
-            key = "fused" if fused else "unfused"
-            try:
-                run = make_window(fused, calib_steps)
-                loss, cs = run(state, images, labels)  # compile + warmup
-                _ = (float(loss), float(cs))
-                t0 = time.perf_counter()
-                loss, cs = run(state, images, labels)
-                _ = (float(loss), float(cs))
-                calibration[key] = round(
-                    (time.perf_counter() - t0) / calib_steps, 6)
-            except Exception as e:  # kernel path broken ≠ bench broken
-                calibration[key] = None
-                calibration[f"{key}_error"] = str(e)[:120]
-        f, u = calibration.get("fused"), calibration.get("unfused")
-        use_fused = f is not None and (u is None or f <= u)
+        for c in result.candidates:
+            key = "fused" if c.knobs["fused_blocks"] else "unfused"
+            calibration[key] = (round(c.measured_seconds, 6)
+                                if c.measured_seconds is not None else None)
+            if c.error:
+                calibration[f"{key}_error"] = c.error[:120]
 
     clock = StepClock(tracer=TRACER)
     run_steps = make_window(use_fused, timed_steps)
@@ -285,6 +302,7 @@ def _bench(batch: int):
         "flops_per_step": flops,
         "fused_blocks": use_fused,
         "fused_calibration": calibration,
+        "autotune": autotune_row,
         "step_breakdown": _step_breakdown(clock, timed_steps),
         "peak_hbm_bytes": (mem or {}).get("peak_hbm_bytes"),
         "memory": mem,
@@ -311,41 +329,111 @@ def _bench_gpt(batch: int, seq: int):
     # compare): scan_blocks compiles one block instead of 24 unrolled;
     # the blockwise loss never materializes the [b, L, 32000] f32 logits
     # (1 GiB at b8/L1024 — THE cap on benchable batch before this).
-    scan_blocks = os.environ.get("BENCH_GPT_SCAN", "1") == "1"
+    # With BENCH_AUTOTUNE on (default), unpinned remat/scan knobs are
+    # swept by training.autotune: priced first (AOT compile, no steps),
+    # survivors measured with short windows, the winner drives the run.
+    scan_env = os.environ.get("BENCH_GPT_SCAN")
+    remat_env = os.environ.get("BENCH_REMAT")
     fused_loss = os.environ.get("BENCH_FUSED_LOSS", "1") == "1"
-    cfg = GptConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
-                    max_seq=seq, vocab_size=32000,
-                    remat=os.environ.get("BENCH_REMAT", "0") == "1",
-                    scan_blocks=scan_blocks)
-    model = GptLM(cfg)
+    autotune_on = os.environ.get("BENCH_AUTOTUNE", "1") != "0"
     rng = jax.random.PRNGKey(0)
-    ids = jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size)
-    params = model.init(rng, ids)["params"]
+    ids = jax.random.randint(rng, (batch, seq), 0, 32000)
     opt = _optax.adamw(3e-4, weight_decay=0.01)
-    opt_state = opt.init(params)
     timed_steps = _timed_steps()
 
-    def loss_fn(p, ids):
-        if fused_loss:
-            hidden = model.apply({"params": p}, ids, return_hidden=True)
-            return blockwise_causal_lm_loss(
-                hidden, p["embedding"]["embedding"], ids)
-        return causal_lm_loss(model.apply({"params": p}, ids), ids)
+    def make_cfg(scan_blocks, remat):
+        return GptConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+                         max_seq=seq, vocab_size=32000,
+                         remat=remat, scan_blocks=scan_blocks)
 
-    def train_step(params, opt_state, ids):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return _optax.apply_updates(params, updates), opt_state, loss
+    def build(cfg):
+        model = GptLM(cfg)
 
-    @jax.jit
-    def run_steps(params, opt_state, ids):
-        def body(carry, _):
-            p, s = carry
-            p, s, loss = train_step(p, s, ids)
-            return (p, s), loss
-        (p, s), losses = jax.lax.scan(body, (params, opt_state), None, length=timed_steps)
-        checksum = sum(jnp.sum(x.astype(jnp.float32)) for x in jax.tree_util.tree_leaves(p))
-        return losses[-1], checksum
+        def loss_fn(p, ids):
+            if fused_loss:
+                hidden = model.apply({"params": p}, ids, return_hidden=True)
+                return blockwise_causal_lm_loss(
+                    hidden, p["embedding"]["embedding"], ids)
+            return causal_lm_loss(model.apply({"params": p}, ids), ids)
+
+        def train_step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return _optax.apply_updates(params, updates), opt_state, loss
+
+        def make_run(n):
+            def run_steps(params, opt_state, ids):
+                def body(carry, _):
+                    p, s = carry
+                    p, s, loss = train_step(p, s, ids)
+                    return (p, s), loss
+                (p, s), losses = jax.lax.scan(
+                    body, (params, opt_state), None, length=n)
+                checksum = sum(jnp.sum(x.astype(jnp.float32))
+                               for x in jax.tree_util.tree_leaves(p))
+                return losses[-1], checksum
+            return run_steps
+
+        return model, train_step, make_run
+
+    default_knobs = {"scan_blocks": scan_env != "0" if scan_env is not None
+                     else True,
+                     "remat": remat_env == "1"}
+    autotune_row = None
+    if autotune_on and (scan_env is None or remat_env is None):
+        from kubeflow_tpu.training.attribution import price_callable
+        from kubeflow_tpu.training.autotune import sweep as _autotune_sweep
+
+        scan_opts = ([scan_env == "1"] if scan_env is not None
+                     else [True, False])
+        remat_opts = ([remat_env == "1"] if remat_env is not None
+                      else [False, True])
+        candidates = [{"scan_blocks": sb, "remat": rm}
+                      for sb in scan_opts for rm in remat_opts]
+        if scan_env is None and remat_env is None:
+            # remat-without-scan compiles 24 unrolled remat blocks for a
+            # config the scanned one dominates — not worth the compile.
+            candidates = [c for c in candidates
+                          if not (c["remat"] and not c["scan_blocks"])]
+        calib_steps = max(2, min(4, timed_steps))
+
+        def _price(knobs):
+            model_c, step_c, _ = build(make_cfg(**knobs))
+            p_s = jax.eval_shape(model_c.init, rng, ids)["params"]
+            o_s = jax.eval_shape(opt.init, p_s)
+            return price_callable(
+                step_c, p_s, o_s, ids, name="gpt_bench",
+                kind="model", train_factor=1.0).est_seconds
+
+        def _measure(knobs):
+            model_c, _, make_run_c = build(make_cfg(**knobs))
+            p = model_c.init(rng, ids)["params"]
+            o = opt.init(p)
+            run = jax.jit(make_run_c(calib_steps))
+            out = run(p, o, ids)  # compile + warmup
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            out = run(p, o, ids)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / calib_steps
+
+        result = _autotune_sweep(
+            "gpt", candidates, measure=_measure, price=_price, keep=2,
+            log=lambda s: print(s, file=sys.stderr))
+        chosen = dict(default_knobs)
+        chosen.update(result.chosen)
+        autotune_row = result.to_row()
+    else:
+        chosen = default_knobs
+        autotune_row = {"family": "gpt", "chosen": dict(chosen),
+                        "pinned": "env"}
+
+    scan_blocks = bool(chosen["scan_blocks"])
+    cfg = make_cfg(scan_blocks, bool(chosen["remat"]))
+    model, train_step, make_run = build(cfg)
+    params = model.init(rng, ids)["params"]
+    opt_state = opt.init(params)
+    run_steps = jax.jit(make_run(timed_steps))
 
     clock = StepClock(tracer=TRACER)
     # FLOPs numerator from the REFERENCE path (unrolled blocks, plain
@@ -357,7 +445,10 @@ def _bench_gpt(batch: int, seq: int):
     try:
         import dataclasses as _dc
 
-        ref_model = GptLM(_dc.replace(cfg, scan_blocks=False))
+        # remat=False too: rematerialized flops are recompute, not model
+        # work — counting them would inflate the numerator when the
+        # autotuner picks a remat config.
+        ref_model = GptLM(_dc.replace(cfg, scan_blocks=False, remat=False))
 
         def ref_step(params, opt_state, ids):
             loss, grads = jax.value_and_grad(
@@ -441,7 +532,9 @@ def _bench_gpt(batch: int, seq: int):
         "batch": batch,
         "seq": seq,
         "scan_blocks": scan_blocks,
+        "remat": cfg.remat,
         "fused_loss": fused_loss,
+        "autotune": autotune_row,
         "step_breakdown": _step_breakdown(clock, timed_steps),
         "peak_hbm_bytes": (mem or {}).get("peak_hbm_bytes"),
         "memory": mem,
@@ -682,6 +775,7 @@ def _run_resnet(platform: str) -> dict:
                 "window_mfus": r.get("window_mfus"),
                 "fused_blocks": r.get("fused_blocks"),
                 "fused_calibration": r.get("fused_calibration"),
+                "autotune": r.get("autotune"),
                 "step_breakdown": r.get("step_breakdown"),
                 "peak_hbm_bytes": r.get("peak_hbm_bytes"),
                 "attribution": r.get("attribution"),
@@ -710,7 +804,9 @@ def _run_gpt(platform: str, allow_legacy_batch: bool = False) -> dict:
             "batch": r["batch"], "seq": r["seq"],
             "window_mfus": r.get("window_mfus"),
             "scan_blocks": r.get("scan_blocks"),
+            "remat": r.get("remat"),
             "fused_loss": r.get("fused_loss"),
+            "autotune": r.get("autotune"),
             "step_breakdown": r.get("step_breakdown"),
             "peak_hbm_bytes": r.get("peak_hbm_bytes"),
             "attribution": r.get("attribution"),

@@ -283,6 +283,23 @@ def bench_regression() -> Dict:
     return b.build()
 
 
+def autotune_smoke() -> Dict:
+    """The autotuner job: training/autotune's quick sweep end-to-end on CPU
+    (price → prune → measure → choose, both the ResNet fused-set sweep and
+    the GPT remat/scan grid), plus the sweep-engine and FSDP gather-mode
+    unit suites — the overlap/eager parity check runs on 8 forced host
+    devices, the same topology the bench's multi-device sweep tunes."""
+    b = WorkflowBuilder("autotune-smoke")
+    b.run("autotune-quick",
+          ["python", "-m", "kubeflow_tpu.training.autotune",
+           "--quick", "--family", "all"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("autotune-unit", "tests/test_autotune.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("fsdp-unit", "tests/test_fsdp.py", env=EIGHT_DEVICE_ENV)
+    return b.build()
+
+
 def attribution_e2e() -> Dict:
     """The attribution-plane job: a live StepClock train loop served over
     real HTTP — /debug/profile must return Perfetto-loadable Chrome-trace
@@ -371,6 +388,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "elastic-e2e": elastic_e2e,
     "platlint": platlint,
     "bench-regression": bench_regression,
+    "autotune-smoke": autotune_smoke,
     "attribution-e2e": attribution_e2e,
     "monitoring-e2e": monitoring_e2e,
     "trace-federation-e2e": trace_federation_e2e,

@@ -65,16 +65,20 @@ class _FoldedNorm(nn.Module):
 class BottleneckBlock(nn.Module):
     """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut when needed.
 
-    ``fused=True`` routes eligible applications (stride 1, identity
-    shortcut, spatial size a multiple of 8) through the Pallas
-    ``fused_bottleneck`` kernel: the whole block runs as MXU matmuls with
+    ``fused=True`` routes every square-input application through a Pallas
+    kernel: identity-shortcut blocks through ``fused_bottleneck`` (any
+    spatial size — non-8-aligned rows go through sublane-padded dots) and
+    the stage heads (stride-2 and/or projection shortcut) through
+    ``fused_transition``. The whole block runs as MXU matmuls with
     activations resident in VMEM, norms folded from the running statistics
     ("frozen norm" — matches the unfused path exactly in eval mode; in
     train mode fused blocks normalize by running stats instead of batch
     stats and do not update them). Backward stays XLA
-    (ops.fused_bottleneck_block). Ineligible applications (downsampling
-    head blocks) silently keep the unfused path; both paths declare an
-    identical variable tree.
+    (ops.fused_bottleneck_block / fused_transition_block). The rare
+    leftover shapes (non-square, odd strided inputs) take the epilogue-
+    fused XLA ``folded_bottleneck`` path and tick
+    ``ops_fused_fallback_total``; all paths declare an identical variable
+    tree, so checkpoints move freely between fused and unfused models.
     """
 
     filters: int
@@ -85,13 +89,40 @@ class BottleneckBlock(nn.Module):
     fused: bool = False
 
     def _fusable(self, x) -> bool:
+        """Identity-shortcut Pallas kernel eligibility (stride 1, square)."""
         return (
             self.strides == (1, 1)
             and x.ndim == 4
             and x.shape[-1] == self.filters * 4
             and x.shape[1] == x.shape[2]
-            and x.shape[1] % 8 == 0
+            and x.shape[1] >= 4
         )
+
+    def _fusable_transition(self, x) -> bool:
+        """Transition-block Pallas kernel eligibility: a stage head (needs a
+        projection shortcut for channels and/or stride), square input,
+        stride in {1, 2}; stride 2 needs an even spatial dim (SAME pad is
+        then (0, 1), which the kernel's strided im2col reproduces)."""
+        if not (x.ndim == 4 and x.shape[1] == x.shape[2] and x.shape[1] >= 4):
+            return False
+        if self.strides == (1, 1):
+            return x.shape[-1] != self.filters * 4  # stride-1 channel head
+        return self.strides == (2, 2) and x.shape[1] % 2 == 0
+
+    def _fused_params(self, cin: int, cmid: int, cout: int, proj: bool):
+        w1 = _ConvKernel((1, 1, cin, cmid), name="conv1")()
+        s1, b1 = _FoldedNorm(cmid, name="bn1")()
+        w2 = _ConvKernel((3, 3, cmid, cmid), name="conv2")()
+        s2, b2 = _FoldedNorm(cmid, name="bn2")()
+        w3 = _ConvKernel((1, 1, cmid, cout), name="conv3")()
+        # Zero-init bn3's scale, mirroring the unfused path below.
+        s3, b3 = _FoldedNorm(cout, scale_init=nn.initializers.zeros, name="bn3")()
+        main = (w1[0, 0], s1, b1, w2, s2, b2, w3[0, 0], s3, b3)
+        if not proj:
+            return main, None
+        wp = _ConvKernel((1, 1, cin, cout), name="conv_proj")()
+        sp, bp = _FoldedNorm(cout, name="bn_proj")()
+        return main, (wp[0, 0], sp, bp)
 
     @nn.compact
     def __call__(self, x):
@@ -99,15 +130,35 @@ class BottleneckBlock(nn.Module):
             from kubeflow_tpu.ops.fused_bottleneck import fused_bottleneck_block
 
             cin, cmid = self.filters * 4, self.filters
-            w1 = _ConvKernel((1, 1, cin, cmid), name="conv1")()
-            s1, b1 = _FoldedNorm(cmid, name="bn1")()
-            w2 = _ConvKernel((3, 3, cmid, cmid), name="conv2")()
-            s2, b2 = _FoldedNorm(cmid, name="bn2")()
-            w3 = _ConvKernel((1, 1, cmid, cin), name="conv3")()
-            s3, b3 = _FoldedNorm(cin, scale_init=nn.initializers.zeros, name="bn3")()
-            return fused_bottleneck_block(
-                x, w1[0, 0], s1, b1, w2, s2, b2, w3[0, 0], s3, b3
-            )
+            main, _ = self._fused_params(cin, cmid, cin, proj=False)
+            return fused_bottleneck_block(x, *main)
+        if self.fused and self._fusable_transition(x):
+            from kubeflow_tpu.ops.fused_bottleneck import fused_transition_block
+
+            cin, cmid, cout = x.shape[-1], self.filters, self.filters * 4
+            main, proj = self._fused_params(cin, cmid, cout, proj=True)
+            return fused_transition_block(
+                x, *main, *proj, stride=self.strides[0])
+        if self.fused and x.ndim == 4:
+            # Neither kernel takes this shape: keep the folded-norm math in
+            # an epilogue-fused XLA composite so the variable tree (and the
+            # frozen-norm semantics of fused=True) stay uniform, and make
+            # the kernel miss visible.
+            from kubeflow_tpu.ops.fallback import record_fallback
+            from kubeflow_tpu.ops.fused_bottleneck import folded_bottleneck
+
+            record_fallback(
+                "fused_bottleneck",
+                f"input shape {tuple(x.shape)} with strides "
+                f"{tuple(self.strides)} is not kernel-fusable; using the "
+                "epilogue-fused XLA path")
+            cin, cmid, cout = x.shape[-1], self.filters, self.filters * 4
+            out_hw = tuple(
+                -(-d // s) for d, s in zip(x.shape[1:3], self.strides))
+            needs_proj = cin != cout or out_hw != tuple(x.shape[1:3])
+            main, proj = self._fused_params(cin, cmid, cout, proj=needs_proj)
+            return folded_bottleneck(
+                x, *main, strides=self.strides, proj=proj)
         residual = x
         y = self.conv(self.filters, (1, 1), name="conv1")(x)
         y = self.norm(name="bn1")(y)
@@ -176,11 +227,12 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv7x7"  # "s2d" | "conv7x7"
-    # fused_blocks: route eligible bottlenecks (stride-1, identity shortcut
-    # — 13 of ResNet-50's 16) through the Pallas fused kernel
-    # (ops/fused_bottleneck.py). Same variable tree as the unfused model;
-    # frozen-norm semantics in those blocks (see BottleneckBlock). Opt-in
-    # like the s2d stem; bench.py decides per backend.
+    # fused_blocks: route bottlenecks through the Pallas fused kernels
+    # (ops/fused_bottleneck.py) — identity blocks AND the stage heads, so
+    # all 16 of ResNet-50's blocks fuse at 224x224. Same variable tree as
+    # the unfused model; frozen-norm semantics in those blocks (see
+    # BottleneckBlock). Opt-in like the s2d stem; bench.py decides per
+    # backend.
     fused_blocks: bool = False
 
     @nn.compact

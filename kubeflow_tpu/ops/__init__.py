@@ -7,17 +7,28 @@ build: fused attention for the notebook/serving/training recipes, used by
 ``kubeflow_tpu.parallel.ring_attention``.
 """
 
+from kubeflow_tpu.ops.fallback import record_fallback, reset_fallback_warnings
 from kubeflow_tpu.ops.flash_attention import auto_attention, flash_attention
 from kubeflow_tpu.ops.fused_bottleneck import (
+    folded_bottleneck,
     fused_bottleneck,
     fused_bottleneck_block,
+    fused_transition,
+    fused_transition_block,
     reference_bottleneck,
+    reference_transition,
 )
 
 __all__ = [
     "auto_attention",
     "flash_attention",
+    "folded_bottleneck",
     "fused_bottleneck",
     "fused_bottleneck_block",
+    "fused_transition",
+    "fused_transition_block",
+    "record_fallback",
     "reference_bottleneck",
+    "reference_transition",
+    "reset_fallback_warnings",
 ]

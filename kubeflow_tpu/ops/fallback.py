@@ -1,0 +1,40 @@
+"""Fused-kernel fallback visibility.
+
+Every fused op in ``kubeflow_tpu.ops`` has a compiler-scheduled XLA fallback
+for shapes (or backends) the Pallas kernel does not take. The fallbacks are
+numerically fine, which is exactly why they used to be silent — a model
+could quietly lose a third of its MFU to an ineligible sequence length and
+nothing would say so. Eligibility misses now tick
+``ops_fused_fallback_total{kernel=...}`` and warn once per (kernel, reason)
+so the loss shows up in the metrics plane instead of only in a profile.
+
+Recording happens at trace time (once per compiled shape), not per step —
+the counter measures distinct fallback decisions, not executions.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Set, Tuple
+
+from kubeflow_tpu.runtime.metrics import METRICS
+
+_OPS = METRICS.namespace("ops")
+_warned: Set[Tuple[str, str]] = set()
+
+
+def record_fallback(kernel: str, reason: str) -> None:
+    """Count a fused-kernel eligibility miss and warn once per reason."""
+    _OPS.counter("fused_fallback_total", kernel=kernel).inc()
+    key = (kernel, reason)
+    if key not in _warned:
+        _warned.add(key)
+        warnings.warn(
+            f"fused kernel {kernel!r} fell back to the XLA path: {reason} "
+            "(counted in ops_fused_fallback_total)",
+            RuntimeWarning, stacklevel=3)
+
+
+def reset_fallback_warnings() -> None:
+    """Re-arm the one-time warnings (tests)."""
+    _warned.clear()

@@ -503,8 +503,19 @@ def auto_attention(
     (CPU tests, ragged prototype shapes).
     """
     lq, lk = q.shape[1], k.shape[1]
-    if jax.default_backend() == "tpu" and lq % 128 == 0 and lk % 128 == 0:
-        return flash_attention(q, k, v, causal=causal, scale=scale, interpret=False)
+    if jax.default_backend() == "tpu":
+        if lq % 128 == 0 and lk % 128 == 0:
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale, interpret=False)
+        # Same eligibility cliff as the bq%8/bk%8 fail-fast above, but here
+        # the miss used to be silent: the model quietly ran the O(l^2)
+        # materialized path on TPU. Make the MFU loss visible.
+        from kubeflow_tpu.ops.fallback import record_fallback
+
+        record_fallback(
+            "flash_attention",
+            f"sequence lengths ({lq}, {lk}) are not 128-tileable; "
+            "pad the sequence to recover the fused path")
     from kubeflow_tpu.parallel.ring_attention import full_attention
 
     return full_attention(q, k, v, causal=causal, scale=scale)

@@ -36,3 +36,19 @@ from kubeflow_tpu.training.attribution import (  # noqa: F401
     price_callable,
     record_step_peak_hbm,
 )
+from kubeflow_tpu.training.autotune import (  # noqa: F401
+    AutotuneResult,
+    TunedCandidate,
+    autotune_gpt_quick,
+    autotune_resnet_quick,
+    measure_steps,
+    sweep,
+)
+from kubeflow_tpu.training.fsdp import (  # noqa: F401
+    FSDP_GATHER_MODES,
+    FsdpConfig,
+    fsdp_batch_sharding,
+    fsdp_mesh,
+    init_fsdp_params,
+    make_fsdp_train_step,
+)

@@ -142,8 +142,10 @@ def attribute_resnet(
 ) -> List[ModuleCost]:
     """Price every module of a ``ResNet(stage_sizes, BottleneckBlock)``:
     stem, each bottleneck (classified fused vs un-fused by the block's own
-    ``_fusable`` predicate — the truth, not the docs), and the pooled
-    classifier head. Defaults mirror ``ResNet50`` and the bench shape."""
+    ``_fusable``/``_fusable_transition`` predicates — the truth, not the
+    docs; transition heads count as fused since the ``fused_transition``
+    kernel landed), and the pooled classifier head. Defaults mirror
+    ``ResNet50`` and the bench shape."""
     import flax.linen as nn
 
     from kubeflow_tpu.models.resnet import BottleneckBlock, space_to_depth
@@ -182,7 +184,8 @@ def attribute_resnet(
             block = BottleneckBlock(filters=filters, strides=strides,
                                     conv=conv, norm=norm, act=nn.relu,
                                     fused=False)
-            fused_here = bool(fused_blocks) and block._fusable(x)
+            fused_here = bool(fused_blocks) and (
+                block._fusable(x) or block._fusable_transition(x))
             if strides != (1, 1) and cin != filters * 4:
                 detail = "strided+projection"
             elif cin != filters * 4:
@@ -191,6 +194,8 @@ def attribute_resnet(
                 detail = "strided"
             else:
                 detail = "identity"
+            if fused_here and not block._fusable(x):
+                detail += "/transition"
             variables = jax.eval_shape(block.init, rng, x)
             costs.append(price_callable(
                 lambda v, a, b=block: b.apply(v, a), variables, x,
@@ -281,6 +286,13 @@ class AttributionReport:
         mods = [m for m in self.modules if fused is None or m.fused == fused]
         return sorted(mods, key=lambda m: m.est_seconds, reverse=True)[:n]
 
+    def coverage(self, kind: str = "bottleneck") -> Dict[str, int]:
+        """Fused-kernel coverage over modules of ``kind`` (the acceptance
+        metric: 16/16 bottlenecks at 224x224 since the transition kernel)."""
+        of_kind = [m for m in self.modules if m.kind == kind]
+        return {"fused": sum(1 for m in of_kind if m.fused),
+                "total": len(of_kind)}
+
     def to_dict(self, top_n: int = 5) -> Dict[str, Any]:
         return {
             "generation": self.generation,
@@ -288,8 +300,11 @@ class AttributionReport:
             "fractions": {k: round(v, 4) for k, v in self.fractions.items()},
             "modules": len(self.modules),
             "fused_modules": sum(1 for m in self.modules if m.fused),
+            "coverage": self.coverage(),
             "top_unfused_sinks": [m.to_dict() for m in
                                   self.top_sinks(top_n, fused=False)],
+            "top_fused_sinks": [m.to_dict() for m in
+                                self.top_sinks(top_n, fused=True)],
         }
 
     def render(self, top_n: int = 10) -> str:
@@ -301,13 +316,15 @@ class AttributionReport:
             + "  ".join(f"{k}={v * 1e3:.3f}ms" for k, v in self.measured.items()),
             "fractions: " + "  ".join(f"{k}={v:.1%}"
                                       for k, v in self.fractions.items()),
+            "fused coverage: {fused}/{total} bottlenecks".format(
+                **self.coverage()),
             "",
-            f"{'module':<22}{'kind':<12}{'detail':<20}{'fused':<7}"
+            f"{'module':<22}{'kind':<12}{'detail':<31}{'fused':<7}"
             f"{'GFLOPs':>9}{'HBM MiB':>10}{'int.':>8}  {'verdict':<14}{'est ms':>8}",
         ]
         for m in sorted(self.modules, key=lambda m: m.est_seconds, reverse=True)[:top_n]:
             lines.append(
-                f"{m.name:<22}{m.kind:<12}{m.detail:<20}"
+                f"{m.name:<22}{m.kind:<12}{m.detail:<31}"
                 f"{'yes' if m.fused else 'NO':<7}"
                 f"{m.flops / 1e9:>9.2f}{m.hbm_bytes / 2**20:>10.1f}"
                 f"{m.intensity:>8.1f}  {m.verdict:<14}{m.est_seconds * 1e3:>8.3f}")

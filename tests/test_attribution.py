@@ -79,11 +79,13 @@ class TestResNetAttribution:
         assert "stage2_block1" in blocks and "stage4_block3" in blocks
 
     def test_fused_set_matches_the_model_predicate(self, resnet_costs):
-        # the docs claim "13 of 16 fused"; the model's own _fusable predicate
-        # (spatial % 8 == 0 among others) admits exactly TWO at 224x224 —
+        # the model's own predicates (_fusable + _fusable_transition, padded
+        # tiling + the transition kernel) admit ALL 16 blocks at 224x224 —
         # attribution must report the truth, which is the whole point
         fused = {c.name for c in resnet_costs if c.fused}
-        assert fused == {"stage1_block2", "stage1_block3"}
+        assert fused == {c.name for c in resnet_costs
+                         if c.name.startswith("stage")}
+        assert len(fused) == 16
 
     def test_every_block_is_priced_with_flops_bytes_and_verdict(self, resnet_costs):
         for c in resnet_costs:
@@ -92,22 +94,28 @@ class TestResNetAttribution:
             assert c.peak_hbm_bytes > 0, c.name
             assert c.verdict in ("compute-bound", "hbm-bound"), c.name
 
-    def test_strided_projection_blocks_lead_the_unfused_sinks(self, resnet_costs):
+    def test_only_stem_and_head_remain_unfused(self, resnet_costs):
+        # full coverage: every bottleneck runs a fused kernel, so the only
+        # unfused sinks left are the stem and the classifier head — and the
+        # former downsampling blocks now lead the FUSED sink table
         report = attribution_report(resnet_costs, step_seconds=0.1,
                                     generation="v5e")
-        top = report.top_sinks(6, fused=False)
-        details = [c.detail for c in top]
-        assert sum(1 for d in details if d == "strided+projection") >= 2, details
-        # and the un-fused downsampling blocks outweigh any fused block
-        fused_best = max((c.est_seconds for c in resnet_costs if c.fused),
-                        default=0.0)
-        assert top[0].est_seconds > fused_best
+        unfused = report.top_sinks(6, fused=False)
+        assert {c.name for c in unfused} == {"stem", "classifier_head"}
+        top_fused = report.top_sinks(6, fused=True)
+        assert any("transition" in c.detail for c in top_fused)
+
+    def test_coverage_counts_fused_bottlenecks(self, resnet_costs):
+        report = attribution_report(resnet_costs, step_seconds=0.1,
+                                    generation="v5e")
+        assert report.coverage() == {"fused": 16, "total": 16}
 
     def test_projection_blocks_are_labeled(self, resnet_costs):
         by_name = {c.name: c for c in resnet_costs}
-        assert by_name["stage1_block1"].detail == "projection"
+        assert by_name["stage1_block1"].detail == "projection/transition"
         for stage in (2, 3, 4):
-            assert by_name[f"stage{stage}_block1"].detail == "strided+projection"
+            assert (by_name[f"stage{stage}_block1"].detail
+                    == "strided+projection/transition")
         assert by_name["stage3_block2"].detail == "identity"
 
 
@@ -153,8 +161,9 @@ class TestAttributionReport:
         assert reconstructed == pytest.approx(report.step_seconds, rel=0.05)
         assert report.step_seconds == pytest.approx(
             clock.summary()["total"], rel=1e-6)
-        # fused vs unfused split follows the roofline estimates
-        assert report.fractions["unfused_compute"] > report.fractions["fused_compute"] > 0
+        # fused vs unfused split follows the roofline estimates: with all 16
+        # bottlenecks fused, only the stem + head remain unfused
+        assert report.fractions["fused_compute"] > report.fractions["unfused_compute"] > 0
 
     def test_steps_per_record_normalizes_bench_windows(self, resnet_costs):
         clock = self._clock(steps=2)
@@ -171,9 +180,13 @@ class TestAttributionReport:
         assert "strided+projection" in text
         d = json.loads(json.dumps(report.to_dict()))
         assert d["modules"] == len(resnet_costs)
-        assert d["fused_modules"] == 2
-        assert len(d["top_unfused_sinks"]) == 5
+        assert d["fused_modules"] == 16
+        assert d["coverage"] == {"fused": 16, "total": 16}
+        # only stem + classifier_head are left unfused
+        assert len(d["top_unfused_sinks"]) == 2
         assert all(s["verdict"] for s in d["top_unfused_sinks"])
+        assert len(d["top_fused_sinks"]) == 5
+        assert "fused coverage: 16/16" in report.render()
 
     def test_without_clock_everything_is_unfused_compute(self):
         report = attribution_report([], step_seconds=0.2)
@@ -222,9 +235,10 @@ def gate_mod():
 
 class TestBenchGate:
     def test_r05_flags_the_serving_regressions(self, gate_mod):
-        # with r06 (the paged-KV recovery round) excluded, the history ends
-        # at r05 and the gate must still retroactively flag the r04->r05 slide
-        rounds = gate_mod.load_history(ROOT, ["r06"])
+        # with r06 (the paged-KV recovery round) and r07 (the autotuner
+        # round) excluded, the history ends at r05 and the gate must still
+        # retroactively flag the r04->r05 slide
+        rounds = gate_mod.load_history(ROOT, ["r06", "r07"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 1
         fails = {r["metric"] for r in results if r["verdict"] == "FAIL"}
@@ -237,8 +251,8 @@ class TestBenchGate:
 
     def test_r06_recovers_without_waivers(self, gate_mod):
         # the committed r06 round beats the r04 serving numbers outright, so
-        # the full history gates green with zero waivers
-        rounds = gate_mod.load_history(ROOT, [])
+        # the history rewound to r06 gates green with zero waivers
+        rounds = gate_mod.load_history(ROOT, ["r07"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 0
         assert max(rounds) == 6
@@ -249,8 +263,26 @@ class TestBenchGate:
         assert verdicts["serving_ttft_p99_s"] == "BASELINE"
         assert verdicts["spec_accept_rate"] == "BASELINE"
 
+    def test_r07_breaks_the_training_plateau(self, gate_mod):
+        # the full history gates green with zero waivers, and the autotuner
+        # round clears the new absolute flagship floors outright
+        rounds = gate_mod.load_history(ROOT, [])
+        results, rc = gate_mod.gate(rounds)
+        assert rc == 0
+        assert max(rounds) == 7
+        by = {r["metric"]: r for r in results}
+        assert by["resnet50_train_mfu"]["verdict"] == "IMPROVED"
+        assert by["resnet50_train_mfu"]["value"] >= 40.0
+        assert by["gpt2_medium_mfu_pct"]["verdict"] == "IMPROVED"
+        assert by["gpt2_medium_mfu_pct"]["value"] >= 50.0
+        # the flagship floors are active at r07 and not breached
+        for metric in ("resnet50_train_mfu", "gpt2_medium_mfu_pct",
+                       "gpt2_medium_tokens_per_sec", "images_per_sec_per_chip"):
+            assert by[metric]["floor"] == gate_mod.FLOORS[metric][0]
+            assert by[metric]["floor_breached"] is False
+
     def test_excluding_r05_passes(self, gate_mod):
-        rounds = gate_mod.load_history(ROOT, ["r05", "r06"])
+        rounds = gate_mod.load_history(ROOT, ["r05", "r06", "r07"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 0
         assert max(rounds) == 4
@@ -262,7 +294,7 @@ class TestBenchGate:
         assert gpt["verdict"] == "BASELINE"
 
     def test_waivers_turn_known_fails_green(self, gate_mod):
-        rounds = gate_mod.load_history(ROOT, ["r06"])
+        rounds = gate_mod.load_history(ROOT, ["r06", "r07"])
         waivers = [f"{m}@r05" for m in (
             "serving_bert_p50_ms_b8",
             "serving_decode_tokens_per_sec_b8",
@@ -316,13 +348,45 @@ class TestBenchGate:
         assert strict.returncode == 0
         assert "serving_decode_tokens_per_sec_b8" in strict.stdout
         assert "gate PASSED" in strict.stdout
-        # --exclude r06 rewinds to the r05 regression round: rc=1 + table
+        # --exclude r06/r07 rewinds to the r05 regression round: rc=1 + table
         rewound = subprocess.run(
-            [sys.executable, "tools/bench_gate.py", "--exclude", "r06"],
+            [sys.executable, "tools/bench_gate.py",
+             "--exclude", "r06", "--exclude", "r07"],
             cwd=ROOT, capture_output=True, text=True)
         assert rewound.returncode == 1
         assert "serving_bert_p50_ms_b8" in rewound.stdout
         assert "REGRESSION" in rewound.stdout
+
+    def test_floor_trips_on_a_slow_drift_back(self, gate_mod):
+        # -8.5% is inside the 10% relative band, but 37.5 is under the
+        # absolute 38.0 flagship floor — the drift back toward the plateau
+        # must fail even though no single round slid past tolerance
+        rounds = {6: {"resnet50_train_mfu": 41.0},
+                  7: {"resnet50_train_mfu": 37.5}}
+        results, rc = gate_mod.gate(rounds)
+        assert rc == 1
+        assert results[0]["verdict"] == "FAIL"
+        assert results[0]["floor_breached"] is True
+
+    def test_floor_inactive_before_its_round(self, gate_mod):
+        # the same values one round earlier predate the floor: rewound
+        # histories must gate exactly as they did then
+        rounds = {5: {"resnet50_train_mfu": 41.0},
+                  6: {"resnet50_train_mfu": 37.5}}
+        results, rc = gate_mod.gate(rounds)
+        assert rc == 0
+        assert results[0]["verdict"] == "OK"
+        assert "floor" not in results[0]
+
+    def test_floor_breach_is_waivable_and_applies_to_baselines(self, gate_mod):
+        rounds = {6: {"resnet50_train_mfu": 41.0},
+                  7: {"resnet50_train_mfu": 37.5}}
+        results, rc = gate_mod.gate(rounds, ["resnet50_train_mfu@r07"])
+        assert rc == 0 and results[0]["verdict"] == "WAIVED"
+        # a metric FIRST appearing under its floor is not a free pass
+        results, rc = gate_mod.gate({7: {"gpt2_medium_mfu_pct": 45.0}})
+        assert rc == 1 and results[0]["verdict"] == "FAIL"
+        assert results[0]["floor_breached"] is True
 
     def test_empty_history_is_vacuously_green(self, gate_mod, tmp_path):
         rounds = gate_mod.load_history(tmp_path, [])

@@ -1,0 +1,146 @@
+"""training/autotune.py: the two-stage sweep engine (price -> prune ->
+measure -> choose) and the quick CPU sweeps the autotune-smoke CI job runs."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubeflow_tpu.training.autotune import (
+    AutotuneResult,
+    TunedCandidate,
+    autotune_gpt_quick,
+    autotune_resnet_quick,
+    measure_steps,
+    sweep,
+)
+
+
+class TestSweepEngine:
+    def test_measured_minimum_wins(self):
+        times = {"a": 0.03, "b": 0.01, "c": 0.02}
+        result = sweep("t", [{"k": k} for k in "abc"],
+                       measure=lambda kn: times[kn["k"]])
+        assert result.chosen == {"k": "b"}
+        assert all(c.measured_seconds == times[c.knobs["k"]]
+                   for c in result.candidates)
+
+    def test_price_prunes_beyond_keep(self):
+        est = {"a": 3.0, "b": 1.0, "c": 2.0, "d": 4.0}
+        measured = []
+
+        def measure(kn):
+            measured.append(kn["k"])
+            return est[kn["k"]] / 10  # measurement agrees with the price
+
+        result = sweep("t", [{"k": k} for k in "abcd"],
+                       measure=measure, price=lambda kn: est[kn["k"]], keep=2)
+        # only the 2 best-priced candidates are measured
+        assert sorted(measured) == ["b", "c"]
+        assert result.chosen == {"k": "b"}
+        pruned = {c.knobs["k"] for c in result.candidates if c.pruned}
+        assert pruned == {"a", "d"}
+
+    def test_measurement_can_overturn_the_price(self):
+        # pricing ranks b best, but the clock disagrees — clocks decide
+        est = {"a": 2.0, "b": 1.0}
+        meas = {"a": 0.01, "b": 0.05}
+        result = sweep("t", [{"k": k} for k in "ab"],
+                       measure=lambda kn: meas[kn["k"]],
+                       price=lambda kn: est[kn["k"]], keep=2)
+        assert result.chosen == {"k": "a"}
+
+    def test_errors_are_recorded_not_fatal(self):
+        def measure(kn):
+            if kn["k"] == "boom":
+                raise RuntimeError("kernel exploded")
+            return 0.02
+
+        result = sweep("t", [{"k": "boom"}, {"k": "ok"}], measure=measure)
+        assert result.chosen == {"k": "ok"}
+        boom = next(c for c in result.candidates if c.knobs["k"] == "boom")
+        assert boom.error and "exploded" in boom.error
+
+    def test_price_errors_keep_candidate_measurable(self):
+        # a candidate whose PRICE raises is still measured (pricing is
+        # advisory): gather-mode candidates price-fail by design, since
+        # collectives are invisible to single-program cost analysis
+        def price(kn):
+            if kn["k"] == "unpriceable":
+                raise ValueError("no cost analysis for collectives")
+            return 1.0
+
+        meas = {"unpriceable": 0.01, "plain": 0.05}
+        result = sweep("t", [{"k": "unpriceable"}, {"k": "plain"}],
+                       measure=lambda kn: meas[kn["k"]], price=price, keep=2)
+        assert result.chosen == {"k": "unpriceable"}
+
+    def test_all_measurements_failing_falls_back_to_price(self):
+        def measure(kn):
+            raise RuntimeError("no hardware")
+
+        result = sweep("t", [{"k": "a"}, {"k": "b"}], measure=measure,
+                       price=lambda kn: {"a": 2.0, "b": 1.0}[kn["k"]], keep=2)
+        assert result.chosen == {"k": "b"}
+
+    def test_everything_failing_falls_back_to_first(self):
+        def bomb(kn):
+            raise RuntimeError("nope")
+
+        result = sweep("t", [{"k": "first"}, {"k": "second"}],
+                       measure=bomb, price=bomb)
+        assert result.chosen == {"k": "first"}
+
+    def test_row_and_dict_are_json_safe(self):
+        result = sweep("t", [{"k": 1}, {"k": 2}],
+                       measure=lambda kn: 0.01 * kn["k"])
+        row = json.loads(json.dumps(result.to_row()))
+        assert row["family"] == "t"
+        assert row["chosen"] == {"k": 1}
+        assert row["swept"] == 2 and row["measured"] == 2
+        assert row["pruned"] == 0 and row["errors"] == 0
+        full = json.loads(json.dumps(result.to_dict()))
+        assert len(full["candidates"]) == 2
+        assert "est=" in result.render() or "chosen" in result.render()
+
+    def test_result_types(self):
+        c = TunedCandidate(knobs={"x": 1})
+        assert c.to_dict()["knobs"] == {"x": 1}
+        r = AutotuneResult(family="f", chosen={"x": 1}, candidates=[c])
+        assert r.to_row()["swept"] == 1
+
+
+def test_measure_steps_returns_median_seconds():
+    calls = []
+
+    def fake_step():
+        calls.append(1)
+
+    dt = measure_steps(fake_step, steps=3)
+    assert len(calls) == 3
+    assert dt >= 0.0
+
+
+# -- the quick sweeps the CI smoke job runs -----------------------------------
+
+@pytest.mark.parametrize("quick_fn,family", [
+    (autotune_resnet_quick, "resnet"),
+    (autotune_gpt_quick, "gpt"),
+])
+def test_quick_sweeps_run_on_cpu(quick_fn, family):
+    result = quick_fn(steps=1)
+    assert result.family == family
+    assert result.quick is True
+    assert result.chosen in [c.knobs for c in result.candidates]
+    # at least one candidate was actually measured (no silent price-only run)
+    assert any(c.measured_seconds is not None for c in result.candidates)
+    row = result.to_row()
+    assert row["swept"] >= 2
+
+
+def test_cli_requires_quick(capsys):
+    from kubeflow_tpu.training.autotune import main
+
+    with pytest.raises(SystemExit):
+        main(["--family", "resnet"])

@@ -1,0 +1,89 @@
+"""training/fsdp.py: the plain ZeRO-3 GPT step with overlapped weight
+gathers. The conftest forces 8 virtual CPU devices, so the eager/overlap
+parity runs against the same topology the bench's multi-device sweep tunes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.training.fsdp import (
+    FSDP_GATHER_MODES,
+    FsdpConfig,
+    fsdp_batch_sharding,
+    fsdp_mesh,
+    init_fsdp_params,
+    make_fsdp_train_step,
+)
+
+CFG = FsdpConfig(vocab_size=64, d_model=32, n_heads=4, d_ff=64,
+                 n_layers=3, seq=16)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 host devices"
+    return fsdp_mesh()
+
+
+def _batch(mesh, batch=8):
+    ids = jax.random.randint(jax.random.PRNGKey(42), (batch, CFG.seq),
+                             0, CFG.vocab_size)
+    return jax.device_put(ids, fsdp_batch_sharding(mesh))
+
+
+def _run(mesh, gather_mode, steps=3):
+    params = init_fsdp_params(jax.random.PRNGKey(0), CFG, mesh)
+    step = make_fsdp_train_step(CFG, mesh, lr=0.1, gather_mode=gather_mode)
+    ids = _batch(mesh)
+    losses = []
+    for _ in range(steps):
+        params, loss = step(params, ids)
+        losses.append(float(loss))
+    return params, losses
+
+
+class TestGatherModes:
+    def test_overlap_is_bit_identical_to_eager(self, mesh):
+        # same math, different comm placement: the double-buffered prefetch
+        # must not change a single bit of the result
+        p_eager, l_eager = _run(mesh, "eager")
+        p_overlap, l_overlap = _run(mesh, "overlap")
+        assert l_eager == l_overlap
+        for a, b in zip(jax.tree_util.tree_leaves(p_eager),
+                        jax.tree_util.tree_leaves(p_overlap)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_loss_decreases(self, mesh):
+        _, losses = _run(mesh, "overlap", steps=4)
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_unknown_mode_rejected(self, mesh):
+        with pytest.raises(ValueError, match="gather_mode"):
+            make_fsdp_train_step(CFG, mesh, gather_mode="telepathy")
+
+    def test_modes_registry(self):
+        assert FSDP_GATHER_MODES == ("eager", "overlap")
+
+
+class TestSharding:
+    def test_params_are_sharded_over_fsdp_axis(self, mesh):
+        params = init_fsdp_params(jax.random.PRNGKey(0), CFG, mesh)
+        wqkv = params["blocks"]["wqkv"]
+        assert wqkv.shape == (CFG.n_layers, CFG.d_model, 3, CFG.d_model)
+        # each device holds a 1/8 slice of the sharded dim, not a replica
+        shard = wqkv.addressable_shards[0]
+        assert shard.data.shape[1] == CFG.d_model // 8
+
+    def test_step_keeps_shardings(self, mesh):
+        params = init_fsdp_params(jax.random.PRNGKey(0), CFG, mesh)
+        step = make_fsdp_train_step(CFG, mesh, gather_mode="overlap")
+        out, loss = step(params, _batch(mesh))
+        before = jax.tree_util.tree_map(lambda a: a.sharding, params)
+        after = jax.tree_util.tree_map(lambda a: a.sharding, out)
+        assert jax.tree_util.tree_all(
+            jax.tree_util.tree_map(lambda a, b: a == b, before, after))
+        assert loss.shape == ()

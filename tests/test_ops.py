@@ -343,9 +343,9 @@ class TestFusedBottleneckBlock:
     def _small_resnet(self, fused: bool):
         from kubeflow_tpu.models.resnet import BottleneckBlock, ResNet
 
-        # stage of two blocks: block1 has a projection shortcut (NOT
-        # fusable — exercises the silent unfused fallback), block2 is the
-        # canonical stride-1 identity block the kernel takes over.
+        # stage of two blocks: block1 has a projection shortcut (handled by
+        # the fused transition kernel), block2 is the canonical stride-1
+        # identity block the original kernel takes over.
         return ResNet(stage_sizes=[2], block_cls=BottleneckBlock,
                       num_classes=10, num_filters=8, fused_blocks=fused)
 

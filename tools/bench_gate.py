@@ -99,6 +99,23 @@ SPECS: Dict[str, Tuple[str, float]] = {
     "wal_append_p99_ms": ("lower", 1.00),
 }
 
+#: Absolute flagship floors: {metric: (floor, applies_from_round)} — checked
+#: on the newest round only, and only once that family's newest round has
+#: reached ``applies_from_round`` (so rewound histories, e.g. ``--exclude``
+#: of the latest round in tests, still gate exactly as they did then).
+#: The relative tolerance above answers "did this round slide vs the best
+#: earlier round?"; the floor answers "is the flagship still above the
+#: plateau?" — a slow multi-round drift back toward the old 30% MFU passes
+#: every relative check but trips the floor.
+FLOORS: Dict[str, Tuple[float, int]] = {
+    "resnet50_train_mfu": (38.0, 7),
+    "images_per_sec_per_chip": (3000.0, 7),
+    "gpt2_medium_train_mfu": (48.0, 7),
+    "gpt2_medium_mfu_pct": (48.0, 7),
+    "gpt2_medium_tokens_per_sec": (40000.0, 7),
+}
+
+
 #: summary-line keys lifted into standalone metrics (the final bench line
 #: carries every flagship number; "value" itself arrives via metric/value)
 SUMMARY_KEYS = (
@@ -218,12 +235,29 @@ def gate(rounds: Dict[int, Dict[str, float]],
     rc = 0
     for metric, value in sorted(rounds[newest].items()):
         direction, tol = spec_for(metric)
+        floor_val: Optional[float] = None
+        floor_breached = False
+        floor = FLOORS.get(metric)
+        if floor is not None and newest >= floor[1]:
+            floor_val = floor[0]
+            floor_breached = (value < floor_val if direction == "higher"
+                              else value > floor_val)
         history = [(n, vals[metric]) for n, vals in sorted(rounds.items())
                    if n < newest and metric in vals]
         if not history:
-            results.append({"metric": metric, "round": newest, "value": value,
-                            "verdict": "BASELINE", "direction": direction,
-                            "tolerance": tol})
+            verdict = "BASELINE"
+            if floor_breached:
+                verdict = ("WAIVED" if f"{metric}@r{newest:02d}" in waived
+                           else "FAIL")
+            if verdict == "FAIL":
+                rc = 1
+            row = {"metric": metric, "round": newest, "value": value,
+                   "verdict": verdict, "direction": direction,
+                   "tolerance": tol}
+            if floor_val is not None:
+                row["floor"] = floor_val
+                row["floor_breached"] = floor_breached
+            results.append(row)
             continue
         if direction == "higher":
             best_round, best = max(history, key=lambda t: t[1])
@@ -237,15 +271,19 @@ def gate(rounds: Dict[int, Dict[str, float]],
         verdict = "OK"
         if improved:
             verdict = "IMPROVED"
-        elif regressed:
+        if regressed or floor_breached:
             verdict = "WAIVED" if f"{metric}@r{newest:02d}" in waived else "FAIL"
         if verdict == "FAIL":
             rc = 1
-        results.append({"metric": metric, "round": newest, "value": value,
-                        "best": best, "best_round": best_round,
-                        "delta_pct": round(delta * 100, 2),
-                        "direction": direction, "tolerance": tol,
-                        "verdict": verdict})
+        row = {"metric": metric, "round": newest, "value": value,
+               "best": best, "best_round": best_round,
+               "delta_pct": round(delta * 100, 2),
+               "direction": direction, "tolerance": tol,
+               "verdict": verdict}
+        if floor_val is not None:
+            row["floor"] = floor_val
+            row["floor_breached"] = floor_breached
+        results.append(row)
     return results, rc
 
 
@@ -259,6 +297,11 @@ def render(results: List[dict], newest: Optional[int],
     lines = [f"bench gate: {label}round r{newest:02d} vs best of earlier rounds",
              head, "-" * len(head)]
     for r in results:
+        floor_note = ""
+        if r.get("floor_breached"):
+            floor_note = f" (past floor {r['floor']:.2f})"
+        elif "floor" in r:
+            floor_note = f" (floor {r['floor']:.2f})"
         if r["verdict"] == "BASELINE":
             lines.append(f"{r['metric']:<44}{r['value']:>12.2f}{'—':>12}{'—':>7}"
                          f"{'—':>9}{r['tolerance']:>7.0%}  BASELINE (first round"
@@ -268,7 +311,7 @@ def render(results: List[dict], newest: Optional[int],
         lines.append(
             f"{r['metric']:<44}{r['value']:>12.2f}{r['best']:>12.2f}"
             f"{'r%02d' % r['best_round']:>7}{arrow}{r['delta_pct']:>7.2f}%"
-            f"{r['tolerance']:>7.0%}  {r['verdict']}")
+            f"{r['tolerance']:>7.0%}  {r['verdict']}{floor_note}")
     fails = [r["metric"] for r in results if r["verdict"] == "FAIL"]
     if fails:
         lines.append("")
