@@ -822,12 +822,15 @@ def _run_serving(platform: str) -> dict:
     """Serving rows condensed for the summary: BERT HTTP p50 at batch 8 and
     KV-decode tokens/s at batch 8 (full sweep on the per-metric line)."""
     try:
-        from e2e.serving_bench import bench_bert_http, bench_continuous, bench_gpt_decode
+        from e2e.serving_bench import (bench_bert_http, bench_continuous,
+                                       bench_disagg, bench_gpt_decode)
 
         bert = bench_bert_http()
         decode = bench_gpt_decode()
         cont = (bench_continuous()
                 if os.environ.get("BENCH_CONTINUOUS", "1") == "1" else None)
+        disagg = (bench_disagg()
+                  if os.environ.get("BENCH_DISAGG", "1") == "1" else None)
         b8 = next((r for r in bert if r["batch"] == 8), bert[-1])
         d8 = next((r for r in decode if r["batch"] == 8), decode[-1])
         return _emit({
@@ -848,6 +851,14 @@ def _run_serving(platform: str) -> dict:
             # accept rate rides into the summary line so the bench gate can
             # track it round over round
             "spec_accept_rate": cont.get("spec_accept_rate") if cont else None,
+            # disaggregated heterogeneous-mix pass (ISSUE 18): aggregate
+            # decode tok/s across two multiplexed models with prefill/decode
+            # pools and the quantized KV handoff in the serving path
+            "disagg": disagg,
+            "decode_tok_s_heterogeneous": (
+                disagg.get("decode_tok_s_heterogeneous") if disagg else None),
+            "kv_handoff_p99_s": (
+                disagg.get("kv_handoff_p99_s") if disagg else None),
             "platform": platform,
         })
     except Exception as e:
@@ -936,6 +947,9 @@ def main() -> int:
         "serving_bert_p50_ms_b8": rows.get("serving", {}).get("bert_http_p50_ms_b8"),
         "serving_ttft_p99_s": rows.get("serving", {}).get("ttft_p99"),
         "spec_accept_rate": rows.get("serving", {}).get("spec_accept_rate"),
+        "decode_tok_s_heterogeneous": rows.get("serving", {}).get(
+            "decode_tok_s_heterogeneous"),
+        "kv_handoff_p99_s": rows.get("serving", {}).get("kv_handoff_p99_s"),
         "hpo_trials_per_hour": rows.get("hpo", {}).get("value"),
         "multichip_tokens_per_sec_per_chip": rows.get("multichip", {}).get("value"),
         "multichip_scaling_efficiency": rows.get("multichip", {}).get("scaling_efficiency"),

@@ -252,6 +252,30 @@ def paged_kv_e2e() -> Dict:
     return b.build()
 
 
+def disagg_serving_e2e() -> Dict:
+    """The disaggregated-serving job: a prefill pool + 2-replica decode
+    pool multiplexing two models over real HTTP — per-model completions
+    bit-identical to a never-moved oracle (the KV wire handoff contract),
+    handoff/import counters and histograms live, chatty first tokens
+    unharmed by a long-prefill burst, the int8 arena's ~2x KV slots per
+    HBM byte asserted from the block gauges, and zero dropped requests
+    through a decode-pool drain (e2e/disagg_driver.py asserts all of it),
+    plus the fleet/router/autoscaler and draft-distillation unit suites."""
+    b = WorkflowBuilder("disagg-serving-e2e")
+    b.run("disagg-driver", ["python", "-m", "e2e.disagg_driver"],
+          env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("fleet-unit", "tests/test_fleet.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    b.pytest("distill-unit", "tests/test_distill.py",
+             env={"JAX_PLATFORMS": "cpu"})
+    # the engine-level handoff/int8 parity tests marked slow (tier-1's
+    # -m 'not slow' skips them) run here, with their fast siblings
+    b.pytest("handoff-unit", "tests/test_continuous_batching.py",
+             env={"JAX_PLATFORMS": "cpu"},
+             extra_args=["-k", "handoff or int8 or kv_wire"])
+    return b.build()
+
+
 def platlint() -> Dict:
     """The lock-discipline job: tools/platlint (guarded-field inference,
     lock-order cycle detection, blocking-under-lock) over the whole
@@ -385,6 +409,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "serving-fleet-e2e": serving_fleet_e2e,
     "serving-overload-e2e": serving_overload_e2e,
     "paged-kv-e2e": paged_kv_e2e,
+    "disagg-serving-e2e": disagg_serving_e2e,
     "elastic-e2e": elastic_e2e,
     "platlint": platlint,
     "bench-regression": bench_regression,

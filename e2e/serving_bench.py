@@ -143,13 +143,18 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     arena (0 = full capacity), ``BENCH_PREFILL_CHUNK`` sets the
     chunked-prefill budget (engine default when unset, 0 disables).
     ``BENCH_SPEC`` (default 1) adds a second timed pass on a speculative
-    engine — the draft is the target's own first ``n_layers // 4`` blocks
-    with tied embeddings (self-speculative drafting: no second checkpoint;
-    the accept rate on a TRAINED model tracks how early the truncated
-    stack commits to the full stack's argmax, on this bench's random init
-    it is a floor, not a ceiling) — reporting ``spec_accept_rate`` and
-    ``spec_tokens_per_sec`` next to the plain numbers, ``BENCH_SPEC_K``
-    tokens per round."""
+    engine — reporting ``spec_accept_rate`` and ``spec_tokens_per_sec``
+    next to the plain numbers, ``BENCH_SPEC_K`` tokens per round.
+
+    ISSUE-18 knobs: ``BENCH_KV_DTYPE`` (default bf16) runs every engine
+    on the int8 arena when set to ``int8``. ``BENCH_DRAFT`` (default
+    ``distill``) picks the speculative draft: ``distill`` trains a small
+    draft from the target with training/distill.py (``BENCH_DISTILL_STEPS``
+    KL steps, outside the timed window; on a trained target this is what
+    lifts the accept rate past the gate floor), ``self`` keeps the r06
+    truncated-layer self-draft (the target's own first ``n_layers // 4``
+    blocks with tied embeddings — no second checkpoint, but on-policy
+    agreement with the full stack's argmax is poor)."""
     from kubeflow_tpu.models.gpt import GptConfig, GptLM, generate
     from kubeflow_tpu.serving.continuous import ContinuousBatcher
 
@@ -191,9 +196,11 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     kv_blocks = int(os.environ.get("BENCH_KV_BLOCKS", "0") or 0) or None
     pc_env = os.environ.get("BENCH_PREFILL_CHUNK", "")
     prefill_chunk = int(pc_env) if pc_env else None
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "bf16")
     eng = ContinuousBatcher(cfg, params, slots=slots, chunk=chunk,
                             pipeline=pipeline, paged=paged,
-                            kv_blocks=kv_blocks, prefill_chunk=prefill_chunk)
+                            kv_blocks=kv_blocks, prefill_chunk=prefill_chunk,
+                            kv_dtype=kv_dtype)
     try:
         # warm the engine's programs (per-group-size prefill, adopt, and
         # the chunked step) the same way the static path's generate()
@@ -222,24 +229,39 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
     ttft_p50, ttft_p99 = _q("serving_ttft_seconds", 0.5), _q("serving_ttft_seconds", 0.99)
     queue_wait_p99 = _q("serving_queue_wait_seconds", 0.99)
 
-    # -- speculative pass: same requests, self-speculative draft -----------
+    # -- speculative pass: distilled draft (default) or self-draft ---------
     spec: Dict[str, Any] = {}
     if os.environ.get("BENCH_SPEC", "1") == "1":
         spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
-        draft_layers = max(1, cfg.n_layers // 4)
-        draft_cfg = GptConfig(d_model=cfg.d_model, n_layers=draft_layers,
-                              n_heads=cfg.n_heads, d_ff=cfg.d_ff,
-                              max_seq=cfg.max_seq, vocab_size=cfg.vocab_size)
-        draft_params = {k: v for k, v in params.items()
-                        if not k.startswith("block_")}
-        for i in range(draft_layers):
-            draft_params[f"block_{i}"] = params[f"block_{i}"]
+        draft_mode = os.environ.get("BENCH_DRAFT", "distill")
+        if draft_mode == "distill":
+            from kubeflow_tpu.training.distill import distill_draft
+
+            # trained OUTSIDE the timed window; the distilled draft is the
+            # bench default because the truncated-layer self-draft's accept
+            # rate (~0.14 in r06/r07) throws away most speculative compute
+            draft_cfg, draft_params = distill_draft(
+                cfg, params,
+                steps=int(os.environ.get("BENCH_DISTILL_STEPS", "300")),
+                seed=0)
+            draft_layers = draft_cfg.n_layers
+        else:
+            draft_layers = max(1, cfg.n_layers // 4)
+            draft_cfg = GptConfig(d_model=cfg.d_model, n_layers=draft_layers,
+                                  n_heads=cfg.n_heads, d_ff=cfg.d_ff,
+                                  max_seq=cfg.max_seq,
+                                  vocab_size=cfg.vocab_size)
+            draft_params = {k: v for k, v in params.items()
+                            if not k.startswith("block_")}
+            for i in range(draft_layers):
+                draft_params[f"block_{i}"] = params[f"block_{i}"]
         drafted0 = METRICS.counter("serving_spec_tokens_drafted_total").value
         accepted0 = METRICS.counter("serving_spec_tokens_accepted_total").value
         seng = ContinuousBatcher(cfg, params, slots=slots, chunk=chunk,
                                  pipeline=pipeline, paged=paged,
                                  kv_blocks=kv_blocks,
                                  prefill_chunk=prefill_chunk,
+                                 kv_dtype=kv_dtype,
                                  spec_draft=(draft_cfg, draft_params),
                                  spec_k=spec_k)
         try:
@@ -256,6 +278,7 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
         accepted = METRICS.counter("serving_spec_tokens_accepted_total").value - accepted0
         spec = {
             "spec_k": spec_k,
+            "spec_draft": draft_mode,
             "spec_draft_layers": draft_layers,
             "spec_wall_s": round(spec_s, 2),
             "spec_tokens_per_sec": round(total_tokens / spec_s, 1),
@@ -268,6 +291,7 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
         "queue_wait_p99": queue_wait_p99,
         "paged": paged,
         "kv_blocks": kv_blocks or "full",
+        "kv_dtype": kv_dtype,
         "prefill_chunk": eng.prefill_chunk,
         **spec,
         "slots": slots, "requests": n_requests, "budgets": "32/64/128/224",
@@ -279,6 +303,84 @@ def bench_continuous(slots: int = 8, n_requests: int = 16,
         "continuous_tokens_per_sec": round(total_tokens / continuous_s, 1),
         "continuous_mean_latency_s": round(sum(cont_lat) / n_requests, 2),
         "speedup": round(static_s / continuous_s, 3),
+    }
+
+
+def bench_disagg(slots: int = 8, n_requests: int = 24,
+                 chunk: int = 16, pipeline: int = 3) -> Dict[str, Any]:
+    """Heterogeneous-mix serving pass (ISSUE 18): two models multiplexed
+    over a disaggregated fleet — a prefill pool and a decode pool per
+    model — under the workload that punishes homogeneous replicas most:
+    chatty short-prompt decode interleaved with long-prefill requests.
+
+    The fleet runs ``kv_dtype`` from ``BENCH_KV_DTYPE`` (int8 doubles KV
+    slots per HBM byte, the r08 default for this pass), routes on the
+    per-request ``model`` id, and ships every prefill over the KV wire —
+    so the reported aggregate decode tokens/s pays for routing, handoff
+    serialization, and import, not just raw decode steps. Headline rows:
+    ``decode_tok_s_heterogeneous`` (gate: strictly above the homogeneous
+    r06 b8 decode row) and ``kv_handoff_p99_s`` (wire serialization +
+    fetch tail). Disable with ``BENCH_DISAGG=0``."""
+    from kubeflow_tpu.models.gpt import GptConfig, GptLM
+    from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.serving.fleet import EngineFleet
+
+    prompt_short, prompt_long, budget = 64, 384, 128
+    cfg = GptConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096,
+                    max_seq=prompt_long + budget, vocab_size=32000)
+    rng = jax.random.PRNGKey(0)
+    model = GptLM(cfg)
+    sample = jax.random.randint(rng, (1, prompt_short), 0, cfg.vocab_size)
+    params = {
+        "alpha": model.init(jax.random.PRNGKey(0), sample)["params"],
+        "beta": model.init(jax.random.PRNGKey(1), sample)["params"],
+    }
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "int8")
+    fleet = EngineFleet(
+        cfg, params["alpha"], max_replicas=4,
+        pools={"prefill": 1, "decode": 2},
+        models={mid: (cfg, p) for mid, p in params.items()},
+        model_slo={"alpha": "interactive", "beta": "batch"},
+        slots=slots, chunk=chunk, pipeline=pipeline, name="bench-disagg",
+        engine_kwargs={"kv_dtype": kv_dtype,
+                       "prefill_chunk": prompt_short})
+    # the mix: 2/3 chatty decode, 1/3 long prefill, models alternating
+    reqs = []
+    for i in range(n_requests):
+        plen = prompt_long if i % 3 == 2 else prompt_short
+        reqs.append(("alpha" if i % 2 == 0 else "beta",
+                     np.asarray(jax.random.randint(
+                         jax.random.PRNGKey(100 + i), (plen,), 0,
+                         cfg.vocab_size))))
+    try:
+        # warm both pools' programs for both prompt shapes, per model
+        for mid in params:
+            for plen in (prompt_short, prompt_long):
+                warm = np.asarray(jax.random.randint(
+                    jax.random.PRNGKey(plen), (plen,), 0, cfg.vocab_size))
+                fleet.submit(warm, 2, model=mid).result(timeout=1800)
+        t0 = time.perf_counter()
+        futs = [fleet.submit(p, budget, model=mid) for mid, p in reqs]
+        for f in futs:
+            f.result(timeout=1800)
+        wall = time.perf_counter() - t0
+        ttfts = sorted(f.first_token_at - f.submit_at for f in futs)
+    finally:
+        fleet.close()
+    handoff_p99 = METRICS.quantile("serving_kv_handoff_seconds", 0.99)
+    return {
+        "models": 2,
+        "pools": {"prefill": 1, "decode": 2},
+        "kv_dtype": kv_dtype,
+        "requests": n_requests,
+        "prompt_mix": f"{prompt_short}/{prompt_long}",
+        "budget": budget,
+        "wall_s": round(wall, 2),
+        "decode_tok_s_heterogeneous": round(n_requests * budget / wall, 1),
+        "ttft_p99_s": round(ttfts[min(len(ttfts) - 1,
+                                      int(len(ttfts) * 0.99))], 4),
+        "kv_handoff_p99_s": (round(handoff_p99, 4)
+                             if handoff_p99 is not None else 0.0),
     }
 
 
@@ -298,6 +400,14 @@ def main() -> int:
           f" vs {cont['static_tokens_per_sec']:8.1f} tok/s ({cont['speedup']}x)")
     print(json.dumps({"metric": "gpt_continuous_batching", **cont,
                       "unit": "tokens_per_sec"}))
+    if os.environ.get("BENCH_DISAGG", "1") == "1":
+        dis = bench_disagg()
+        print(f"{'Disagg heterogeneous mix':28s} "
+              f"{dis['decode_tok_s_heterogeneous']:8.1f} tok/s "
+              f"(handoff p99 {dis['kv_handoff_p99_s']}s)")
+        print(json.dumps({"metric": "decode_tok_s_heterogeneous",
+                          "value": dis["decode_tok_s_heterogeneous"],
+                          "unit": "tokens_per_sec", **dis}))
     return 0
 
 

@@ -139,11 +139,21 @@ class GptAttention(nn.Module):
     paged: bool = False
     kv_blocks: int = 0
     kv_block_t: int = 16
+    # kv_dtype: arena storage precision (ISSUE 18). "bf16" stores cfg.dtype
+    # directly (bit-parity ground truth); "int8" stores symmetric
+    # per-(row, head) quantized values with an f32 scale arena alongside
+    # ("k_scale"/"v_scale" [kv_blocks, kv_block_t, h, 1]) — 2x KV positions
+    # per HBM byte, dequantized to f32 at the attention read.
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  block_tables: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype {self.kv_dtype!r}: expected bf16|int8")
+        if self.kv_dtype == "int8" and self.decode and not self.paged:
+            raise ValueError("int8 KV cache requires the paged arena layout")
         dense = functools.partial(
             nn.DenseGeneral,
             features=(cfg.n_heads, cfg.head_dim),
@@ -284,10 +294,16 @@ class GptAttention(nn.Module):
         """
         cfg = self.cfg
         b, seg_len = x.shape[0], x.shape[1]
+        quant = self.kv_dtype == "int8"
         arena_shape = (max(self.kv_blocks, 1), self.kv_block_t,
                        cfg.n_heads, cfg.head_dim)
-        cache_k = self.variable("cache", "k_arena", jnp.zeros, arena_shape, cfg.dtype)
-        cache_v = self.variable("cache", "v_arena", jnp.zeros, arena_shape, cfg.dtype)
+        arena_dtype = jnp.int8 if quant else cfg.dtype
+        cache_k = self.variable("cache", "k_arena", jnp.zeros, arena_shape, arena_dtype)
+        cache_v = self.variable("cache", "v_arena", jnp.zeros, arena_shape, arena_dtype)
+        if quant:
+            scale_shape = arena_shape[:3] + (1,)
+            scale_k = self.variable("cache", "k_scale", jnp.zeros, scale_shape, jnp.float32)
+            scale_v = self.variable("cache", "v_scale", jnp.zeros, scale_shape, jnp.float32)
         cursors = self.variable("cache", "cursors", lambda: jnp.zeros((b,), jnp.int32))
         if block_tables is None:
             raise ValueError("paged decode needs block_tables=[b, max_blocks]")
@@ -299,9 +315,29 @@ class GptAttention(nn.Module):
         use_kernel = (
             _kv_kernel_enabled() if self.kv_kernel is None else self.kv_kernel
         )
-        from ..ops.kv_cache import kv_block_update, kv_block_update_ref
+        from ..ops.kv_cache import (kv_block_update, kv_block_update_quant,
+                                    kv_block_update_ref, quantize_kv)
 
-        if seg_len == 1 and use_kernel:
+        if quant:
+            if seg_len == 1 and use_kernel:
+                keys_arena, k_scales = kv_block_update_quant(
+                    cache_k.value, scale_k.value, k[:, 0], start,
+                    block_tables, max_seq=cfg.max_seq)
+                vals_arena, v_scales = kv_block_update_quant(
+                    cache_v.value, scale_v.value, v[:, 0], start,
+                    block_tables, max_seq=cfg.max_seq)
+            else:
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                keys_arena = kv_block_update_ref(
+                    cache_k.value, kq, start, block_tables, max_seq=cfg.max_seq)
+                vals_arena = kv_block_update_ref(
+                    cache_v.value, vq, start, block_tables, max_seq=cfg.max_seq)
+                k_scales = kv_block_update_ref(
+                    scale_k.value, ks, start, block_tables, max_seq=cfg.max_seq)
+                v_scales = kv_block_update_ref(
+                    scale_v.value, vs, start, block_tables, max_seq=cfg.max_seq)
+        elif seg_len == 1 and use_kernel:
             keys_arena = kv_block_update(
                 cache_k.value, k[:, 0], start, block_tables, max_seq=cfg.max_seq)
             vals_arena = kv_block_update(
@@ -314,13 +350,25 @@ class GptAttention(nn.Module):
         if not self.is_initializing():
             cache_k.value = keys_arena
             cache_v.value = vals_arena
+            if quant:
+                scale_k.value = k_scales
+                scale_v.value = v_scales
             cursors.value = start + seg_len
 
         bt = arena_shape[1]
         mb = block_tables.shape[1]
         view = (b, mb * bt, cfg.n_heads, cfg.head_dim)
-        keys = keys_arena[block_tables].reshape(view)
-        values = vals_arena[block_tables].reshape(view)
+        if quant:
+            # load-dequantized read: gather values + scales through the same
+            # table, dequantize to f32 (the einsum below is f32 regardless)
+            sview = (b, mb * bt, cfg.n_heads, 1)
+            keys = (keys_arena[block_tables].reshape(view).astype(jnp.float32)
+                    * k_scales[block_tables].reshape(sview))
+            values = (vals_arena[block_tables].reshape(view).astype(jnp.float32)
+                      * v_scales[block_tables].reshape(sview))
+        else:
+            keys = keys_arena[block_tables].reshape(view)
+            values = vals_arena[block_tables].reshape(view)
         mask = (jnp.arange(mb * bt)[None, None, None, :]
                 <= seg_positions[:, None, :, None])             # [b,1,L,mb*bt]
         scale = cfg.head_dim**-0.5
@@ -361,6 +409,7 @@ class GptBlock(nn.Module):
     paged: bool = False
     kv_blocks: int = 0
     kv_block_t: int = 16
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
@@ -369,7 +418,7 @@ class GptBlock(nn.Module):
         ln = functools.partial(nn.LayerNorm, dtype=jnp.float32, param_dtype=jnp.float32)
         x = x + GptAttention(cfg, self.attention_fn, self.decode, self.per_slot,
                              self.kv_kernel, self.paged, self.kv_blocks,
-                             self.kv_block_t, name="attention")(
+                             self.kv_block_t, self.kv_dtype, name="attention")(
             ln(name="ln_attn")(x).astype(cfg.dtype), positions, block_tables
         )
         normed = ln(name="ln_mlp")(x).astype(cfg.dtype)
@@ -409,6 +458,7 @@ class GptLM(nn.Module):
     paged: bool = False
     kv_blocks: int = 0
     kv_block_t: int = 16
+    kv_dtype: str = "bf16"
 
     @nn.compact
     def __call__(self, input_ids: jax.Array, *,
@@ -455,7 +505,7 @@ class GptLM(nn.Module):
             for i in range(cfg.n_layers):
                 x = block(cfg, self.attention_fn, self.mesh, self.decode,
                           self.per_slot, self.kv_kernel, self.paged,
-                          self.kv_blocks, self.kv_block_t,
+                          self.kv_blocks, self.kv_block_t, self.kv_dtype,
                           name=f"block_{i}")(x, positions, block_tables)
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32, name="ln_final")(x)
         if return_hidden:

@@ -176,6 +176,117 @@ def kv_block_update(arena: jax.Array, new: jax.Array, cursors: jax.Array,
       arena, new.astype(arena.dtype))
 
 
+# ---------------------------------------------------------------------------
+# int8 KV quantization — ISSUE 18.
+#
+# Symmetric per-(position-row, head) quantization: one f32 scale per written
+# KV vector's head, computed as abs-max over head_dim / 127. The scale rides
+# in a parallel arena shaped [N, block_t, H, 1] so the exact same block-table
+# indirection (and the same scatter reference) addresses it. Zero-point is
+# implicitly 0 (symmetric): rope'd keys and values are zero-mean enough that
+# an asymmetric zero-point buys <0.1% extra SNR for 2x the bookkeeping.
+# Everything is computed in f32 with round-half-even, so the Pallas kernel,
+# the XLA reference, and the host-side helper produce bit-identical int8 —
+# the KV-handoff byte-parity contract depends on that.
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(x: jax.Array):
+    """Quantize KV vectors symmetrically per head row.
+
+    x: [..., H, D] (bf16/f32) -> (int8 [..., H, D], f32 scales [..., H, 1]).
+    ``dequantize_kv(q, s)`` recovers x to within scale/2 per element. All-zero
+    rows quantize to zeros with scale 0 (dequant is exactly 0).
+    """
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0
+    q = jnp.clip(jnp.round(xf / jnp.where(scale > 0, scale, 1.0)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`quantize_kv` (f32 out)."""
+    return q.astype(jnp.float32) * scale
+
+
+# Compiled quantizer shared by the adoption path and the KV-wire exporter.
+# Eager and jitted quantize_kv disagree by ~1 ULP in scale (XLA rewrites the
+# division to a reciprocal multiply), which flips int8 codes at rounding
+# boundaries — jit-vs-jit is bit-identical across batch shapes, so every
+# producer of arena bytes must go through this one entry point for the
+# moved-vs-never-moved parity contract to hold.
+quantize_kv_jit = jax.jit(quantize_kv)
+
+
+def _paged_quant_kernel(cur_ref, tbl_ref, arena_ref, scale_ref, new_ref,
+                        q_out_ref, s_out_ref, *, block_t: int, max_seq: int):
+    s = pl.program_id(0)
+    cur = cur_ref[s]
+    off = jnp.minimum(cur, max_seq - 1) % block_t
+    q_out_ref[...] = arena_ref[...]
+    s_out_ref[...] = scale_ref[...]
+    x = new_ref[0].astype(jnp.float32)                       # [1, H, D]
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    q = jnp.clip(jnp.round(x / jnp.where(scale > 0, scale, 1.0)),
+                 -127, 127).astype(jnp.int8)
+    write = cur < max_seq
+    q_out_ref[0, pl.dslice(off, 1)] = jnp.where(
+        write, q, arena_ref[0, pl.dslice(off, 1)])
+    s_out_ref[0, pl.dslice(off, 1)] = jnp.where(
+        write, scale, scale_ref[0, pl.dslice(off, 1)])
+
+
+@functools.partial(jax.jit, static_argnames=("max_seq", "interpret"))
+def kv_block_update_quant(arena: jax.Array, scales: jax.Array, new: jax.Array,
+                          cursors: jax.Array, tables: jax.Array, *,
+                          max_seq: int, interpret: bool | None = None):
+    """Store-quantized variant of :func:`kv_block_update`.
+
+    arena: [N, block_t, H, D] int8; scales: [N, block_t, H, 1] f32; new:
+    [S, H, D] (or [S, 1, H, D]) bf16/f32. Quantizes ``new`` INSIDE the
+    kernel (same math as :func:`quantize_kv`) and writes value + scale
+    through the block table in one pass — both arenas alias in place. Same
+    out-of-range no-op contract as the bf16 kernel.
+    """
+    N, block_t, H, D = arena.shape
+    S = new.shape[0]
+    mb = tables.shape[1]
+    if new.ndim == 3:
+        new = new[:, None]
+    if interpret is None:
+        interpret = _interpret_default()
+
+    def arena_block(s, cur, tbl):
+        pos = jnp.minimum(cur[s], max_seq - 1)
+        return (tbl[s, jnp.minimum(pos // block_t, mb - 1)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, block_t, H, D), arena_block),
+            pl.BlockSpec((1, block_t, H, 1), arena_block),
+            pl.BlockSpec((1, 1, H, D), lambda s, cur, tbl: (s, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_t, H, D), arena_block),
+            pl.BlockSpec((1, block_t, H, 1), arena_block),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_quant_kernel, block_t=block_t,
+                          max_seq=max_seq),
+        out_shape=[jax.ShapeDtypeStruct(arena.shape, jnp.int8),
+                   jax.ShapeDtypeStruct(scales.shape, jnp.float32)],
+        grid_spec=grid_spec,
+        # flattened args: (cursors, tables, arena, scales, new)
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(cursors.astype(jnp.int32), tables.astype(jnp.int32),
+      arena, scales, new)
+
+
 def kv_block_update_ref(arena: jax.Array, seg: jax.Array, cursors: jax.Array,
                         tables: jax.Array, *, max_seq: int) -> jax.Array:
     """XLA scatter reference for :func:`kv_block_update`, generalized to

@@ -36,12 +36,16 @@ from ..runtime.metrics import METRICS, quantile_from_counts
 
 TTFT_METRIC = "serving_ttft_seconds"
 QUEUE_WAIT_METRIC = "serving_queue_wait_seconds"
+INTER_TOKEN_METRIC = "serving_inter_token_seconds"
 
 
 @dataclass
 class AutoscalerConfig:
     ttft_slo: float = 1.0          # p-q TTFT ceiling (seconds)
     queue_wait_slo: float = 0.5    # p-q queue-wait ceiling (seconds)
+    #: p-q inter-token gap ceiling — the DECODE pool's SLO on a
+    #: disaggregated fleet (TTFT belongs to the prefill pool there)
+    inter_token_slo: float = 0.1
     quantile: float = 0.99
     scale_down_margin: float = 0.5  # idle iff p-q < margin * SLO (or no traffic)
     breach_ticks: int = 2
@@ -167,9 +171,11 @@ class SLOAutoscaler:
         self.config = config or AutoscalerConfig()
         self._registry = registry
         self._source = source if source is not None else RegistryWindowSource(registry)
-        self._breach_streak = 0
-        self._idle_streak = 0
-        self._cooldown = 0
+        #: per-pool hysteresis state ("unified" for a homogeneous fleet;
+        #: "prefill"/"decode" each keep their OWN streaks and cooldown on a
+        #: disaggregated one — a prefill burst must not cool down a decode
+        #: decision, and vice versa)
+        self._pool_state: Dict[str, Dict[str, int]] = {}
         self._ticks = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -181,72 +187,113 @@ class SLOAutoscaler:
         return self._source.window(name, self.config.quantile)
 
     # -- one evaluation ------------------------------------------------------
-    def tick(self) -> Optional[str]:
-        """Evaluate one window; returns ``"up"``/``"down"``/None."""
+    def _evaluate(self, pool: str, windows: List[Tuple[_Window, float]],
+                  size: int, lo: int, hi: int,
+                  pass_pool: bool) -> Tuple[Optional[str], Dict]:
+        """Run one pool's hysteresis state machine over its (window, SLO)
+        pairs; scales the fleet and returns ``(decision, debug_state)``.
+        The staleness-holds-streaks discipline (PR 10) applies per pool."""
         cfg = self.config
-        self._ticks += 1
-        ttft = self._window(TTFT_METRIC)
-        qwait = self._window(QUEUE_WAIT_METRIC)
-
-        def _breach(w: _Window, slo: float) -> bool:
-            return w.value is not None and w.value > slo
-
-        def _idle(w: _Window, slo: float) -> bool:
-            return w.value is None or w.value < cfg.scale_down_margin * slo
-
-        stale = ttft.stale or qwait.stale
+        st = self._pool_state.setdefault(
+            pool, {"breach": 0, "idle": 0, "cooldown": 0})
+        stale = any(w.stale for w, _ in windows)
         breach = (not stale
-                  and (_breach(ttft, cfg.ttft_slo)
-                       or _breach(qwait, cfg.queue_wait_slo)))
+                  and any(w.value is not None and w.value > slo
+                          for w, slo in windows))
         idle = (not stale and not breach
-                and _idle(ttft, cfg.ttft_slo)
-                and _idle(qwait, cfg.queue_wait_slo))
+                and all(w.value is None
+                        or w.value < cfg.scale_down_margin * slo
+                        for w, slo in windows))
         if stale:
             # an untrustworthy window (scrape gap / frozen series) HOLDS:
             # both streaks reset, no decision — staleness is not idleness
-            self._breach_streak = 0
-            self._idle_streak = 0
+            st["breach"] = st["idle"] = 0
         elif breach:
-            self._breach_streak += 1
-            self._idle_streak = 0
+            st["breach"] += 1
+            st["idle"] = 0
         elif idle:
-            self._idle_streak += 1
-            self._breach_streak = 0
+            st["idle"] += 1
+            st["breach"] = 0
         else:  # hysteresis band between margin*SLO and SLO: hold
-            self._breach_streak = 0
-            self._idle_streak = 0
-        if self._cooldown > 0:
-            self._cooldown -= 1
+            st["breach"] = st["idle"] = 0
+        if st["cooldown"] > 0:
+            st["cooldown"] -= 1
 
         decision: Optional[str] = None
         reason = ""
-        replicas = self.fleet.desired_replicas
-        if (self._breach_streak >= cfg.breach_ticks and self._cooldown == 0
-                and replicas < self.fleet.max_replicas):
+        if (st["breach"] >= cfg.breach_ticks and st["cooldown"] == 0
+                and size < hi):
             reason = "slo_breach"
-            self.fleet.scale_to(replicas + 1, reason=reason)
             decision = "up"
-        elif (self._idle_streak >= cfg.idle_ticks and self._cooldown == 0
-              and replicas > self.fleet.min_replicas):
+        elif (st["idle"] >= cfg.idle_ticks and st["cooldown"] == 0
+              and size > lo):
             reason = "idle"
-            self.fleet.scale_to(replicas - 1, reason=reason)
             decision = "down"
         if decision is not None:
-            self._breach_streak = 0
-            self._idle_streak = 0
-            self._cooldown = cfg.cooldown_ticks
-            METRICS.counter("fleet_autoscale_total",
-                            direction=decision, reason=reason).inc()
+            target = size + 1 if decision == "up" else size - 1
+            if pass_pool:
+                self.fleet.scale_to(target, reason=reason, pool=pool)
+            else:
+                self.fleet.scale_to(target, reason=reason)
+            st["breach"] = st["idle"] = 0
+            st["cooldown"] = cfg.cooldown_ticks
+            METRICS.counter("fleet_autoscale_total", direction=decision,
+                            reason=reason, pool=pool).inc()
+        state = {"stale": stale, "breach_streak": st["breach"],
+                 "idle_streak": st["idle"], "cooldown": st["cooldown"],
+                 "decision": decision}
+        return decision, state
 
+    def tick(self) -> Optional[str]:
+        """Evaluate one window; returns ``"up"``/``"down"``/None (on a
+        disaggregated fleet: the prefill decision if any, else decode's).
+
+        A unified fleet scales off TTFT + queue-wait as before. A
+        disaggregated fleet (``fleet.pools``) evaluates each pool against
+        the signal that pool actually owns: prefill off the TTFT p-q
+        (prefill compute IS time-to-first-token), decode off the
+        inter-token p-q (decode slot contention stretches the gap between
+        tokens) — each with independent streaks and cooldown."""
+        cfg = self.config
+        self._ticks += 1
+        pools = getattr(self.fleet, "pools", None)
+        if pools:
+            ttft = self._window(TTFT_METRIC)
+            itl = self._window(INTER_TOKEN_METRIC)
+            dp, sp = self._evaluate(
+                "prefill", [(ttft, cfg.ttft_slo)],
+                self.fleet.pool_size("prefill"), 1,
+                self.fleet.max_replicas, pass_pool=True)
+            dd, sd = self._evaluate(
+                "decode", [(itl, cfg.inter_token_slo)],
+                self.fleet.pool_size("decode"), 1,
+                self.fleet.max_replicas, pass_pool=True)
+            decision = dp or dd
+            self.last = {
+                "tick": self._ticks,
+                "source": self._source.name,
+                "ttft_p": ttft.value, "ttft_samples": ttft.samples,
+                "inter_token_p": itl.value, "inter_token_samples": itl.samples,
+                "prefill": dict(sp, replicas=self.fleet.pool_size("prefill")),
+                "decode": dict(sd, replicas=self.fleet.pool_size("decode")),
+                "decision": decision,
+            }
+            return decision
+        ttft = self._window(TTFT_METRIC)
+        qwait = self._window(QUEUE_WAIT_METRIC)
+        decision, st = self._evaluate(
+            "unified", [(ttft, cfg.ttft_slo), (qwait, cfg.queue_wait_slo)],
+            self.fleet.desired_replicas, self.fleet.min_replicas,
+            self.fleet.max_replicas, pass_pool=False)
         self.last = {
             "tick": self._ticks,
             "source": self._source.name,
-            "stale": stale,
+            "stale": st["stale"],
             "ttft_p": ttft.value, "ttft_samples": ttft.samples,
             "queue_wait_p": qwait.value, "queue_wait_samples": qwait.samples,
-            "breach_streak": self._breach_streak,
-            "idle_streak": self._idle_streak,
-            "cooldown": self._cooldown,
+            "breach_streak": st["breach_streak"],
+            "idle_streak": st["idle_streak"],
+            "cooldown": st["cooldown"],
             "replicas": self.fleet.desired_replicas,
             "decision": decision,
         }

@@ -72,6 +72,10 @@ PREFILL_BUCKETS_S = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                      10.0)
 DECODE_CHUNK_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                         0.5, 1.0, 2.5)
+#: KV handoff blob sizes span ~KBs (tiny configs) to ~100s of MB (long
+#: prompts on the base config) — a power-of-8 ladder covers both
+HANDOFF_BYTES_BUCKETS = (1024.0, 8192.0, 65536.0, 524288.0, 4194304.0,
+                         33554432.0, 268435456.0)
 
 #: ceiling on one batched prefill's rows: every admission group is padded
 #: to ``min(slots, MAX_GROUP)`` (ONE prefill program + ONE reusable zero
@@ -154,6 +158,13 @@ class _Request:
     submit_at: Optional[float] = None       # perf_counter at enqueue
     first_token_at: Optional[float] = None  # perf_counter at first token
     last_token_at: Optional[float] = None   # perf_counter at latest token
+    #: multiplexing id (ISSUE 18) — which served model this request targets
+    model_id: str = ""
+    #: the request's exported KV wire blob, once a prefill replica has
+    #: shipped it — a decode-pool drain hands the request back with this
+    #: set so the fleet can re-import it on a surviving decode replica
+    #: instead of re-running prefill
+    kv_blob: Optional[bytes] = None
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -230,6 +241,18 @@ class _ChunkedPrefill:
     res: Optional[KVReservation] = None
 
 
+@dataclass(eq=False)
+class _Import:
+    """One KV-wire import awaiting a decode slot (ISSUE 18): the request
+    was prefilled on a PREFILL-pool replica; its KV blocks arrived here
+    already computed (and, int8, already quantized). Admission reserves
+    arena blocks like any other request — wire imports get no back-pressure
+    exemption — then scatters the blocks in one jitted call."""
+    req: _Request
+    manifest: Dict[str, Any]
+    arrays: Dict[str, np.ndarray]
+
+
 class ContinuousBatcher:
     """Slot-based decode engine over one per-slot KV cache.
 
@@ -274,7 +297,11 @@ class ContinuousBatcher:
                  kv_block_t: int = 16,
                  prefill_chunk: Optional[int] = None,
                  spec_draft: Optional[Tuple[GptConfig, Any]] = None,
-                 spec_k: int = 4):
+                 spec_k: int = 4,
+                 kv_dtype: str = "bf16",
+                 role: str = "unified",
+                 model_id: str = "",
+                 handoff_sink: Optional[Callable[["_Request", bytes], None]] = None):
         """New ISSUE-12 knobs (defaults keep every pre-existing behavior):
 
         ``paged``: shared block-arena KV layout with a per-slot block table
@@ -304,10 +331,45 @@ class ContinuousBatcher:
         caches. Greedy requests stay bit-identical to plain decode;
         sampled slots accept exactly one token per round, drawn from the
         verify logits.
+
+        New ISSUE-18 knobs:
+
+        ``kv_dtype``: arena storage precision — ``"bf16"`` (default,
+        bit-parity ground truth) or ``"int8"`` (symmetric per-(row, head)
+        quantized arena + f32 scale arena: 2x KV positions per HBM byte;
+        greedy decode stays within the tested logit tolerance). int8
+        requires the paged layout.
+
+        ``role``: ``"unified"`` (default — prefill and decode in one
+        engine), ``"prefill"`` (runs prefill ONLY: every admitted request
+        is prefilled, exported to the KV wire format, and handed to
+        ``handoff_sink(req, blob)`` — ownership transfers; the sink routes
+        it to a decode replica), or ``"decode"`` (additionally accepts
+        :meth:`submit_handoff` imports whose KV arrives pre-filled over
+        the wire).
+
+        ``model_id``: the served model's multiplexing id — stamped into
+        exported KV manifests so a decode replica can refuse a wire blob
+        from the wrong model.
         """
         self.cfg = cfg
         self.params = params
         self.slots = slots
+        self.kv_dtype = str(kv_dtype)
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"kv_dtype {self.kv_dtype!r}: expected bf16|int8")
+        if self.kv_dtype == "int8" and not paged:
+            raise ValueError("kv_dtype='int8' requires paged=True")
+        self.role = str(role)
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role {self.role!r}: expected unified|prefill|decode")
+        if self.role != "unified" and not paged:
+            raise ValueError(
+                "prefill/decode roles require paged=True (the KV wire "
+                "format is block-shaped)")
+        self.model_id = str(model_id)
+        self.handoff_sink = handoff_sink
         # engine id -> the ``replica`` label on this engine's gauges: N
         # engines sharing one process registry (the fleet) must not clobber
         # each other's queue_depth / slot_occupancy series
@@ -376,7 +438,8 @@ class ContinuousBatcher:
             self.model = GptLM(cfg, decode=True, per_slot=True,
                                kv_kernel=kv_kernel, paged=True,
                                kv_blocks=self._alloc.n_blocks + 1,
-                               kv_block_t=self.kv_block_t)
+                               kv_block_t=self.kv_block_t,
+                               kv_dtype=self.kv_dtype)
         else:
             self.model = GptLM(cfg, decode=True, per_slot=True,
                                kv_kernel=kv_kernel)
@@ -406,8 +469,11 @@ class ContinuousBatcher:
         self._draining = False
         #: requests drain() could not serve — handed off to the fleet router
         self._handoff: List[_Request] = []
+        #: wire-format KV imports awaiting a slot (decode role, ISSUE 18)
+        self._imports: "collections.deque[_Import]" = collections.deque()
         self._step_fn = self._build_step()
         self._adopt_fn = self._build_adopt()
+        self._import_fn = self._build_import() if self.paged else None
         self._spec_fn = self._build_spec_step() if self.spec_k else None
         self._draft_adopt_fn = self._build_draft_adopt() if self.spec_k else None
         self._prefill_fns: Dict[Tuple[int, int, bool], Any] = {}
@@ -426,14 +492,22 @@ class ContinuousBatcher:
         if self.paged:
             arena = (self._alloc.n_blocks + 1, self.kv_block_t,
                      cfg.n_heads, cfg.head_dim)
-            return {
-                f"block_{i}": {"attention": {
-                    "k_arena": jnp.zeros(arena, cfg.dtype),
-                    "v_arena": jnp.zeros(arena, cfg.dtype),
+            quant = self.kv_dtype == "int8"
+            arena_dtype = jnp.int8 if quant else cfg.dtype
+
+            def layer() -> Dict[str, Any]:
+                att = {
+                    "k_arena": jnp.zeros(arena, arena_dtype),
+                    "v_arena": jnp.zeros(arena, arena_dtype),
                     "cursors": jnp.zeros((S,), jnp.int32),
-                }}
-                for i in range(cfg.n_layers)
-            }
+                }
+                if quant:
+                    scale = arena[:3] + (1,)
+                    att["k_scale"] = jnp.zeros(scale, jnp.float32)
+                    att["v_scale"] = jnp.zeros(scale, jnp.float32)
+                return {"attention": att}
+
+            return {f"block_{i}": layer() for i in range(cfg.n_layers)}
         kv = (S, cfg.max_seq, cfg.n_heads, cfg.head_dim)
         return {
             f"block_{i}": {"attention": {
@@ -566,6 +640,7 @@ class ContinuousBatcher:
     def _build_adopt(self):
         if self.paged:
             bt = self.kv_block_t
+            quant = self.kv_dtype == "int8"
 
             @functools.partial(jax.jit, donate_argnums=(0, 5, 6, 7))
             def paged_adopt(cache, small, block_ids, slots, true_lens,
@@ -578,7 +653,12 @@ class ContinuousBatcher:
                 ``block_ids``. Rows' trailing entries are the trash block,
                 so bucket padding past the granted blocks lands in trash;
                 padding inside the last granted block sits above the
-                cursor, which the mask hides until decode overwrites it."""
+                cursor, which the mask hides until decode overwrites it.
+                int8 arenas quantize here with the SAME quantize_kv the KV
+                wire exporter uses — a moved and a never-moved request land
+                byte-identical int8 blocks."""
+                from ..ops.kv_cache import quantize_kv
+
                 n = slots.shape[0]
                 nb = block_ids.shape[1]
                 ids = block_ids.reshape(-1)
@@ -590,13 +670,20 @@ class ContinuousBatcher:
                         n * nb, bt, shape[2], shape[3])
                     seg_v = small_att["v"][:n, :nb * bt].reshape(
                         n * nb, bt, shape[2], shape[3])
-                    k = att["k_arena"].at[ids].set(
-                        seg_k.astype(att["k_arena"].dtype))
-                    v = att["v_arena"].at[ids].set(
-                        seg_v.astype(att["v_arena"].dtype))
-                    cursors = att["cursors"].at[slots].set(true_lens)
-                    out[name] = {"attention": {
-                        "k_arena": k, "v_arena": v, "cursors": cursors}}
+                    upd = {"cursors": att["cursors"].at[slots].set(true_lens)}
+                    if quant:
+                        kq, ks = quantize_kv(seg_k)
+                        vq, vs = quantize_kv(seg_v)
+                        upd["k_arena"] = att["k_arena"].at[ids].set(kq)
+                        upd["v_arena"] = att["v_arena"].at[ids].set(vq)
+                        upd["k_scale"] = att["k_scale"].at[ids].set(ks)
+                        upd["v_scale"] = att["v_scale"].at[ids].set(vs)
+                    else:
+                        upd["k_arena"] = att["k_arena"].at[ids].set(
+                            seg_k.astype(att["k_arena"].dtype))
+                        upd["v_arena"] = att["v_arena"].at[ids].set(
+                            seg_v.astype(att["v_arena"].dtype))
+                    out[name] = {"attention": upd}
                 return (out, last_tok.at[slots].set(first_toks),
                         temps.at[slots].set(temperatures),
                         rngs.at[slots].set(slot_rngs))
@@ -653,6 +740,41 @@ class ContinuousBatcher:
             return out
 
         return draft_adopt
+
+    def _build_import(self):
+        """Jitted KV-wire import (decode role): scatter one request's
+        pre-filled blocks — [nb, block_t, h, d] per layer, plus the f32
+        scale blocks when int8 — into the arena rows just granted to it,
+        and install cursor/sampling state exactly as adoption would. One
+        retrace per distinct block count (shape-keyed under jit), same as
+        the prompt-bucketed adopt."""
+        quant = self.kv_dtype == "int8"
+
+        @functools.partial(jax.jit, donate_argnums=(0, 3, 4, 5))
+        def import_kv(cache, wire, block_ids, last_tok, temps, rngs,
+                      slot, true_len, first_tok, temperature, key):
+            out = {}
+            for name, layer in cache.items():
+                att = layer["attention"]
+                w = wire[name]
+                upd = {
+                    "k_arena": att["k_arena"].at[block_ids].set(
+                        w["k"].astype(att["k_arena"].dtype)),
+                    "v_arena": att["v_arena"].at[block_ids].set(
+                        w["v"].astype(att["v_arena"].dtype)),
+                    "cursors": att["cursors"].at[slot].set(true_len),
+                }
+                if quant:
+                    upd["k_scale"] = att["k_scale"].at[block_ids].set(
+                        w["k_scale"])
+                    upd["v_scale"] = att["v_scale"].at[block_ids].set(
+                        w["v_scale"])
+                out[name] = {"attention": upd}
+            return (out, last_tok.at[slot].set(first_tok),
+                    temps.at[slot].set(temperature),
+                    rngs.at[slot].set(key))
+
+        return import_kv
 
     def _prefill_group(self, prompts: Sequence[np.ndarray],
                        temperatures: Sequence[float], keys,
@@ -752,7 +874,8 @@ class ContinuousBatcher:
                     f"{self._alloc.n_blocks} (raise kv_blocks)")
         req = _Request(prompt, max_new_tokens, eos_id=eos_id,
                        temperature=float(temperature),
-                       deadline=deadline, priority=priority, on_done=on_done)
+                       deadline=deadline, priority=priority, on_done=on_done,
+                       model_id=self.model_id)
         req.span = TRACER.start_span(
             "serving.request", traceparent=traceparent,
             **{"prompt_tokens": int(len(prompt)),
@@ -782,6 +905,49 @@ class ContinuousBatcher:
                 _fail(req, EngineClosed("batcher closed"))
                 raise EngineClosed("batcher closed")
             self._queue.put([req])
+        return req
+
+    def submit_handoff(self, req: _Request, blob: bytes) -> _Request:
+        """Accept a request prefilled ELSEWHERE (decode role, ISSUE 18):
+        ``blob`` is the KV wire export from a prefill-pool replica. The
+        manifest and per-layer crc32s are verified here, synchronously —
+        a corrupt or mismatched blob must fail on the caller's thread
+        (where the fleet can still retry another replica), never poison
+        the decode loop. The SAME request object continues: its future,
+        span, and deadline all carry over, so TTFT measures the true
+        submit→first-token path across both replicas."""
+        if self.role == "prefill":
+            raise ValueError("prefill-role engines cannot import KV")
+        if not self.paged:
+            raise ValueError("KV import requires the paged arena layout")
+        from .kv_wire import unpack_kv
+
+        manifest, arrays = unpack_kv(blob)
+        if manifest.get("kv_dtype") != self.kv_dtype:
+            raise ValueError(
+                f"wire kv_dtype {manifest.get('kv_dtype')!r} != engine "
+                f"{self.kv_dtype!r}")
+        if int(manifest.get("block_t", 0)) != self.kv_block_t:
+            raise ValueError(
+                f"wire block_t {manifest.get('block_t')} != engine "
+                f"{self.kv_block_t}")
+        if manifest.get("model_id", "") != self.model_id:
+            raise ValueError(
+                f"wire model {manifest.get('model_id')!r} != replica model "
+                f"{self.model_id!r}")
+        if int(manifest.get("prompt_len", -1)) != len(req.prompt):
+            raise ValueError("wire prompt_len disagrees with the request")
+        need = self._alloc.blocks_for(len(req.prompt) + req.max_new_tokens)
+        if need > self._alloc.n_blocks:
+            raise ValueError(
+                f"prompt + budget needs {need} KV blocks; the arena has "
+                f"{self._alloc.n_blocks} (raise kv_blocks)")
+        req.kv_blob = blob
+        imp = _Import(req=req, manifest=manifest, arrays=arrays)
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("batcher closed")
+            self._queue.put(imp)
         return req
 
     def cancel_requests(self, n: int = 1) -> int:
@@ -900,6 +1066,27 @@ class ContinuousBatcher:
                   for chunk in by_bucket.values()
                   for i in range(0, len(chunk), self._group_pad)]
         for group in groups:
+            if self.role == "prefill":
+                # prefill specialist: ONE batched prefill, then export each
+                # row's KV blocks + first token over the wire — no slot, no
+                # arena reservation, no decode. Ownership moves to the
+                # handoff sink (the fleet routes it to a decode replica).
+                try:
+                    keys = jnp.stack([k for _, k in group])
+                    t0 = time.perf_counter()
+                    small, first = self._prefill_group(
+                        [r.prompt for r, _ in group],
+                        [r.temperature for r, _ in group], keys)
+                except Exception as e:
+                    for req, _ in group:
+                        _fail(req, e)
+                    continue
+                METRICS.histogram(
+                    "serving_prefill_seconds", buckets=PREFILL_BUCKETS_S
+                ).observe(time.perf_counter() - t0,
+                          trace_id=_trace_id(group[0][0]))
+                self._export_group(group, small, first)
+                continue
             reserved: List[KVReservation] = []
             if self.paged:
                 # reserve worst-case blocks BEFORE spending prefill compute;
@@ -1038,6 +1225,70 @@ class ContinuousBatcher:
         self._set_occupancy()
         return events
 
+    # -- KV handoff: prefill-role export (ISSUE 18) --------------------------
+    def _export_group(self, group, small, first) -> None:
+        """Fetch a prefill group's cache rows + first tokens to host and
+        ship each request over the wire. The host fetch is a deliberate
+        synchronous round trip: a prefill specialist has no decode lane to
+        starve, and the wire serialization needs the bytes anyway."""
+        first_host = np.asarray(first)
+        host = {nm: {"k": np.asarray(l["attention"]["k"]),
+                     "v": np.asarray(l["attention"]["v"])}
+                for nm, l in small.items()}
+        for i, (req, _) in enumerate(group):
+            self._ship(req,
+                       {nm: {"k": d["k"][i], "v": d["v"][i]}
+                        for nm, d in host.items()},
+                       int(first_host[i]))
+
+    def _ship(self, req: _Request, row_cache: Dict[str, Any],
+              first_token: int) -> None:
+        """Export ONE prefilled request ([max_seq, h, d] contiguous rows
+        per layer) to the KV wire format and hand it to the sink. The sink
+        call is synchronous — when it returns without raising, ownership
+        has transferred (a decode replica holds the import); any failure
+        fails the request here, where its future still has an owner."""
+        from .kv_wire import export_kv
+
+        sink = self.handoff_sink
+        if sink is None:
+            _fail(req, RuntimeError(
+                "prefill engine has no handoff_sink — a prefill-role "
+                "replica cannot serve decode itself"))
+            return
+        try:
+            t0 = time.perf_counter()
+            blob = export_kv(
+                row_cache, prompt_len=len(req.prompt),
+                block_t=self.kv_block_t, kv_dtype=self.kv_dtype,
+                first_token=first_token, model_id=self.model_id)
+            req.kv_blob = blob
+            sink(req, blob)
+        except Exception as e:
+            _fail(req, e)
+            return
+        dt = time.perf_counter() - t0
+        METRICS.counter("serving_kv_handoff_total").inc()
+        METRICS.histogram("serving_kv_handoff_bytes",
+                          buckets=HANDOFF_BYTES_BUCKETS).observe(
+            float(len(blob)))
+        METRICS.histogram("serving_kv_handoff_seconds",
+                          buckets=PREFILL_BUCKETS_S).observe(
+            dt, trace_id=_trace_id(req))
+        _ev(req, "kv_handoff", bytes=len(blob))
+
+    def _build_draft_full_prefill(self):
+        dmodel = self._draft_prefill_model
+
+        @jax.jit
+        def draft_full(params, cache, ids):
+            _, updated = dmodel.apply(
+                {"params": params, "cache": cache}, ids,
+                mutable=["cache"])
+            return updated["cache"]
+
+        return draft_full
+
     # -- chunked prefill (ISSUE 12) ------------------------------------------
     def _build_chunk_prefill(self):
         model = self._prefill_model
@@ -1066,7 +1317,9 @@ class ContinuousBatcher:
         Returns False when the arena cannot reserve yet (caller requeues);
         a structurally impossible request fails and returns True."""
         res = None
-        if self.paged:
+        # a prefill specialist never decodes: no arena reservation — the
+        # decode replica that imports the wire blob reserves there
+        if self.paged and self.role != "prefill":
             blocks = self._alloc.blocks_for(len(req.prompt) + req.max_new_tokens)
             try:
                 res = self._alloc.reserve(blocks)
@@ -1152,6 +1405,16 @@ class ContinuousBatcher:
         _ev(req, "prefill_chunk", start=start)
         if not last:
             return []
+        if self.role == "prefill":
+            # last chunk of a long prompt on a prefill specialist: export
+            # instead of adopting — the decode replica owns it from here
+            host = {nm: {"k": np.asarray(l["attention"]["k"])[0],
+                         "v": np.asarray(l["attention"]["v"])[0]}
+                    for nm, l in cp.cache.items()}
+            tok = int(np.asarray(first))
+            self._abort_chunked(cp)
+            self._ship(req, host, tok)
+            return []
         # -- last chunk: adopt + activate -----------------------------------
         slot = cp.slot
         first_arr = first[None]
@@ -1192,16 +1455,7 @@ class ContinuousBatcher:
                 for i in range(dcfg.n_layers)
             }
             if self._draft_full_prefill_fn is None:
-                dmodel = self._draft_prefill_model
-
-                @jax.jit
-                def draft_full(params, cache, ids):
-                    _, updated = dmodel.apply(
-                        {"params": params, "cache": cache}, ids,
-                        mutable=["cache"])
-                    return updated["cache"]
-
-                self._draft_full_prefill_fn = draft_full
+                self._draft_full_prefill_fn = self._build_draft_full_prefill()
             dids = np.zeros((1, cp.pos), np.int32)
             dids[0, :n] = req.prompt
             dsmall = self._draft_full_prefill_fn(
@@ -1226,6 +1480,138 @@ class ContinuousBatcher:
         self._chunked = None
         self._set_occupancy()
         return [("first", first_arr, [(req, slot)], now)]
+
+    # -- KV handoff: decode-role import (ISSUE 18) ---------------------------
+    def _admit_imports(self) -> List[Tuple[str, Any, Any, float]]:
+        """Admit queued KV-wire imports into free slots: reserve arena
+        blocks (normal back-pressure — an exhausted arena leaves the import
+        queued and retries as retirements free blocks), grant the prompt's
+        blocks, scatter the wire payload in one jitted call, and activate.
+        The 'first' event carries the PREFILL replica's first token so the
+        decode side emits it through the standard event path (TTFT from
+        the original submit instant — handoff latency is inside it)."""
+        events: List[Tuple[str, Any, Any, float]] = []
+        quant = self.kv_dtype == "int8"
+        while self._imports and self._free:
+            imp = self._imports[0]
+            req = imp.req
+            if req.done.is_set():
+                self._imports.popleft()
+                continue
+            if req.cancel_requested:
+                self._imports.popleft()
+                req.finish_reason = "cancelled"
+                METRICS.counter("serving_cancelled_total").inc()
+                _ev(req, "cancelled", stage="import")
+                _fail(req, RequestCancelled("cancelled before KV import"))
+                continue
+            if req.expired():
+                self._imports.popleft()
+                req.finish_reason = "deadline"
+                METRICS.counter("serving_deadline_expired_total",
+                                stage="queued").inc()
+                _ev(req, "deadline_expired", stage="import")
+                _fail(req, DeadlineExceeded(
+                    "deadline expired before KV import"))
+                continue
+            n = len(req.prompt)
+            try:
+                res = self._alloc.reserve(
+                    self._alloc.blocks_for(n + req.max_new_tokens))
+            except FleetSaturated:
+                break  # no blocks yet; the import keeps its place in line
+            except Exception as e:
+                self._imports.popleft()
+                _fail(req, e)
+                continue
+            self._imports.popleft()
+            slot = self._free.pop()
+            try:
+                nb = self._alloc.blocks_for(n)
+                self._alloc.grant(res, nb)
+                block_ids = np.asarray(res.granted, np.int32)
+                if any(a.shape[0] != nb for a in imp.arrays.values()):
+                    raise ValueError(
+                        f"wire carries a block count != {nb} for "
+                        f"prompt_len {n}")
+                self._tables[slot, :nb] = block_ids
+                self._slot_res[slot] = res
+                self._ub_cursor[slot] = n
+                wire = {}
+                for i in range(self.cfg.n_layers):
+                    nm = f"block_{i}"
+                    entry = {"k": jnp.asarray(imp.arrays[f"{nm}/k"]),
+                             "v": jnp.asarray(imp.arrays[f"{nm}/v"])}
+                    if quant:
+                        entry["k_scale"] = jnp.asarray(
+                            imp.arrays[f"{nm}/k_scale"])
+                        entry["v_scale"] = jnp.asarray(
+                            imp.arrays[f"{nm}/v_scale"])
+                    wire[nm] = entry
+                self._rng_counter += 1
+                key = jax.random.fold_in(self._base_rng, self._rng_counter)
+                (self.cache, self.last_tok, self.temps, self.rngs) = \
+                    self._import_fn(
+                        self.cache, wire, jnp.asarray(block_ids),
+                        self.last_tok, self.temps, self.rngs,
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(n, jnp.int32),
+                        jnp.asarray(int(imp.manifest["first_token"]),
+                                    jnp.int32),
+                        jnp.asarray(req.temperature, jnp.float32),
+                        jax.random.fold_in(key, 1))
+                if self.spec_k:
+                    # the wire carries no draft KV: the draft re-prefills
+                    # the prompt locally in one forward (it is small by
+                    # construction — that is the draft's whole point)
+                    dcfg = self._draft_cfg
+                    kv = (1, dcfg.max_seq, dcfg.n_heads, dcfg.head_dim)
+                    dzero = {
+                        f"block_{i}": {"attention": {
+                            "k": jnp.zeros(kv, dcfg.dtype),
+                            "v": jnp.zeros(kv, dcfg.dtype),
+                            "cursor": jnp.zeros((), jnp.int32),
+                        }}
+                        for i in range(dcfg.n_layers)
+                    }
+                    if self._draft_full_prefill_fn is None:
+                        self._draft_full_prefill_fn = \
+                            self._build_draft_full_prefill()
+                    pad = nb * self.kv_block_t
+                    dids = np.zeros((1, pad), np.int32)
+                    dids[0, :n] = req.prompt
+                    dsmall = self._draft_full_prefill_fn(
+                        self._draft_params, dzero, jnp.asarray(dids))
+                    dsmall = {nm: {"attention": {
+                        "k": l["attention"]["k"],
+                        "v": l["attention"]["v"]}}
+                        for nm, l in dsmall.items()}
+                    self.draft_cache = self._draft_adopt_fn(
+                        self.draft_cache, dsmall,
+                        jnp.asarray([slot], jnp.int32),
+                        jnp.asarray([n], jnp.int32))
+            except Exception as e:
+                self._free.append(slot)
+                self._tables[slot, :] = self._alloc.trash
+                self._slot_res.pop(slot, None)
+                self._ub_cursor[slot] = 0
+                self._alloc.release(res)
+                _fail(req, e)
+                continue
+            now = time.perf_counter()
+            self._active[slot] = req
+            if req.submit_at is not None:
+                METRICS.histogram(
+                    "serving_queue_wait_seconds", buckets=QUEUE_WAIT_BUCKETS,
+                ).observe(now - req.submit_at, trace_id=_trace_id(req))
+            METRICS.counter("serving_kv_import_total").inc()
+            _ev(req, "admitted", slot=slot)
+            _ev(req, "kv_import", blocks=int(nb))
+            events.append(("first",
+                           np.asarray([imp.manifest["first_token"]], np.int32),
+                           [(req, slot)], now))
+        self._set_occupancy()
+        return events
 
     def _grant_active(self, tokens: int) -> None:
         """Advance every active slot's cursor upper bound by the tokens the
@@ -1395,13 +1781,17 @@ class ContinuousBatcher:
         self._active.clear()
         while self._pending:
             _fail(self._pending.popleft(), EngineClosed(cause))
+        while self._imports:
+            _fail(self._imports.popleft().req, EngineClosed(cause))
         self._set_queue_gauge()
         while True:
             try:
                 rest = self._queue.get_nowait()
             except queue.Empty:
                 return
-            if rest is not None and rest is not _DRAIN:
+            if isinstance(rest, _Import):
+                _fail(rest.req, EngineClosed(cause))
+            elif rest is not None and rest is not _DRAIN:
                 for req in rest:
                     _fail(req, EngineClosed(cause))
 
@@ -1518,7 +1908,8 @@ class ContinuousBatcher:
             try:
                 timeout = (None if not (self._active or self._pending
                                         or events or self._draining
-                                        or self._chunked) else 0.0)
+                                        or self._chunked or self._imports)
+                           else 0.0)
                 while True:
                     item = self._queue.get(timeout=timeout) if timeout is None \
                         else self._queue.get_nowait()
@@ -1531,6 +1922,8 @@ class ContinuousBatcher:
                         # enqueues it), so everything still queued here is
                         # part of the handoff set
                         self._draining = True
+                    elif isinstance(item, _Import):
+                        self._imports.append(item)
                     else:
                         self._enqueue_pendings(item)
                     timeout = 0.0
@@ -1555,6 +1948,12 @@ class ContinuousBatcher:
                 self._reap_pending()
                 self._reap_active()
                 dispatched = False
+                if self._imports and self._free and not self._draining:
+                    # wire imports admit before fresh prompts: their
+                    # prefill compute is already spent — leaving them
+                    # queued behind new admissions would waste it twice
+                    events.extend(self._admit_imports())
+                    dispatched = True
                 if self._free and self._pending and not self._draining:
                     wave = self._next_wave(len(self._free))
                     self._set_queue_gauge()
@@ -1613,9 +2012,15 @@ class ContinuousBatcher:
                         and self._chunked is None):
                     # drain complete: every in-flight slot ran to its
                     # budget/EOS; park the unserved pendings (futures still
-                    # open) for the caller and zero this replica's gauges
+                    # open) for the caller and zero this replica's gauges.
+                    # Unadmitted KV imports park too — their ``kv_blob`` is
+                    # set, so the fleet re-imports them on a surviving
+                    # decode replica instead of re-running prefill.
                     self._handoff.extend(self._pending)
                     self._pending.clear()
+                    self._handoff.extend(imp.req for imp in self._imports
+                                         if not imp.req.done.is_set())
+                    self._imports.clear()
                     self._set_queue_gauge()
                     self._set_occupancy()
                     return

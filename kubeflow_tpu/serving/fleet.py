@@ -29,16 +29,29 @@ replica re-enters the scheduling queue.
 
 ``EngineFleet.submit`` mirrors ``ContinuousBatcher.submit`` so
 ``GenerativeModel`` can use either interchangeably.
+
+Disaggregation (ISSUE 18): ``pools={"prefill": p, "decode": d}`` splits
+the fleet by phase — requests enter through prefill specialists
+(``role="prefill"`` engines), which ship the finished KV state over the
+wire format (serving/kv_wire.py) to the fleet's handoff sink; the sink
+routes each blob to the least-loaded same-model decode replica via
+``submit_handoff``. A long prompt therefore never occupies a decode slot
+during its compute-bound phase. ``models={model_id: (cfg, params)}``
+multiplexes several models over the same pools: every pool holds its
+per-model target count of replicas, routing is scoped to same-role
+same-model handles, and ``model_slo`` maps each model to its default
+admission class (the PR 9 two-class reserve).
 """
 
 from __future__ import annotations
 
 import collections
+import inspect
 import logging
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..runtime.metrics import METRICS
 from ..runtime.obs import register_debug_source
@@ -179,6 +192,8 @@ class ReplicaHandle:
     engine: Any
     gauge_id: str  # the engine's ``replica`` gauge label
     state: str = "pending"  # pending | ready | draining | stopped
+    role: str = "unified"  # unified | prefill | decode (the engine's pool)
+    model_id: str = ""  # multiplexed model this replica serves ("" = only)
     #: LRU of prefix keys routed here (contents owned by PrefixRouter)
     prefixes: "collections.OrderedDict" = field(
         default_factory=collections.OrderedDict)
@@ -205,8 +220,11 @@ class EngineFleet:
                  max_replicas: int = 8, slots: int = 8, chunk: int = 16,
                  pipeline: int = 3, name: str = "fleet",
                  router: Optional[PrefixRouter] = None,
-                 engine_factory: Optional[Callable[[str], Any]] = None,
+                 engine_factory: Optional[Callable[..., Any]] = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None,
+                 pools: Optional[Dict[str, int]] = None,
+                 models: Optional[Dict[str, Tuple[Any, Any]]] = None,
+                 model_slo: Optional[Dict[str, str]] = None,
                  client: Any = None, namespace: str = "default",
                  replica_chips: int = 0, priority_class: str = "default",
                  poll_interval: float = 0.2, register_debug: bool = True,
@@ -228,23 +246,57 @@ class EngineFleet:
         self._replica_chips = int(replica_chips)
         self._priority_class = priority_class
         self._poll_interval = poll_interval
+        # -- ISSUE-18 disaggregation / multiplexing config -------------------
+        if pools is not None:
+            if (set(pools) != {"prefill", "decode"}
+                    or any(int(n) < 1 for n in pools.values())):
+                raise ValueError(
+                    "pools must map BOTH 'prefill' and 'decode' to >= 1 "
+                    f"replicas, got {pools!r}")
+        self._pools_cfg = ({k: int(v) for k, v in pools.items()}
+                           if pools else None)
+        self._models = dict(models) if models else None
+        #: model ids replicas are minted for ("" = the single anonymous one)
+        self._model_ids = list(self._models) if self._models else [""]
+        self._model_slo = dict(model_slo or {})
+        for mid in self._model_slo:
+            if self._models is not None and mid not in self._models:
+                raise ValueError(f"model_slo names unknown model {mid!r}")
         if engine_factory is None:
-            if cfg is None or params is None:
-                raise ValueError("EngineFleet needs cfg+params or an engine_factory")
+            if self._models is None and (cfg is None or params is None):
+                raise ValueError(
+                    "EngineFleet needs cfg+params, models=, or an engine_factory")
+            fleet = self
 
-            def engine_factory(engine_id: str):
+            def engine_factory(engine_id: str, role: str = "unified",
+                               model_id: str = ""):
                 from .continuous import ContinuousBatcher
 
                 # engine_kwargs: ISSUE-12 per-engine knobs (paged KV arena
                 # sizing, chunked prefill, speculative decoding) forwarded
                 # verbatim so GenerativeModel configures fleets and single
                 # engines identically
-                return ContinuousBatcher(cfg, params, slots=slots,
-                                         chunk=chunk, pipeline=pipeline,
-                                         engine_id=engine_id,
-                                         **(engine_kwargs or {}))
+                mcfg, mparams = (fleet._models[model_id] if fleet._models
+                                 else (cfg, params))
+                return ContinuousBatcher(
+                    mcfg, mparams, slots=slots, chunk=chunk,
+                    pipeline=pipeline, engine_id=engine_id,
+                    role=role, model_id=model_id,
+                    handoff_sink=(fleet._handoff_sink if role == "prefill"
+                                  else None),
+                    **(engine_kwargs or {}))
 
         self._factory = engine_factory
+        # injected factories predate pools/models: only call them with
+        # role=/model_id= when their signature can take the keywords
+        try:
+            sig = inspect.signature(self._factory)
+            self._factory_pool_aware = (
+                "role" in sig.parameters
+                or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            self._factory_pool_aware = False
         self._lock = threading.RLock()
         self._replicas: Dict[str, ReplicaHandle] = {}
         self._next_id = 0
@@ -252,10 +304,22 @@ class EngineFleet:
         #: recent drains for /debug/fleet: (replica, reason, seconds, requeued)
         self._drains: "collections.deque" = collections.deque(maxlen=32)
         self._scale_log: "collections.deque" = collections.deque(maxlen=32)
-        self._target = self.min_replicas  # last scale_to target (watcher restores to it)
-        self.scale_to(max(self.min_replicas, min(int(replicas),
-                                                 self.max_replicas)),
-                      reason="initial")
+        #: last scale_to target PER (role, model) — the watcher restores
+        #: preempted pools to these
+        self._targets: Dict[Tuple[str, str], int] = {}
+        if self._pools_cfg:
+            # each pool keeps >= 1 replica per model: a disaggregated fleet
+            # with no prefill (or no decode) replicas can serve nothing
+            self._pool_min = {r: 1 for r in self._pools_cfg}
+            self._pool_max = {r: self.max_replicas for r in self._pools_cfg}
+            for role, count in self._pools_cfg.items():
+                self.scale_to(count, reason="initial", pool=role)
+        else:
+            self._pool_min = {"unified": self.min_replicas}
+            self._pool_max = {"unified": self.max_replicas}
+            self.scale_to(max(self.min_replicas, min(int(replicas),
+                                                     self.max_replicas)),
+                          reason="initial")
         self._watcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if client is not None:
@@ -278,38 +342,78 @@ class EngineFleet:
             return [h for h in self._replicas.values()
                     if h.state in ("pending", "ready")]
 
-    def scale_to(self, n: int, reason: str = "") -> None:
-        """Grow or shrink the fleet to ``n`` live replicas (clamped to
-        [min_replicas, max_replicas]). Shrinking drains the newest ready
-        replicas — their pendings re-queue to survivors."""
-        n = max(self.min_replicas, min(int(n), self.max_replicas))
+    @property
+    def pools(self) -> Optional[Dict[str, int]]:
+        """Configured role pools (``None`` = unified fleet). The
+        autoscaler keys its per-pool evaluation off this."""
+        return dict(self._pools_cfg) if self._pools_cfg else None
+
+    def _default_pool(self) -> str:
+        # pool=None targets the pool serving capacity competes for:
+        # "unified" normally, "decode" when disaggregated
+        return "decode" if self._pools_cfg else "unified"
+
+    def _pool_handles(self, role: str, model_id: str) -> List[ReplicaHandle]:
+        """Caller holds the lock."""
+        return [h for h in self._replicas.values()
+                if h.role == role and h.model_id == model_id
+                and h.state in ("pending", "ready")]
+
+    def pool_size(self, pool: Optional[str] = None) -> int:
+        """Live replicas in ``pool``, per model (the fleet keeps every
+        model at the same per-pool count, so this reports the max)."""
+        role = pool or self._default_pool()
+        with self._lock:
+            return max((len(self._pool_handles(role, mid))
+                        for mid in self._model_ids), default=0)
+
+    def scale_to(self, n: int, reason: str = "",
+                 pool: Optional[str] = None) -> None:
+        """Grow or shrink ``pool`` to ``n`` live replicas PER MODEL
+        (clamped to the pool's bounds; ``pool=None`` targets the unified
+        pool — or the decode pool on a disaggregated fleet, since decode
+        slots are the capacity callers compete for). Shrinking drains the
+        newest ready replicas — their pendings re-queue to survivors."""
+        role = pool or self._default_pool()
+        lo = self._pool_min.get(role, 1)
+        hi = self._pool_max.get(role, self.max_replicas)
+        n = max(lo, min(int(n), hi))
         victims: List[str] = []
         with self._lock:
             if self._closed:
                 return
-            self._target = n
-            current = self.desired_replicas
-            while current < n:
-                self._add_replica()
-                current += 1
-            if current > n:
-                live = [h for h in self._replicas.values()
-                        if h.state in ("pending", "ready")]
-                live.sort(key=lambda h: h.started_at, reverse=True)
-                victims = [h.id for h in live[: current - n]]
+            for mid in self._model_ids:
+                self._targets[(role, mid)] = n
+                handles = self._pool_handles(role, mid)
+                current = len(handles)
+                while current < n:
+                    self._add_replica(role=role, model_id=mid)
+                    current += 1
+                if current > n:
+                    handles.sort(key=lambda h: h.started_at, reverse=True)
+                    victims.extend(h.id for h in handles[: current - n])
             self._scale_log.append({"at": time.time(), "to": n,
-                                    "reason": reason})
+                                    "pool": role, "reason": reason})
         for rid in victims:
             self.drain_replica(rid, reason=reason or "scale_down")
         self._set_replica_gauge()
 
-    def _add_replica(self) -> ReplicaHandle:
+    def _add_replica(self, role: str = "unified",
+                     model_id: str = "") -> ReplicaHandle:
         """Caller holds the lock."""
         rid = str(self._next_id)
         self._next_id += 1
         gauge_id = f"{self.name}-{rid}"
-        engine = self._factory(gauge_id)
+        if self._factory_pool_aware:
+            engine = self._factory(gauge_id, role=role, model_id=model_id)
+        elif role != "unified" or model_id:
+            raise ValueError(
+                "engine_factory must accept role=/model_id= keywords to "
+                "build pooled or multi-model replicas")
+        else:
+            engine = self._factory(gauge_id)
         handle = ReplicaHandle(id=rid, engine=engine, gauge_id=gauge_id,
+                               role=role, model_id=model_id,
                                breaker=self._breaker_factory())
         METRICS.gauge("fleet_breaker_state", replica=gauge_id).set(
             handle.breaker.state_code)
@@ -324,6 +428,13 @@ class EngineFleet:
 
     def _set_replica_gauge(self) -> None:
         METRICS.gauge("fleet_replicas").set(self.desired_replicas)
+        if self._pools_cfg:
+            with self._lock:
+                for role in self._pools_cfg:
+                    n = sum(1 for h in self._replicas.values()
+                            if h.role == role
+                            and h.state in ("pending", "ready"))
+                    METRICS.gauge("fleet_pool_replicas", pool=role).set(n)
 
     # -- scheduler integration ----------------------------------------------
     def _pod_body(self, handle: ReplicaHandle) -> Dict[str, Any]:
@@ -394,12 +505,16 @@ class EngineFleet:
                     # preempted (scheduler deletes victim pods) or killed
                     self.drain_replica(h.id, reason="preempted")
                     with self._lock:
-                        # restore the last scale_to target: the replacement
-                        # replica re-enters the scheduler queue and binds
-                        # whenever the ledger next has chips
+                        # restore the last scale_to target for the victim's
+                        # (pool, model): the replacement replica re-enters
+                        # the scheduler queue and binds whenever the ledger
+                        # next has chips
+                        tgt = self._targets.get((h.role, h.model_id), 0)
                         if (not self._closed
-                                and self.desired_replicas < self._target):
-                            self._add_replica()
+                                and len(self._pool_handles(h.role,
+                                                           h.model_id)) < tgt):
+                            self._add_replica(role=h.role,
+                                              model_id=h.model_id)
                     self._set_replica_gauge()
                     continue
                 node = (pod.get("spec") or {}).get("nodeName")
@@ -452,16 +567,30 @@ class EngineFleet:
                eos_id: Optional[int] = None, temperature: float = 0.0,
                traceparent: Optional[str] = None,
                deadline: Optional[float] = None,
-               priority: str = "interactive"):
+               priority: Optional[str] = None,
+               model: str = ""):
         """Route and submit; same signature/return as
         ``ContinuousBatcher.submit`` so GenerativeModel can't tell the
         difference. Raises :class:`FleetSaturated` (a RuntimeError → the
         HTTP layer's 503) when no replica can take the request.
 
+        ``model`` picks the multiplexed model (required when ``models=``
+        was configured); ``priority=None`` resolves the model's default
+        admission class from ``model_slo`` (falling back to interactive).
+        On a disaggregated fleet the request enters through the prefill
+        pool; its KV then hands off to a decode replica behind the same
+        returned future.
+
         Replicas whose circuit breaker is open are excluded from routing;
         retries beyond the first attempt draw from the fleet-wide
         :class:`RetryBudget` so a dying fleet fails fast instead of
         retry-storming."""
+        if self._models is not None and model not in self._models:
+            raise ValueError(
+                f"unknown model {model!r}: fleet serves {sorted(self._models)}")
+        if priority is None:
+            priority = self._model_slo.get(model, "interactive")
+        entry_role = "prefill" if self._pools_cfg else "unified"
         self.retry_budget.deposit()
         last_err: Optional[BaseException] = None
         for attempt in range(self.MAX_ATTEMPTS):
@@ -471,14 +600,17 @@ class EngineFleet:
             with self._lock:
                 if self._closed:
                     raise RuntimeError("fleet closed")
-                live = self.live_handles()
-                admissible = self._admissible()
+                live = [h for h in self.live_handles()
+                        if h.role == entry_role and h.model_id == model]
+                admissible = [h for h in self._admissible()
+                              if h.role == entry_role and h.model_id == model]
                 if live and not admissible:
                     raise FleetSaturated(
                         f"all {len(live)} replica breakers open",
                         retry_after_s=self.router.retry_after_hint(live))
                 handle, _policy = self.router.route(admissible, prompt_ids,
-                                                    priority=priority)
+                                                    priority=priority,
+                                                    model_id=model)
                 try:
                     return handle.engine.submit(
                         prompt_ids, max_new_tokens, eos_id=eos_id,
@@ -492,6 +624,43 @@ class EngineFleet:
                     self._record_outcome(handle, ok=False)
                     last_err = e
         raise FleetSaturated(f"no replica accepted the request: {last_err}")
+
+    def _handoff_sink(self, req: Any, blob: bytes) -> None:
+        """Prefill engines call this (from their worker thread) with a
+        finished request's KV wire blob. Route it to the least-loaded
+        same-model decode replica; ``submit_handoff`` resumes the ORIGINAL
+        request object, so the caller's future survives the move. On total
+        failure the request fails — the prefill compute is lost, and the
+        client's retry re-enters through the prefill pool."""
+        model = getattr(req, "model_id", "") or ""
+        last_err: Optional[BaseException] = None
+        for _ in range(self.MAX_ATTEMPTS):
+            with self._lock:
+                if self._closed:
+                    last_err = RuntimeError("fleet closed mid-handoff")
+                    break
+                cands = [h for h in self._admissible()
+                         if h.role == "decode" and h.model_id == model]
+            if not cands:
+                last_err = FleetSaturated(
+                    f"no decode replica for model {model!r}")
+                break
+            handle = min(cands, key=self.router.load_score)
+            try:
+                # the decode replica owns the outcome now — rebind the
+                # breaker callback before the import can finish
+                req.on_done = self._outcome_cb(handle)
+                handle.engine.submit_handoff(req, blob)
+            except Exception as e:
+                req.on_done = None
+                last_err = e
+                continue
+            # the warm KV lives on the decode replica: future same-prefix
+            # requests should prefill next to it
+            self.router.note_prefix(handle, req.prompt, model)
+            return
+        self._fail_request(req, last_err
+                           or RuntimeError("KV handoff found no route"))
 
     # -- drain / handoff ------------------------------------------------------
     def drain_replica(self, rid: str, reason: str = "scale_down") -> int:
@@ -535,17 +704,49 @@ class EngineFleet:
         HTTP handlers still hold), so each re-submission gets a bridge
         thread that copies the survivor's outcome back into the original."""
         requeued = 0
+        entry_role = "prefill" if self._pools_cfg else "unified"
         for req in unserved:
             # detach the drained replica's breaker callback: the outcome
             # about to be bridged belongs to the SURVIVOR, which gets its
             # own callback on the shadow submission below
             if hasattr(req, "on_done"):
                 req.on_done = None
+            model = getattr(req, "model_id", "") or ""
+            blob = getattr(req, "kv_blob", None)
+            if blob is not None and self._pools_cfg:
+                # already prefilled: re-IMPORT into a surviving decode
+                # replica — the prefill compute is paid for, and
+                # submit_handoff resumes the ORIGINAL request object, so
+                # no bridge thread is needed
+                with self._lock:
+                    cands = [h for h in self.live_handles()
+                             if h.role == "decode" and h.model_id == model
+                             and h.id != exclude]
+                imported = False
+                for handle in sorted(cands, key=self.router.load_score):
+                    try:
+                        req.on_done = self._outcome_cb(handle)
+                        handle.engine.submit_handoff(req, blob)
+                        imported = True
+                        break
+                    except Exception:
+                        req.on_done = None
+                        continue
+                if imported:
+                    requeued += 1
+                    METRICS.counter("fleet_requeued_total").inc()
+                    continue
+                # no decode survivor took it: fall through to a full
+                # re-submission (re-runs prefill elsewhere)
             try:
                 with self._lock:
+                    handles = [h for h in self.live_handles()
+                               if h.role == entry_role
+                               and h.model_id == model]
                     handle, _policy = self.router.route(
-                        self.live_handles(), req.prompt, exclude=exclude,
-                        priority=getattr(req, "priority", "interactive"))
+                        handles, req.prompt, exclude=exclude,
+                        priority=getattr(req, "priority", "interactive"),
+                        model_id=model)
                     shadow = handle.engine.submit(
                         req.prompt, req.max_new_tokens, eos_id=req.eos_id,
                         temperature=req.temperature,
@@ -639,6 +840,8 @@ class EngineFleet:
             replicas = [{
                 "id": h.gauge_id,
                 "state": h.state,
+                "role": h.role,
+                "model": h.model_id,
                 "queue_depth": reg.value("serving_queue_depth",
                                          replica=h.gauge_id),
                 "active_slots": reg.value("serving_continuous_active_slots",

@@ -1,0 +1,162 @@
+"""KV wire format — how a prefilled request moves between replica pools.
+
+A prefill-pool replica (serving/continuous.py ``role="prefill"``) runs the
+prompt's compute-bound phase, then ships the resulting KV state to a
+decode-pool replica as ONE self-describing blob. Framing follows the PR 7
+canonical per-layer checkpoint: a JSON manifest naming every array (dtype,
+shape, crc32, byte count) followed by the raw buffers in manifest order —
+crc-verified on import, so a truncated or corrupted handoff fails loudly on
+the importer's thread instead of poisoning a decode arena.
+
+Layout per request::
+
+    b"KVW1" | u32 manifest_len | manifest JSON (utf-8) | payload bytes
+
+Arrays are BLOCK-shaped: ``block_{i}/k`` and ``block_{i}/v`` are
+``[nb, block_t, heads, head_dim]`` where ``nb = ceil(prompt_len /
+block_t)`` — exactly the granted-block span a decode replica scatters into
+its arena (serving/paged.py). Positions past ``prompt_len`` inside the
+last block carry prefill-padding garbage; the attention mask hides them
+until decode overwrites, the same contract as never-moved adoption. With
+``kv_dtype="int8"`` the values ship PRE-QUANTIZED (``block_{i}/k_scale`` /
+``v_scale`` ride alongside, ``[nb, block_t, heads, 1]`` f32): the importer
+scatters bytes without re-quantizing, so a moved request's arena blocks
+are byte-identical to a never-moved request's — the handoff parity
+contract in tests/test_fleet.py.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+MAGIC = b"KVW1"
+WIRE_VERSION = 1
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _dtype_name(arr: np.ndarray) -> str:
+    # ml_dtypes.bfloat16 prints as "bfloat16" already; keep numpy names
+    # for everything else
+    return arr.dtype.name
+
+
+def pack(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> bytes:
+    """Frame ``arrays`` (name -> ndarray, insertion order preserved) behind
+    a manifest carrying ``meta`` plus per-array dtype/shape/crc32."""
+    entries = []
+    payload = bytearray()
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        buf = arr.tobytes()
+        entries.append({
+            "name": name,
+            "dtype": _dtype_name(arr),
+            "shape": list(arr.shape),
+            "nbytes": len(buf),
+            "crc32": zlib.crc32(buf) & 0xFFFFFFFF,
+        })
+        payload.extend(buf)
+    manifest = dict(meta)
+    manifest["version"] = WIRE_VERSION
+    manifest["arrays"] = entries
+    mbytes = json.dumps(manifest, sort_keys=True).encode("utf-8")
+    return MAGIC + struct.pack("<I", len(mbytes)) + mbytes + bytes(payload)
+
+
+def unpack(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Inverse of :func:`pack`; verifies the magic, framing, and every
+    array's crc32. Raises ``ValueError`` on any mismatch."""
+    if len(blob) < len(MAGIC) + 4 or blob[:len(MAGIC)] != MAGIC:
+        raise ValueError("not a KV wire blob (bad magic)")
+    (mlen,) = struct.unpack_from("<I", blob, len(MAGIC))
+    mstart = len(MAGIC) + 4
+    if len(blob) < mstart + mlen:
+        raise ValueError("truncated KV wire manifest")
+    manifest = json.loads(blob[mstart:mstart + mlen].decode("utf-8"))
+    if manifest.get("version") != WIRE_VERSION:
+        raise ValueError(f"KV wire version {manifest.get('version')!r} "
+                         f"(expected {WIRE_VERSION})")
+    arrays: Dict[str, np.ndarray] = {}
+    off = mstart + mlen
+    for e in manifest["arrays"]:
+        buf = blob[off:off + e["nbytes"]]
+        if len(buf) != e["nbytes"]:
+            raise ValueError(f"truncated KV wire payload at {e['name']!r}")
+        if (zlib.crc32(buf) & 0xFFFFFFFF) != e["crc32"]:
+            raise ValueError(f"crc mismatch for {e['name']!r}")
+        arrays[e["name"]] = np.frombuffer(
+            buf, dtype=_np_dtype(e["dtype"])).reshape(e["shape"])
+        off += e["nbytes"]
+    if off != len(blob):
+        raise ValueError("trailing bytes after KV wire payload")
+    return manifest, arrays
+
+
+def export_kv(row_cache: Dict[str, Any], *, prompt_len: int, block_t: int,
+              kv_dtype: str, first_token: int, model_id: str = "") -> bytes:
+    """Export ONE prefilled request's KV to the wire.
+
+    ``row_cache``: ``{"block_{i}": {"k": [max_seq, h, d], "v": ...}}`` —
+    one contiguous prefill-cache row per layer (bf16, host or device).
+    Truncates to whole blocks covering the prompt, reshapes block-wise,
+    and (int8) quantizes with the SAME compiled ``quantize_kv_jit`` the
+    decode engine's adoption path uses — bit-identical quantization is what
+    makes moved-vs-never-moved arenas byte-identical.
+    """
+    if block_t <= 0:
+        raise ValueError("export_kv needs a positive block_t")
+    nb = -(-int(prompt_len) // int(block_t))
+    arrays: Dict[str, np.ndarray] = {}
+    for name, layer in row_cache.items():
+        k = np.asarray(layer["k"])[:nb * block_t]
+        v = np.asarray(layer["v"])[:nb * block_t]
+        h, d = k.shape[-2], k.shape[-1]
+        k = k.reshape(nb, block_t, h, d)
+        v = v.reshape(nb, block_t, h, d)
+        if kv_dtype == "int8":
+            # The jitted quantizer, NOT the eager one: the decode engine's
+            # adoption path quantizes under jit, and eager quantize drifts
+            # by 1 ULP in scale — enough to flip codes at rounding
+            # boundaries and break moved-vs-never-moved byte parity.
+            from ..ops.kv_cache import quantize_kv_jit
+
+            kq, ks = quantize_kv_jit(k)
+            vq, vs = quantize_kv_jit(v)
+            arrays[f"{name}/k"] = np.asarray(kq)
+            arrays[f"{name}/v"] = np.asarray(vq)
+            arrays[f"{name}/k_scale"] = np.asarray(ks)
+            arrays[f"{name}/v_scale"] = np.asarray(vs)
+        else:
+            arrays[f"{name}/k"] = k
+            arrays[f"{name}/v"] = v
+    meta = {
+        "prompt_len": int(prompt_len),
+        "block_t": int(block_t),
+        "kv_dtype": str(kv_dtype),
+        "first_token": int(first_token),
+        "model_id": str(model_id),
+        "n_layers": len(row_cache),
+    }
+    return pack(meta, arrays)
+
+
+def unpack_kv(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Verify and parse a KV wire blob (alias of :func:`unpack` with the
+    export_kv manifest fields guaranteed present)."""
+    manifest, arrays = unpack(blob)
+    for field in ("prompt_len", "block_t", "kv_dtype", "first_token"):
+        if field not in manifest:
+            raise ValueError(f"KV wire manifest missing {field!r}")
+    return manifest, arrays

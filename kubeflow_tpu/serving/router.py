@@ -48,11 +48,15 @@ RETRY_AFTER_MIN_S = 0.5
 RETRY_AFTER_MAX_S = 60.0
 
 
-def prefix_key(prompt_ids: Sequence[int], prefix_len: int = DEFAULT_PREFIX_LEN) -> int:
-    """Stable hash of the first ``prefix_len`` token ids (crc32 of the
-    int32 bytes — deterministic across processes, unlike ``hash()``)."""
+def prefix_key(prompt_ids: Sequence[int], prefix_len: int = DEFAULT_PREFIX_LEN,
+               model_id: str = "") -> int:
+    """Stable hash of ``model_id || first prefix_len token ids`` (crc32 of
+    the int32 bytes seeded with the model id's crc — deterministic across
+    processes, unlike ``hash()``). Keying per model means multiplexed
+    models can never collide on prefix hash and poison each other's cache
+    affinity; ``model_id=""`` reduces to the historic single-model key."""
     head = np.asarray(prompt_ids, np.int32).reshape(-1)[:prefix_len]
-    return zlib.crc32(head.tobytes())
+    return zlib.crc32(head.tobytes(), zlib.crc32(model_id.encode("utf-8")))
 
 
 class PrefixRouter:
@@ -110,19 +114,25 @@ class PrefixRouter:
 
     def route(self, handles: Sequence, prompt_ids: Sequence[int],
               exclude: Optional[str] = None,
-              priority: str = "interactive") -> Tuple[object, str]:
+              priority: str = "interactive",
+              model_id: str = "") -> Tuple[object, str]:
         """Pick a replica for ``prompt_ids``; returns ``(handle, policy)``.
 
         ``exclude`` drops one replica id from consideration (re-queueing a
         drained replica's pendings must not route them back to it).
         ``priority`` shapes the saturation threshold: batch requests shed
-        at the reserved-fraction depth, interactive at the full depth."""
+        at the reserved-fraction depth, interactive at the full depth.
+        ``model_id`` scopes BOTH policies to one multiplexed model: the
+        prefix key is salted with it, and least-loaded scoring only ever
+        sees same-model replicas (handles carrying a different
+        ``model_id`` are dropped here even if the fleet passed them)."""
         ready = [h for h in handles
-                 if h.state == "ready" and h.id != exclude]
+                 if h.state == "ready" and h.id != exclude
+                 and getattr(h, "model_id", "") == model_id]
         if not ready:
             raise FleetSaturated("no ready replicas in the fleet")
         limit = self.depth_limit(priority)
-        key = prefix_key(prompt_ids, self.prefix_len)
+        key = prefix_key(prompt_ids, self.prefix_len, model_id)
         owner = next((h for h in ready if key in h.prefixes), None)
         if owner is not None and self.queue_depth(owner) < limit:
             policy = "prefix"
@@ -145,6 +155,14 @@ class PrefixRouter:
         self._note_prefix(chosen, key)
         METRICS.counter("fleet_routed_total", policy=policy).inc()
         return chosen, policy
+
+    def note_prefix(self, handle, prompt_ids: Sequence[int],
+                    model_id: str = "") -> None:
+        """Record warm-prefix ownership outside :meth:`route` — the fleet
+        calls this when a KV handoff moves a request's warm state to a
+        decode replica the router never picked itself."""
+        self._note_prefix(handle, prefix_key(prompt_ids, self.prefix_len,
+                                             model_id))
 
     def _note_prefix(self, handle, key: int) -> None:
         """Record that ``handle`` now holds the warm state for ``key``

@@ -160,7 +160,8 @@ class ModelServer:
 
     def _predict(self, model: ServedModel, instances,
                  deadline: Optional[float] = None,
-                 priority: str = "interactive") -> List[Any]:
+                 priority: str = "interactive",
+                 model_id: Optional[str] = None) -> List[Any]:
         from .batching import BatcherClosed
 
         batcher = self._batchers.get(model.name)
@@ -174,7 +175,7 @@ class ModelServer:
                 pass
         if isinstance(model, GenerativeModel):
             return model.predict(instances, deadline=deadline,
-                                 priority=priority)
+                                 priority=priority, model=model_id)
         return model.predict(instances)
 
     def close(self) -> None:
@@ -211,12 +212,15 @@ class ModelServer:
             if instances is None:
                 raise HttpError(400, "body must carry 'instances'")
             deadline, priority = request_deadline_opts(req, body)
+            # multiplexed servables route on the body's "model" id
+            model_id = body.get("model") if isinstance(body, dict) else None
 
             t0 = time.perf_counter()
             try:
                 predictions = self._predict(model, instances,
                                             deadline=deadline,
-                                            priority=priority)
+                                            priority=priority,
+                                            model_id=model_id)
             except HttpError:
                 raise
             except DeadlineExceeded as e:
@@ -279,6 +283,18 @@ class GenerativeModel(ServedModel):
     #: (draft_cfg, draft_params) enables speculative decoding
     spec_draft: Optional[Any] = None
     spec_k: int = 4
+    # -- ISSUE-18 disaggregation knobs -------------------------------------
+    #: KV arena storage precision: "bf16" (bit-parity ground truth) or
+    #: "int8" (2x KV positions per HBM byte, tested logit tolerance)
+    kv_dtype: str = "bf16"
+    #: role pools for a disaggregated fleet, e.g. {"prefill": 1,
+    #: "decode": 2}; None keeps homogeneous replicas
+    pools: Optional[Dict[str, int]] = None
+    #: model_id -> (cfg, params): multiplex several models over one fleet;
+    #: requests pick one via the body's "model" field
+    mux_models: Optional[Dict[str, Any]] = None
+    #: model_id -> default admission class ("interactive"/"batch")
+    model_slo: Optional[Dict[str, str]] = None
 
     def __post_init__(self):
         # Per-request sampling state: a base key seeded from OS entropy folded
@@ -292,22 +308,31 @@ class GenerativeModel(ServedModel):
         self._engine = None
         self._engine_lock = threading.Lock()
 
+    def _wants_fleet(self) -> bool:
+        # pools and multiplexing are fleet-level concepts; a single engine
+        # only exists for the plain one-replica case
+        return bool(self.replicas > 1 or self.max_replicas or self.pools
+                    or self.mux_models)
+
     def _continuous_engine(self):
         from .continuous import ContinuousBatcher
 
         engine_kwargs = dict(paged=self.paged, kv_blocks=self.kv_blocks,
                              kv_block_t=self.kv_block_t,
                              prefill_chunk=self.prefill_chunk,
-                             spec_draft=self.spec_draft, spec_k=self.spec_k)
+                             spec_draft=self.spec_draft, spec_k=self.spec_k,
+                             kv_dtype=self.kv_dtype)
         with self._engine_lock:
             if self._engine is None:
-                if self.replicas > 1 or self.max_replicas:
+                if self._wants_fleet():
                     from .fleet import EngineFleet
 
                     self._engine = EngineFleet(
                         self.cfg, self.params, replicas=self.replicas,
                         max_replicas=self.max_replicas or max(self.replicas, 1),
                         slots=self.slots, name=self.name,
+                        pools=self.pools, models=self.mux_models,
+                        model_slo=self.model_slo,
                         engine_kwargs=engine_kwargs)
                 else:
                     self._engine = ContinuousBatcher(self.cfg, self.params,
@@ -326,11 +351,18 @@ class GenerativeModel(ServedModel):
 
     def predict(self, instances: Sequence[Any],
                 deadline: Optional[float] = None,
-                priority: str = "interactive") -> List[Any]:
+                priority: str = "interactive",
+                model: Optional[str] = None) -> List[Any]:
         from kubeflow_tpu.models.gpt import generate
 
         if not instances:
             return []
+        if model and not self.mux_models:
+            raise HttpError(400, f"servable {self.name!r} does not "
+                                 "multiplex models")
+        if self.mux_models and not model:
+            raise HttpError(400, "body must carry 'model': this servable "
+                                 f"multiplexes {sorted(self.mux_models)}")
         if deadline is None:
             # direct callers (tests, DynamicBatcher) get the server default
             deadline = time.monotonic() + DEFAULT_DEADLINE_MS / 1000.0
@@ -368,13 +400,20 @@ class GenerativeModel(ServedModel):
             cur = TRACER.current_span()
             tp = format_traceparent(cur) if cur is not None else None
             futs: List[Any] = []
+            # a multiplexed model's SLO class is deployment policy, not a
+            # client choice: it overrides whatever the request asked for
+            if model and self.model_slo and model in self.model_slo:
+                priority = self.model_slo[model]
+            submit_kw: Dict[str, Any] = (
+                {"model": model or ""} if self._wants_fleet() else {})
             try:
                 for row in prompts:
                     futs.append(eng.submit(row, self.max_new_tokens,
                                            temperature=self.temperature,
                                            traceparent=tp,
                                            deadline=deadline,
-                                           priority=priority))
+                                           priority=priority,
+                                           **submit_kw))
                 out = []
                 for row, f in zip(prompts, futs):
                     # the wait derives from the request's own deadline: at

@@ -30,8 +30,9 @@ from kubeflow_tpu.runtime.metrics import METRICS  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: multi-minute 8-device end-to-end tests; tier-1 excludes them "
-        "with -m 'not slow', the multichip CI job runs them",
+        "slow: heavyweight tests (multi-device parity, long decode loops); "
+        "tier-1 excludes them with -m 'not slow', the owning CI job runs "
+        "them (multichip-e2e, disagg-serving-e2e)",
     )
 
 

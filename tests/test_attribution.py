@@ -235,10 +235,11 @@ def gate_mod():
 
 class TestBenchGate:
     def test_r05_flags_the_serving_regressions(self, gate_mod):
-        # with r06 (the paged-KV recovery round) and r07 (the autotuner
-        # round) excluded, the history ends at r05 and the gate must still
-        # retroactively flag the r04->r05 slide
-        rounds = gate_mod.load_history(ROOT, ["r06", "r07"])
+        # with r06 (the paged-KV recovery round), r07 (the autotuner round)
+        # and r08 (the disaggregated-serving round) excluded, the history
+        # ends at r05 and the gate must still retroactively flag the
+        # r04->r05 slide
+        rounds = gate_mod.load_history(ROOT, ["r06", "r07", "r08"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 1
         fails = {r["metric"] for r in results if r["verdict"] == "FAIL"}
@@ -252,7 +253,7 @@ class TestBenchGate:
     def test_r06_recovers_without_waivers(self, gate_mod):
         # the committed r06 round beats the r04 serving numbers outright, so
         # the history rewound to r06 gates green with zero waivers
-        rounds = gate_mod.load_history(ROOT, ["r07"])
+        rounds = gate_mod.load_history(ROOT, ["r07", "r08"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 0
         assert max(rounds) == 6
@@ -264,9 +265,9 @@ class TestBenchGate:
         assert verdicts["spec_accept_rate"] == "BASELINE"
 
     def test_r07_breaks_the_training_plateau(self, gate_mod):
-        # the full history gates green with zero waivers, and the autotuner
-        # round clears the new absolute flagship floors outright
-        rounds = gate_mod.load_history(ROOT, [])
+        # rewound to r07, the history gates green with zero waivers, and the
+        # autotuner round clears the new absolute flagship floors outright
+        rounds = gate_mod.load_history(ROOT, ["r08"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 0
         assert max(rounds) == 7
@@ -281,8 +282,25 @@ class TestBenchGate:
             assert by[metric]["floor"] == gate_mod.FLOORS[metric][0]
             assert by[metric]["floor_breached"] is False
 
+    def test_r08_disagg_round_gates_green(self, gate_mod):
+        # the full history gates green with zero waivers: the disaggregated
+        # round's heterogeneous-mix SLIs enter as baselines, and the
+        # distilled draft clears the new spec_accept_rate floor outright
+        rounds = gate_mod.load_history(ROOT, [])
+        results, rc = gate_mod.gate(rounds)
+        assert rc == 0
+        assert max(rounds) == 8
+        by = {r["metric"]: r for r in results}
+        assert by["decode_tok_s_heterogeneous"]["verdict"] == "BASELINE"
+        assert by["kv_handoff_p99_s"]["verdict"] == "BASELINE"
+        assert by["spec_accept_rate"]["verdict"] == "IMPROVED"
+        assert by["spec_accept_rate"]["value"] >= 0.5
+        assert by["spec_accept_rate"]["floor"] == gate_mod.FLOORS[
+            "spec_accept_rate"][0]
+        assert by["spec_accept_rate"]["floor_breached"] is False
+
     def test_excluding_r05_passes(self, gate_mod):
-        rounds = gate_mod.load_history(ROOT, ["r05", "r06", "r07"])
+        rounds = gate_mod.load_history(ROOT, ["r05", "r06", "r07", "r08"])
         results, rc = gate_mod.gate(rounds)
         assert rc == 0
         assert max(rounds) == 4
@@ -294,7 +312,7 @@ class TestBenchGate:
         assert gpt["verdict"] == "BASELINE"
 
     def test_waivers_turn_known_fails_green(self, gate_mod):
-        rounds = gate_mod.load_history(ROOT, ["r06", "r07"])
+        rounds = gate_mod.load_history(ROOT, ["r06", "r07", "r08"])
         waivers = [f"{m}@r05" for m in (
             "serving_bert_p50_ms_b8",
             "serving_decode_tokens_per_sec_b8",
@@ -348,10 +366,10 @@ class TestBenchGate:
         assert strict.returncode == 0
         assert "serving_decode_tokens_per_sec_b8" in strict.stdout
         assert "gate PASSED" in strict.stdout
-        # --exclude r06/r07 rewinds to the r05 regression round: rc=1 + table
+        # rewinding to the r05 regression round: rc=1 + table
         rewound = subprocess.run(
             [sys.executable, "tools/bench_gate.py",
-             "--exclude", "r06", "--exclude", "r07"],
+             "--exclude", "r06", "--exclude", "r07", "--exclude", "r08"],
             cwd=ROOT, capture_output=True, text=True)
         assert rewound.returncode == 1
         assert "serving_bert_p50_ms_b8" in rewound.stdout

@@ -427,3 +427,113 @@ def test_http_unservable_request_is_400_not_500(params):
         assert "KV blocks" in str(resp.body)
     finally:
         served.close()
+
+
+# -- int8 KV arena + prefill/decode handoff (ISSUE 18) ------------------------
+
+
+def _self_draft(n_layers=1):
+    """The truncated-stack draft serving_bench uses: bottom blocks +
+    embeddings of the target."""
+    draft_cfg = GptConfig(d_model=CFG.d_model, n_layers=n_layers,
+                          n_heads=CFG.n_heads, d_ff=CFG.d_ff,
+                          max_seq=CFG.max_seq, vocab_size=CFG.vocab_size)
+    return draft_cfg
+
+
+@pytest.mark.slow
+def test_int8_arena_greedy_parity_with_bf16_oracle(params):
+    """int8 KV halves arena bytes; greedy decode must stay within the
+    tested tolerance of the bf16 oracle — on this config the quantization
+    error never flips an argmax, so the tolerance is EXACT token equality
+    (any weakening of the quantizer shows up as a diff here)."""
+    prompts = [prompt(40 + i, 6 + i) for i in range(4)]
+    outs = {}
+    for dt in ("bf16", "int8"):
+        eng = ContinuousBatcher(CFG, params, slots=2, chunk=2, pipeline=1,
+                                kv_dtype=dt, engine_id=f"q-{dt}")
+        try:
+            outs[dt] = [eng.submit(p, 12).result(timeout=300)
+                        for p in prompts]
+        finally:
+            eng.close()
+    assert outs["int8"] == outs["bf16"]
+    # bf16 stays the bit-parity ground truth against static decode
+    for p, toks in zip(prompts, outs["bf16"]):
+        ref = np.asarray(generate(CFG, params, p[None, :], 12))[0, len(p):]
+        assert toks == ref.tolist()
+
+
+def test_int8_rejected_without_paged_arena(params):
+    with pytest.raises(ValueError, match="int8"):
+        ContinuousBatcher(CFG, params, paged=False, kv_dtype="int8")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("mode", ["plain", "chunked"])
+def test_handoff_pair_bit_identical_to_never_moved(params, kv_dtype, mode):
+    """An engine pair wired prefill → decode through the KV wire must
+    produce byte-identical greedy output to a unified engine that never
+    exported anything — for both arena dtypes, with and without chunked
+    prefill on the exporting side."""
+    kw = dict(slots=2, chunk=2, pipeline=1, kv_dtype=kv_dtype)
+    if mode == "chunked":
+        kw["prefill_chunk"] = 4
+    unified = ContinuousBatcher(CFG, params, engine_id="u", **kw)
+    decode = ContinuousBatcher(CFG, params, engine_id="d", role="decode",
+                               **kw)
+    prefill = ContinuousBatcher(CFG, params, engine_id="p", role="prefill",
+                                handoff_sink=lambda req, blob:
+                                decode.submit_handoff(req, blob), **kw)
+    try:
+        prompts = [prompt(50 + i, 5 + 2 * i) for i in range(3)]
+        want = [unified.submit(p, 8).result(timeout=300) for p in prompts]
+        futs = [prefill.submit(p, 8) for p in prompts]
+        assert [f.result(timeout=300) for f in futs] == want
+    finally:
+        prefill.close()
+        decode.close()
+        unified.close()
+
+
+@pytest.mark.slow
+def test_handoff_with_speculative_decode_stays_greedy_exact(params):
+    """The decode specialist re-prefills its DRAFT locally after an
+    import; speculative verification must still commit exactly the
+    unified engine's greedy tokens."""
+    draft_cfg = _self_draft()
+    draft_params = {k: v for k, v in params.items()
+                    if not k.startswith("block_")}
+    draft_params["block_0"] = params["block_0"]
+    kw = dict(slots=2, chunk=2, pipeline=1,
+              spec_draft=(draft_cfg, draft_params), spec_k=3)
+    unified = ContinuousBatcher(CFG, params, engine_id="su", **kw)
+    decode = ContinuousBatcher(CFG, params, engine_id="sd", role="decode",
+                               **kw)
+    prefill = ContinuousBatcher(CFG, params, engine_id="sp", role="prefill",
+                                handoff_sink=lambda req, blob:
+                                decode.submit_handoff(req, blob), **kw)
+    try:
+        p = prompt(60, 7)
+        want = unified.submit(p, 10).result(timeout=300)
+        assert prefill.submit(p, 10).result(timeout=300) == want
+    finally:
+        prefill.close()
+        decode.close()
+        unified.close()
+
+
+def test_kv_wire_frame_round_trip_and_crc():
+    from kubeflow_tpu.serving.kv_wire import pack, unpack
+
+    arrays = {"layer0/k": np.arange(24, dtype=np.float32).reshape(2, 3, 4)}
+    blob = pack({"version": 1, "prompt_len": 5}, arrays)
+    meta, out = unpack(blob)
+    assert meta["prompt_len"] == 5
+    np.testing.assert_array_equal(out["layer0/k"], arrays["layer0/k"])
+    # a flipped payload byte must fail the per-array crc32, loudly
+    bad = bytearray(blob)
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc"):
+        unpack(bytes(bad))

@@ -230,7 +230,7 @@ class TestAutoscaler:
             f"cooldown must stop back-to-back scaling: {decisions}"
         assert fleet.calls == [(3, "slo_breach")]
         assert METRICS.value("fleet_autoscale_total", direction="up",
-                             reason="slo_breach") == 1.0
+                             reason="slo_breach", pool="unified") == 1.0
 
     def test_boundary_quantile_never_flaps(self):
         """p99 between margin*SLO and SLO sits in the hysteresis band:
@@ -546,3 +546,261 @@ class TestHistogramCounts:
 
     def test_missing_name_returns_none(self):
         assert METRICS.histogram_counts("nope") is None
+
+
+# -- disaggregated serving / multiplexing (ISSUE 18) ---------------------------
+
+
+def params_for_seed(seed: int):
+    return GptLM(CFG).init(jax.random.PRNGKey(seed),
+                           np.zeros((1, 8), np.int32))["params"]
+
+
+class TestPerModelRouting:
+    def test_prefix_key_salted_by_model(self):
+        head = list(range(16))
+        assert prefix_key(head, model_id="a") != prefix_key(head, model_id="b")
+        # the anonymous model keeps the pre-multiplexing key (back-compat:
+        # crc32 with the zero seed IS plain crc32)
+        assert prefix_key(head, model_id="") == prefix_key(head)
+
+    def test_route_only_sees_same_model_replicas(self):
+        from collections import OrderedDict
+
+        class H:
+            def __init__(self, i, model_id):
+                self.id = self.gauge_id = f"m-{i}"
+                self.state = "ready"
+                self.model_id = model_id
+                self.prefixes = OrderedDict()
+
+        router = PrefixRouter()
+        a0, a1, b0 = H(0, "a"), H(1, "a"), H(2, "b")
+        chosen, _ = router.route([a0, a1, b0], prompt(0), model_id="b")
+        assert chosen is b0, "routing must scope to the requested model"
+        with pytest.raises(FleetSaturated):
+            router.route([a0, a1], prompt(0), model_id="c")
+
+    def test_same_prompt_different_models_warm_different_replicas(self):
+        from collections import OrderedDict
+
+        class H:
+            def __init__(self, i, model_id):
+                self.id = self.gauge_id = f"w-{i}"
+                self.state = "ready"
+                self.model_id = model_id
+                self.prefixes = OrderedDict()
+
+        router = PrefixRouter()
+        handles = [H(0, "a"), H(1, "b")]
+        p = prompt(1)
+        ha, _ = router.route(handles, p, model_id="a")
+        hb, _ = router.route(handles, p, model_id="b")
+        # identical prompt, distinct models: each model owns its own warm
+        # prefix on its own replica — no cross-model cache aliasing
+        assert ha is not hb
+        assert list(ha.prefixes) != list(hb.prefixes)
+
+
+class TestMultiplexedFleet:
+    @pytest.mark.slow
+    def test_two_models_serve_their_own_weights(self, params):
+        params_b = params_for_seed(1)
+        fleet = EngineFleet(models={"a": (CFG, params), "b": (CFG, params_b)},
+                            model_slo={"a": "interactive", "b": "batch"},
+                            replicas=1, min_replicas=1, max_replicas=4,
+                            slots=2, chunk=2, pipeline=1, name="mux",
+                            register_debug=False)
+        try:
+            p = prompt(9, 8)
+            fa = fleet.submit(p, 6, model="a")
+            fb = fleet.submit(p, 6, model="b")
+            ref_a = np.asarray(generate(CFG, params, p[None, :], 6))[0, len(p):]
+            ref_b = np.asarray(generate(CFG, params_b, p[None, :], 6))[0, len(p):]
+            assert fa.result(timeout=120) == ref_a.tolist()
+            assert fb.result(timeout=120) == ref_b.tolist()
+            assert (ref_a.tolist() != ref_b.tolist()), \
+                "sanity: distinct weights must disagree for the test to bite"
+            # model_slo resolves the admission class when the caller
+            # passes none
+            assert fa.priority == "interactive"
+            assert fb.priority == "batch"
+        finally:
+            fleet.close()
+
+    def test_unknown_model_refused_at_submit(self, params):
+        fleet = EngineFleet(models={"a": (CFG, params)}, replicas=1,
+                            min_replicas=1, max_replicas=2, slots=2, chunk=2,
+                            pipeline=1, name="mux2", register_debug=False)
+        try:
+            with pytest.raises(ValueError, match="unknown model"):
+                fleet.submit(prompt(10), 4, model="zz")
+        finally:
+            fleet.close()
+
+    def test_model_slo_must_name_a_model(self, params):
+        with pytest.raises(ValueError, match="unknown model"):
+            EngineFleet(models={"a": (CFG, params)}, model_slo={"b": "batch"},
+                        replicas=1, name="bad", register_debug=False)
+
+
+class TestDisaggregatedFleet:
+    def _fleet(self, params, name, kv_dtype="bf16", decode=1, **kw):
+        return EngineFleet(CFG, params, pools={"prefill": 1, "decode": decode},
+                           min_replicas=1, max_replicas=4, slots=2, chunk=2,
+                           pipeline=1, name=name, register_debug=False,
+                           engine_kwargs={"kv_dtype": kv_dtype}, **kw)
+
+    def test_pools_must_cover_both_roles(self, params):
+        with pytest.raises(ValueError, match="pools"):
+            EngineFleet(CFG, params, pools={"prefill": 1}, name="p1",
+                        register_debug=False)
+        with pytest.raises(ValueError, match="pools"):
+            EngineFleet(CFG, params, pools={"prefill": 1, "decode": 0},
+                        name="p0", register_debug=False)
+
+    @pytest.mark.parametrize("kv_dtype", [
+        pytest.param("bf16", marks=pytest.mark.slow), "int8"])
+    def test_handoff_round_trip_matches_never_moved(self, params, kv_dtype):
+        """A request prefilled on one replica and decoded on another must
+        produce byte-identical greedy output to an engine that never moved
+        the KV — for the bf16 arena AND the int8+scale arena (the wire
+        ships the SAME quantized bytes the local path would have stored)."""
+        oracle = ContinuousBatcher(CFG, params, slots=2, chunk=2, pipeline=1,
+                                   engine_id="nm", kv_dtype=kv_dtype)
+        fleet = self._fleet(params, f"dis-{kv_dtype}", kv_dtype)
+        try:
+            prompts = [prompt(20 + i, 6 + 3 * i) for i in range(3)]
+            want = [oracle.submit(p, 8).result(timeout=120) for p in prompts]
+            futs = [fleet.submit(p, 8) for p in prompts]
+            got = [f.result(timeout=120) for f in futs]
+            assert got == want
+            assert METRICS.value("serving_kv_handoff_total") == 3.0
+            assert METRICS.value("serving_kv_import_total") == 3.0
+            assert METRICS.histogram_counts("serving_kv_handoff_bytes")[2] == 3
+            assert METRICS.histogram_counts("serving_kv_handoff_seconds")[2] == 3
+        finally:
+            fleet.close()
+            oracle.close()
+
+    def test_pool_scaling_and_gauges(self, params):
+        fleet = self._fleet(params, "dsc")
+        try:
+            assert fleet.pools == {"prefill": 1, "decode": 1}
+            fleet.scale_to(2, reason="test", pool="prefill")
+            assert fleet.pool_size("prefill") == 2
+            assert fleet.pool_size("decode") == 1
+            assert METRICS.value("fleet_pool_replicas", pool="prefill") == 2.0
+            assert METRICS.value("fleet_pool_replicas", pool="decode") == 1.0
+            fleet.scale_to(1, reason="test", pool="prefill")
+            assert fleet.pool_size("prefill") == 1
+            # pools floor at 1 replica: a drained-to-zero prefill pool
+            # could never admit again
+            fleet.scale_to(0, reason="test", pool="decode")
+            assert fleet.pool_size("decode") == 1
+            roles = sorted(h.role for h in fleet.live_handles())
+            assert roles == ["decode", "prefill"]
+        finally:
+            fleet.close()
+
+    @pytest.mark.slow
+    def test_decode_pool_drain_re_imports_with_zero_drops(self, params):
+        fleet = self._fleet(params, "ddr", decode=2)
+        try:
+            p = prompt(31, 6)
+            futs = [fleet.submit(p, 10) for _ in range(4)]
+            wait_for(lambda: METRICS.value("serving_kv_import_total") >= 1,
+                     desc="first handoff import")
+            victim = next(h for h in fleet.live_handles()
+                          if h.role == "decode")
+            fleet.drain_replica(victim.id, reason="test")
+            ref = np.asarray(generate(CFG, params, p[None, :], 10))[0, len(p):]
+            for f in futs:  # ZERO dropped through the decode-pool drain
+                assert f.result(timeout=120) == ref.tolist()
+            assert fleet.pool_size("decode") == 1
+        finally:
+            fleet.close()
+
+
+class FakeDisaggFleet:
+    """Pool-aware scale recorder for the per-pool autoscaler tests."""
+
+    max_replicas = 4
+
+    def __init__(self):
+        self.sizes = {"prefill": 1, "decode": 1}
+        self.calls = []
+
+    @property
+    def pools(self):
+        return dict(self.sizes)
+
+    def pool_size(self, pool=None):
+        return self.sizes[pool or "decode"]
+
+    def scale_to(self, n, reason="", pool=None):
+        self.calls.append((pool, n, reason))
+        self.sizes[pool] = n
+
+
+class TestPerPoolAutoscaler:
+    def test_prefill_scales_on_ttft_decode_on_inter_token(self):
+        fleet = FakeDisaggFleet()
+        asc = SLOAutoscaler(fleet, _cfg(cooldown_ticks=3))
+        ttft = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        itl = METRICS.histogram("serving_inter_token_seconds",
+                                buckets=TTFT_BUCKETS)
+        asc.tick()  # baseline snapshot
+        for _ in range(3):  # sustained TTFT breach; inter-token healthy
+            ttft.observe(3.0, count=10)
+            itl.observe(0.001, count=10)
+            asc.tick()
+        assert ("prefill", 2, "slo_breach") in fleet.calls
+        assert all(c[0] != "decode" or c[2] != "slo_breach"
+                   for c in fleet.calls), \
+            "a prefill-side breach must never scale the decode pool"
+        assert METRICS.value("fleet_autoscale_total", direction="up",
+                             reason="slo_breach", pool="prefill") == 1.0
+
+    def test_decode_breach_scales_decode_only(self):
+        fleet = FakeDisaggFleet()
+        asc = SLOAutoscaler(fleet, _cfg(cooldown_ticks=3))
+        ttft = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        itl = METRICS.histogram("serving_inter_token_seconds",
+                                buckets=TTFT_BUCKETS)
+        asc.tick()
+        for _ in range(3):
+            ttft.observe(0.01, count=10)  # healthy prefill
+            itl.observe(1.0, count=10)    # inter-token SLO (0.1) breached
+            asc.tick()
+        assert ("decode", 2, "slo_breach") in fleet.calls
+        assert all(c[0] != "prefill" or c[2] != "slo_breach"
+                   for c in fleet.calls)
+        assert METRICS.value("fleet_autoscale_total", direction="up",
+                             reason="slo_breach", pool="decode") == 1.0
+
+    def test_pool_streaks_and_cooldowns_are_independent(self):
+        """A decode scale action must not cool down a pending prefill
+        decision: both pools breach, both scale on the same tick."""
+        fleet = FakeDisaggFleet()
+        asc = SLOAutoscaler(fleet, _cfg(cooldown_ticks=3))
+        ttft = METRICS.histogram("serving_ttft_seconds", buckets=TTFT_BUCKETS)
+        itl = METRICS.histogram("serving_inter_token_seconds",
+                                buckets=TTFT_BUCKETS)
+        asc.tick()
+        for _ in range(3):
+            ttft.observe(3.0, count=10)
+            itl.observe(1.0, count=10)
+            asc.tick()
+        assert ("prefill", 2, "slo_breach") in fleet.calls
+        assert ("decode", 2, "slo_breach") in fleet.calls
+        assert asc.last["prefill"]["cooldown"] > 0
+        assert asc.last["decode"]["cooldown"] > 0
+
+    def test_disagg_last_reports_both_pools(self):
+        fleet = FakeDisaggFleet()
+        asc = SLOAutoscaler(fleet, _cfg())
+        asc.tick()
+        assert set(asc.last) >= {"prefill", "decode", "ttft_p",
+                                 "inter_token_p", "decision"}
+        assert asc.last["prefill"]["replicas"] == 1

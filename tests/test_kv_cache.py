@@ -268,3 +268,74 @@ def test_block_allocator_publishes_gauges():
     assert free.value == 1 and used.value == 3
     alloc.release(res)
     assert free.value == 4 and used.value == 0
+
+
+# -- int8 KV quantization (ISSUE 18) ------------------------------------------
+
+
+def test_quantize_dequantize_round_trip_within_half_scale():
+    from kubeflow_tpu.ops.kv_cache import dequantize_kv, quantize_kv
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 7, 8, 16)).astype(np.float32))
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.dtype == jnp.float32
+    assert scale.shape == x.shape[:-1] + (1,)
+    err = np.abs(np.asarray(dequantize_kv(q, scale)) - np.asarray(x))
+    # symmetric rounding: every element lands within half a scale step
+    bound = np.asarray(scale) / 2.0 + 1e-7
+    assert (err <= bound).all(), f"max quant error {err.max()} exceeds bound"
+
+
+def test_quantize_all_zero_rows_are_exact():
+    from kubeflow_tpu.ops.kv_cache import dequantize_kv, quantize_kv
+
+    x = jnp.zeros((2, 3, 4, 8), jnp.float32)
+    q, scale = quantize_kv(x)
+    assert np.asarray(q).sum() == 0 and np.asarray(scale).sum() == 0
+    assert np.asarray(dequantize_kv(q, scale)).sum() == 0
+
+
+def test_quantize_is_deterministic_across_jit_contexts():
+    """The KV-handoff parity contract: the wire exporter and the local
+    store path must produce the same int8 codes for identical inputs,
+    jitted or not. (Scales may drift one ULP under XLA's reciprocal
+    fusion — harmless, the wire ships the exporter's scales verbatim so
+    import never recomputes them.)"""
+    from kubeflow_tpu.ops.kv_cache import quantize_kv
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(3, 4, 2, 8)).astype(np.float32))
+    q0, s0 = quantize_kv(x)
+    q1, s1 = jax.jit(quantize_kv)(x)
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q1))
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("interpret", [True])
+def test_block_update_quant_matches_quantize_then_scatter(interpret):
+    from kubeflow_tpu.ops.kv_cache import kv_block_update_quant, quantize_kv
+
+    S, MB, block_t, H, D = 3, 4, 4, 2, 8
+    N = S * MB + 1  # one arena block per table entry + the trash row
+    max_seq = block_t * MB
+    rng = np.random.default_rng(5)
+    arena = jnp.asarray(rng.integers(-127, 128, (N, block_t, H, D)), jnp.int8)
+    scales = jnp.asarray(rng.random((N, block_t, H, 1)), jnp.float32)
+    new = jnp.asarray(rng.normal(size=(S, H, D)).astype(np.float32))
+    cursors = jnp.asarray([0, 5, max_seq], jnp.int32)  # last row out of range
+    tables = jnp.asarray(np.arange(S * MB).reshape(S, MB), jnp.int32)
+    got_q, got_s = kv_block_update_quant(arena, scales, new, cursors, tables,
+                                         max_seq=max_seq, interpret=interpret)
+    want_q = np.array(arena, copy=True)
+    want_s = np.array(scales, copy=True)
+    q, s = quantize_kv(new)
+    for row in range(S):
+        pos = int(cursors[row])
+        if pos >= max_seq:
+            continue  # out-of-range rows are a no-op (retired slots)
+        blk = int(tables[row, pos // block_t])
+        want_q[blk, pos % block_t] = np.asarray(q[row])
+        want_s[blk, pos % block_t] = np.asarray(s[row])
+    np.testing.assert_array_equal(np.asarray(got_q), want_q)
+    np.testing.assert_array_equal(np.asarray(got_s), want_s)
