@@ -233,6 +233,25 @@ def elastic_e2e() -> Dict:
     return b.build()
 
 
+def goodput_e2e() -> Dict:
+    """The goodput-accounting job: the ledger chaos dryrun — an elastic
+    composite run on the 8-virtual-device topology surviving two graceful
+    preemptions plus one hard gang loss, with the GoodputLedger's badput
+    fractions summing to exactly 1.0, the named buckets reconstructing the
+    driver-measured wallclock within 5%, the chaos attributed to
+    ``preemption_replay``/``checkpoint_restore`` rather than ``other``,
+    ``scheduling_wait`` agreeing with the scheduler's own bind-latency
+    observations, the tenant chip meter matching chips × bound duration,
+    and the fraction surviving scrape → TSDB → recording rule → dashboard
+    (e2e/goodput_driver.py asserts all of it) — plus the ledger / tenant
+    meter / cold-start / restore-histogram unit suite."""
+    b = WorkflowBuilder("goodput-e2e")
+    b.run("goodput-chaos-dryrun", ["python", "-m", "e2e.goodput_driver"],
+          env=EIGHT_DEVICE_ENV)
+    b.pytest("goodput-unit", "tests/test_goodput.py", env=EIGHT_DEVICE_ENV)
+    return b.build()
+
+
 def paged_kv_e2e() -> Dict:
     """The paged-KV serving job: a 2-replica fleet on the paged arena +
     chunked prefill + speculative decode path over real HTTP — greedy
@@ -411,6 +430,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "paged-kv-e2e": paged_kv_e2e,
     "disagg-serving-e2e": disagg_serving_e2e,
     "elastic-e2e": elastic_e2e,
+    "goodput-e2e": goodput_e2e,
     "platlint": platlint,
     "bench-regression": bench_regression,
     "autotune-smoke": autotune_smoke,

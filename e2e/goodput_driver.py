@@ -1,0 +1,429 @@
+"""Goodput-ledger e2e: account every wallclock second of a chaotic run.
+
+An ElasticTrainer survives scripted chaos (two graceful preemptions plus
+one hard gang loss) on the 8-virtual-device dryrun topology while a
+GoodputLedger decomposes its incarnation-spanning wallclock, CI job
+goodput-e2e:
+
+1. the composed-4D GPT trains as a drain-graced ``trial`` gang; a
+   StepClock on the workload separates XLA compile and data-wait from
+   compute inside every step;
+2. chaos preempts the gang twice gracefully (urgent checkpoint + ack, zero
+   replay) and once HARD (pods deleted without drain, timed so the next
+   incarnation must replay exactly the steps past the last periodic
+   checkpoint);
+3. a 4-chip gang in namespace ``tenant-a`` is bound for the whole run so
+   ``tenant_chip_seconds_total`` can be checked against chips × measured
+   bound duration;
+4. after training, the monitoring plane scrapes this process's /metrics
+   over real HTTP, evaluates the ``platform:training_goodput_fraction``
+   recording rule, and the dashboard's ``/api/metrics/platform`` reports
+   the goodput and tenants sections from the federated TSDB.
+
+Asserts the ledger's honesty contract: fractions sum to EXACTLY 1.0, the
+named buckets reconstruct the driver-measured wallclock within 5%,
+``preemption_replay`` and ``checkpoint_restore`` are strictly positive (and
+the replay is exactly the steps past the surviving checkpoint),
+``scheduling_wait`` matches the scheduler's own bind-latency observations,
+and the tenant meter agrees with chips × bound-duration within a scrape
+interval.
+
+CPU-only; per-incarnation jit compiles dominate the ~minutes runtime.
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+
+import json
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+from e2e.junit import run_driver
+
+NAMESPACE = "default"
+TENANT_NS = "tenant-a"
+TOTAL_STEPS = 24
+CKPT_EVERY = 4
+GRACE_SECONDS = 20.0
+STEP_SLEEP = 0.03
+RECONSTRUCTION_TOL = 0.05
+#: bind-latency timestamps have 1s resolution (creationTimestamp), so the
+#: cross-check slack scales with the number of observed gangs
+BIND_LATENCY_SLACK_PER_GANG = 1.5
+#: tenant meter tolerance: chips × (bind-observe + unbind-settle delays)
+TENANT_TOL_CHIP_SECONDS = 8.0
+
+#: the one slice shape: both 2x4 hosts (the spare host is the tenant's)
+SHAPE = {"pods": 2, "chips": 4, "pp": 4, "virtual": 1}
+
+
+def _poll(fn, timeout: float = 30.0, interval: float = 0.05, desc: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout}s waiting for {desc}")
+
+
+def _gang_pod(name, gang, size, chips, priority_class, namespace=NAMESPACE,
+              grace=None):
+    from kubeflow_tpu.api.meta import new_object
+    from kubeflow_tpu.scheduler.gang import (
+        DRAIN_GRACE_ANNOTATION,
+        POD_GROUP_LABEL,
+        POD_GROUP_SIZE_ANNOTATION,
+    )
+    from kubeflow_tpu.tpu.topology import RESOURCE_TPU
+
+    annotations = {POD_GROUP_SIZE_ANNOTATION: str(size)}
+    if grace is not None:
+        annotations[DRAIN_GRACE_ANNOTATION] = str(grace)
+    return new_object(
+        "v1", "Pod", name, namespace,
+        labels={POD_GROUP_LABEL: gang},
+        annotations=annotations,
+        spec={
+            "priorityClassName": priority_class,
+            "containers": [{
+                "name": "trainer",
+                "resources": {"limits": {RESOURCE_TPU: str(chips)}},
+            }],
+        },
+    )
+
+
+class SliceRequester:
+    """Gang acquisition against the real scheduler, one fixed shape."""
+
+    def __init__(self, client, devices):
+        self._client = client
+        self._devices = list(devices)
+        self.gen = 0
+        self.current_gang: Optional[str] = None
+        self.current_pods: list = []
+
+    def __call__(self, attempt: int):
+        from kubeflow_tpu.training.elastic import SliceOffer
+
+        self.gen += 1
+        gang = f"train-g{self.gen}"
+        names = [f"{gang}-{i}" for i in range(SHAPE["pods"])]
+        for n in names:
+            self._client.create(_gang_pod(
+                n, gang, SHAPE["pods"], SHAPE["chips"], "trial",
+                grace=GRACE_SECONDS))
+        _poll(lambda: self._all_running(names), timeout=30.0,
+              desc=f"gang {gang} running")
+        self.current_gang = gang
+        self.current_pods = names
+        return SliceOffer(
+            devices=self._devices[: SHAPE["pods"] * SHAPE["chips"]],
+            pp=SHAPE["pp"], virtual_stages=SHAPE["virtual"],
+            pods=names, namespace=NAMESPACE,
+        )
+
+    def _all_running(self, names) -> bool:
+        pods = [self._client.get_opt("v1", "Pod", n, NAMESPACE) for n in names]
+        return all(p is not None and (p.get("status") or {}).get("phase") == "Running"
+                   for p in pods)
+
+
+def run(args) -> dict:
+    import jax
+
+    from kubeflow_tpu.controllers.builtin import PodletReconciler, make_tpu_node
+    from kubeflow_tpu.monitoring.goodput import TENANT_METER
+    from kubeflow_tpu.parallel.composite import CompositeConfig
+    from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+    from kubeflow_tpu.runtime.manager import Manager
+    from kubeflow_tpu.runtime.metrics import METRICS
+    from kubeflow_tpu.scheduler import SchedulerReconciler
+    from kubeflow_tpu.tpu.profiling import StepClock
+    from kubeflow_tpu.training.checkpoint import Checkpointer
+    from kubeflow_tpu.training.elastic import (
+        CompositeWorkload,
+        ElasticTrainer,
+        PreemptionHandler,
+    )
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"driver needs 8 virtual devices, got {len(devices)}"
+    cfg = CompositeConfig(n_layers=8, vocab_size=64)
+
+    mgr = Manager()
+    mgr.add(SchedulerReconciler(
+        assembly_timeout=5.0, reservation_ttl=5.0,
+        backoff_base=0.05, backoff_cap=0.4))
+    mgr.add(PodletReconciler())
+    client = mgr.client
+    client.create(make_tpu_node("tpu-node-0", "v5e", "2x4", 4))
+    client.create(make_tpu_node("tpu-node-1", "v5e", "2x4", 4))
+    client.create(make_tpu_node("tpu-spare", "v5e", "2x2", 4))
+    mgr.start()
+
+    # -- the metered tenant: one 4-chip gang bound for the whole run ----------
+    client.create(_gang_pod("meter-0", "meter", 1, 4, "trial",
+                            namespace=TENANT_NS))
+    _poll(lambda: ((client.get_opt("v1", "Pod", "meter-0", TENANT_NS) or {})
+                   .get("status") or {}).get("phase") == "Running",
+          desc="tenant gang running")
+    tenant_bound_at = time.monotonic()
+
+    ckpt_dir = tempfile.mkdtemp(prefix="goodput-e2e-")
+    requester = SliceRequester(client, devices)
+    monkey = ChaosMonkey(client, ChaosSchedule([]), store=mgr.store)
+
+    # -- scripted badput ------------------------------------------------------
+    # gens 1 & 2: GRACEFUL chaos preemption (urgent save + ack → zero replay)
+    # gen 3: HARD loss — pods deleted with no drain signal, timed on a step
+    # ≡ 1 (mod CKPT_EVERY) so the surviving checkpoint (saved at step ≡ 3)
+    # forces the next incarnation to replay exactly 2 steps
+    fired = set()
+
+    def graceful_preempt():
+        monkey.inject(Fault(
+            0.0, "preempt_gang", f"{NAMESPACE}/{requester.current_gang}",
+            param=GRACE_SECONDS))
+
+    def hard_kill():
+        for n in requester.current_pods:
+            client.delete_opt("v1", "Pod", n, NAMESPACE)
+
+    def maybe_fire(gen: int, local: int, step: int) -> None:
+        if gen in (1, 2) and local == 2 and gen not in fired:
+            fired.add(gen)
+            graceful_preempt()
+        elif (gen == 3 and 3 not in fired and local >= 1
+              and step % CKPT_EVERY == 1):
+            fired.add(3)
+            hard_kill()
+
+    class DrivenWorkload(CompositeWorkload):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._gen = None
+            self._local = 0
+
+        def run_step(self, state, step):
+            state, loss = super().run_step(state, step)
+            if requester.gen != self._gen:
+                self._gen, self._local = requester.gen, 0
+            maybe_fire(self._gen, self._local, step)
+            self._local += 1
+            time.sleep(STEP_SLEEP)
+            return state, loss
+
+    workload = DrivenWorkload(cfg=cfg, num_micro=4, microbatch=4,
+                              clock=StepClock())
+    trainer = ElasticTrainer(
+        workload,
+        Checkpointer(ckpt_dir, max_to_keep=3),
+        requester,
+        TOTAL_STEPS,
+        checkpoint_every=CKPT_EVERY,
+        handler_factory=lambda offer: PreemptionHandler(
+            client, NAMESPACE, offer.pods, poll_interval=0.02),
+    )
+
+    try:
+        t0 = time.perf_counter()
+        report = trainer.run()
+        elapsed = time.perf_counter() - t0
+
+        # -- settle the tenant interval before reading the meter --------------
+        client.delete_opt("v1", "Pod", "meter-0", TENANT_NS)
+        _poll(lambda: TENANT_NS not in TENANT_METER.open_intervals(),
+              desc="tenant interval settled")
+        tenant_unbound_at = time.monotonic()
+    finally:
+        monkey.stop()
+
+    try:
+        # -- survival ---------------------------------------------------------
+        assert report.completed, f"training never finished: {report.incarnations}"
+        assert report.preemptions_survived >= 2, report.incarnations
+        assert fired == {1, 2, 3}, f"unfired chaos phases: {fired}"
+        outcomes = [i["outcome"] for i in report.incarnations]
+        assert "lost" in outcomes, f"hard loss never happened: {outcomes}"
+
+        # -- the honesty contract --------------------------------------------
+        snap = trainer.goodput.snapshot()
+        fraction_sum = sum(snap["fractions"].values())
+        assert fraction_sum == 1.0, \
+            f"fractions must sum to exactly 1.0, got {fraction_sum!r}"
+        assert snap["reconstructionError"] <= RECONSTRUCTION_TOL, (
+            "named buckets fail to reconstruct wallclock: "
+            f"{snap['reconstructionError']:.4f} > {RECONSTRUCTION_TOL}; "
+            f"decomposition: {snap['badputSeconds']}")
+        wall_delta = abs(snap["wallclockSeconds"] - elapsed) / elapsed
+        assert wall_delta <= RECONSTRUCTION_TOL, (
+            f"ledger wallclock {snap['wallclockSeconds']:.2f}s vs driver "
+            f"{elapsed:.2f}s ({wall_delta:.1%})")
+
+        # -- attribution: chaos lands in named buckets, not `other` ----------
+        bad = snap["badputSeconds"]
+        assert bad["preemption_replay"] > 0.0, bad
+        assert bad["checkpoint_restore"] > 0.0, bad
+        assert bad["checkpoint_save"] > 0.0, bad
+        assert bad["compile"] > 0.0, "StepClock compile never drained"
+        assert bad["scheduling_wait"] > 0.0, bad
+        replayed = sum(i["goodput"]["replaySteps"] for i in report.incarnations)
+        assert replayed == 2, (
+            f"hard loss on step ≡ 1 (mod {CKPT_EVERY}) must replay exactly "
+            f"2 steps, replayed {replayed}")
+        # graceful drains urgent-save at the drained step: every non-lost
+        # handover resumes at endStep+1 with zero replay
+        for prev, cur in zip(report.incarnations, report.incarnations[1:]):
+            if prev["outcome"] == "preempted":
+                assert cur["startStep"] == prev["endStep"] + 1, (prev, cur)
+                assert cur["goodput"]["replaySteps"] == 0, cur
+
+        # -- scheduling_wait vs the scheduler's own bind-latency signal ------
+        bind = METRICS.histogram("scheduler_bind_latency_seconds")
+        assert bind.total >= len(report.incarnations), bind.total
+        slack = BIND_LATENCY_SLACK_PER_GANG * bind.total
+        assert abs(bad["scheduling_wait"] - bind.sum) <= slack, (
+            f"scheduling_wait {bad['scheduling_wait']:.2f}s vs scheduler "
+            f"bind latency {bind.sum:.2f}s over {int(bind.total)} gangs")
+
+        # -- satellite histograms --------------------------------------------
+        restore_h = METRICS.histogram("checkpoint_restore_seconds")
+        assert restore_h.total >= 3, restore_h.total  # one per re-incarnation
+        assert METRICS.total("training_badput_seconds_total") > 0.0
+        assert METRICS.value("training_badput_seconds_total",
+                             bucket="preemption_replay") > 0.0
+        goodput_fraction = METRICS.value("training_goodput_fraction",
+                                         workload="training")
+        assert goodput_fraction > 0.0
+        # the gauge publishes round(fraction, 6)
+        assert abs(goodput_fraction - snap["goodputFraction"]) <= 1e-6, (
+            goodput_fraction, snap["goodputFraction"])
+
+        # -- tenant metering: chips × bound duration --------------------------
+        expected_chip_s = 4 * (tenant_unbound_at - tenant_bound_at)
+        actual_chip_s = METRICS.value("tenant_chip_seconds_total",
+                                      namespace=TENANT_NS)
+        assert abs(actual_chip_s - expected_chip_s) <= TENANT_TOL_CHIP_SECONDS, (
+            f"tenant_chip_seconds_total={actual_chip_s:.2f} vs "
+            f"chips×duration={expected_chip_s:.2f}")
+
+        # -- federation: scrape → TSDB → recording rule → dashboard ----------
+        monitoring = monitoring_phase(client, snap)
+
+        summary = {
+            "ok": True,
+            "elapsed_seconds": round(elapsed, 1),
+            "preemptions_survived": report.preemptions_survived,
+            "incarnations": [
+                {k: v for k, v in i.items() if k != "offer"}
+                for i in report.incarnations
+            ],
+            "goodput_fraction": round(snap["goodputFraction"], 4),
+            "reconstruction_error": round(snap["reconstructionError"], 4),
+            "badput_seconds": {k: round(v, 3) for k, v in bad.items()},
+            "replayed_steps": replayed,
+            "tenant_chip_seconds": round(actual_chip_s, 2),
+            "monitoring": monitoring,
+        }
+        # metric line for the GOODPUT_r* bench-gate family
+        print(json.dumps({"metric": "training_goodput_fraction",
+                          "value": round(snap["goodputFraction"], 4)}))
+        print(json.dumps(summary))
+        return summary
+    finally:
+        mgr.stop()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def monitoring_phase(client, snap) -> dict:
+    """Scrape this process over real HTTP into a MonitoringPlane, evaluate
+    the goodput recording rule, and read the dashboard's goodput/tenants
+    sections from the federated TSDB."""
+    from kubeflow_tpu.api.meta import new_object
+    from kubeflow_tpu.monitoring import (
+        SCRAPE_ANNOTATION,
+        SCRAPE_JOB_ANNOTATION,
+        SCRAPE_URL_ANNOTATION,
+        MonitoringPlane,
+        goodput_recording_rules,
+    )
+    from kubeflow_tpu.runtime.obs import mount_observability
+    from kubeflow_tpu.services.dashboard import make_dashboard_app
+    from kubeflow_tpu.web.auth import AuthConfig
+    from kubeflow_tpu.web.http import App
+
+    app = App("trainer")
+    mount_observability(app)
+    httpd = app.serve(0)
+    try:
+        client.create(new_object(
+            "v1", "Pod", "goodput-target", NAMESPACE,
+            annotations={
+                SCRAPE_ANNOTATION: "true",
+                SCRAPE_URL_ANNOTATION:
+                    f"http://127.0.0.1:{httpd.port}/metrics",
+                SCRAPE_JOB_ANNOTATION: "training",
+            }))
+        plane = MonitoringPlane(client=client, stale_after=10, timeout_s=5.0)
+        for rule in goodput_recording_rules():
+            plane.rules.add(rule)
+        up = plane.scraper.scrape_once()
+        assert up and all(up.values()), f"scrape target not up: {up}"
+        plane.tick()
+
+        scraped = {lab.get("workload"): v for lab, _t, v in
+                   plane.tsdb.latest("training_goodput_fraction")}
+        assert scraped.get("training") is not None, scraped
+        assert abs(scraped["training"] - snap["goodputFraction"]) < 1e-3, (
+            scraped, snap["goodputFraction"])
+        recorded = [v for _l, _t, v in
+                    plane.tsdb.latest("platform:training_goodput_fraction")]
+        assert recorded and 0.0 < recorded[0] <= 1.0, (
+            f"recording rule produced {recorded}")
+        assert list(plane.tsdb.latest("tenant_chip_seconds_total")), \
+            "tenant chip meter not federated"
+
+        dash = make_dashboard_app(client, auth=AuthConfig(disable_auth=True),
+                                  monitoring=plane)
+        overview = dash.call("GET", "/api/metrics/platform?window=60",
+                             None, {"kubeflow-userid": "ops@example.com"})
+        assert overview.status == 200, overview.body
+        doc = overview.body
+        gp = doc["goodput"]
+        assert gp["trainingGoodputFraction"], gp
+        assert gp["trainingBadputSeconds"].get("preemption_replay", 0) > 0, gp
+        tenants = {t["namespace"]: t for t in doc["tenants"]}
+        assert TENANT_NS in tenants and tenants[TENANT_NS]["chipSeconds"] > 0, \
+            doc["tenants"]
+        return {
+            "scraped_goodput_fraction": round(scraped["training"], 4),
+            "recorded_measured_fraction": round(recorded[0], 4),
+            "dashboard_tenants": sorted(tenants),
+        }
+    finally:
+        httpd.close()
+
+
+def main(argv=None) -> int:
+    return run_driver(
+        suite_name="goodput-e2e",
+        class_name="GoodputLedgerDryrun",
+        case_name=f"reconcile-wallclock-{TOTAL_STEPS}-steps-3-preemptions",
+        make_case=lambda args: lambda: run(args),
+        argv=argv,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

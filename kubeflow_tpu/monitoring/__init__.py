@@ -14,7 +14,11 @@ nothing that could read one. This package closes the loop:
   alerts (SRE-workbook 5m/1h + 30m/6h pairs) with a pending→firing→resolved
   lifecycle, emitted as deduplicated K8s Warning Events,
 - ``plane``   — ``MonitoringPlane`` composing the three, serving
-  ``/federate`` and ``/debug/alerts``.
+  ``/federate`` and ``/debug/alerts``,
+- ``goodput`` — the accounting layer over all of it: wallclock-reconciled
+  goodput/badput decomposition per training workload, per-tenant chip and
+  token metering, and the serving token-goodput view, at
+  ``GET /debug/goodput``.
 """
 
 from .tsdb import TSDB, Matchers  # noqa: F401
@@ -39,3 +43,12 @@ from .rules import (  # noqa: F401
 )
 from .traces import TraceCollector, critical_path, traces_url  # noqa: F401
 from .plane import MonitoringPlane, install_cluster_collector  # noqa: F401
+from .goodput import (  # noqa: F401
+    BADPUT_BUCKETS,
+    GoodputLedger,
+    TENANT_METER,
+    TenantChipMeter,
+    get_ledger,
+    goodput_recording_rules,
+    serving_goodput_view,
+)

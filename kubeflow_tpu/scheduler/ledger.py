@@ -37,6 +37,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from ..api import meta as apimeta
+from ..monitoring.goodput import TENANT_METER
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
 from .gang import TERMINAL_PHASES, gang_of
 
@@ -134,6 +135,7 @@ class ChipLedger:
         """Cacheless fallback (no Manager/informer): rebuild from a fresh list.
         Reservations are kept — they are scheduler state, not cluster state."""
         with self._lock:
+            stale = list(self._records)
             self._capacity.clear()
             self._labels.clear()
             self._records.clear()
@@ -146,6 +148,10 @@ class ChipLedger:
             self._base_free.clear()
             self._hn.clear()
             self._by_hostname.clear()
+        # settle tenant meter intervals for everything we just forgot; pods
+        # still bound re-open their interval when re-listed below
+        for key in stale:
+            TENANT_METER.on_unbind(key)
         for n in nodes:
             self.on_node_event("ADDED", n)
         for p in pods:
@@ -544,11 +550,15 @@ class ChipLedger:
             self._adjust(old.node, -old.chips)
         self._records[key] = rec
         self._adjust(rec.node, rec.chips)
+        # tenant chip-second accrual opens at bind; the meter is idempotent
+        # for the informer echo of a bind this scheduler already assumed
+        TENANT_METER.on_bind(key, rec.namespace, rec.chips)
 
     def _drop(self, key: PodKey) -> None:
         old = self._records.pop(key, None)
         if old is not None:
             self._adjust(old.node, -old.chips)
+            TENANT_METER.on_unbind(key)
 
     def _adjust(self, node: str, delta: int) -> None:
         n = self._used.get(node, 0) + delta

@@ -162,10 +162,63 @@ class TpuMetricsService:
             "targets": sorted(targets, key=lambda t: t["instance"]),
             "serving": serving,
             "scheduler": scheduler,
+            "goodput": self._goodput_overview(),
+            "tenants": self._tenant_overview(),
             "tracing": self._tracing_overview(),
             "alerts": alerts,
             "series": self.tsdb.stats(),
         }
+
+    def _goodput_overview(self) -> Dict[str, Any]:
+        """The federated goodput story (ISSUE 19): the workloads' live
+        goodput fraction, the badput decomposition by bucket summed across
+        instances, and serving token goodput from the waste counters."""
+        fractions = {
+            labels.get("workload", ""): value
+            for labels, _ts, value in self.tsdb.latest(
+                "training_goodput_fraction")
+        }
+        badput: Dict[str, float] = {}
+        for labels, _ts, value in self.tsdb.latest(
+                "training_badput_seconds_total"):
+            bucket = labels.get("bucket", "other")
+            badput[bucket] = badput.get(bucket, 0.0) + value
+        goodput_s = sum(v for _l, _ts, v in self.tsdb.latest(
+            "training_goodput_seconds_total"))
+        delivered = sum(v for _l, _ts, v in self.tsdb.latest(
+            "serving_tokens_out_total"))
+        discarded = sum(v for _l, _ts, v in self.tsdb.latest(
+            "serving_discarded_tail_tokens_total"))
+        return {
+            "trainingGoodputFraction": fractions or None,
+            "trainingGoodputSeconds": round(goodput_s, 6),
+            "trainingBadputSeconds": {k: round(v, 6)
+                                      for k, v in sorted(badput.items())},
+            "servingTokenGoodputFraction": (
+                delivered / (delivered + discarded)
+                if delivered + discarded > 0 else None),
+        }
+
+    def _tenant_overview(self) -> List[Dict[str, Any]]:
+        """Per-namespace resource accounting: chip-seconds accrued by the
+        scheduler's bind/unbind lifecycle, tokens in/out from serving."""
+        chip_seconds: Dict[str, float] = {}
+        for labels, _ts, value in self.tsdb.latest("tenant_chip_seconds_total"):
+            ns = labels.get("namespace", "default")
+            chip_seconds[ns] = chip_seconds.get(ns, 0.0) + value
+        tokens: Dict[str, Dict[str, float]] = {}
+        for labels, _ts, value in self.tsdb.latest("tenant_tokens_total"):
+            ns = labels.get("namespace", "default")
+            direction = labels.get("direction", "out")
+            per = tokens.setdefault(ns, {})
+            per[direction] = per.get(direction, 0.0) + value
+        return [
+            {"namespace": ns,
+             "chipSeconds": round(chip_seconds.get(ns, 0.0), 6),
+             "tokensIn": tokens.get(ns, {}).get("in", 0.0),
+             "tokensOut": tokens.get(ns, {}).get("out", 0.0)}
+            for ns in sorted(set(chip_seconds) | set(tokens))
+        ]
 
     def _tracing_overview(self) -> Optional[Dict[str, Any]]:
         """Slowest gang binds from the plane's TraceCollector, each carrying

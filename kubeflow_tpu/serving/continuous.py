@@ -1861,7 +1861,10 @@ class ContinuousBatcher:
                     width)
                 if req.finish_reason in ("deadline", "cancelled"):
                     # tokens generated past an expired deadline / abandoned
-                    # future — the goodput-loss counter (ISSUE 9)
+                    # future — the goodput-loss counter, rolled up with the
+                    # shed/expiry waste into the serving token-goodput view
+                    # (monitoring/goodput.serving_goodput_view, surfaced at
+                    # GET /debug/goodput and in the dashboard)
                     METRICS.counter("serving_wasted_decode_tokens_total").inc(
                         width)
                 continue

@@ -64,6 +64,12 @@ from .router import PrefixRouter
 DRAIN_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                  120.0)
 
+#: replica created → first routable (pod bind + any prewarm): the ROADMAP
+#: item-5 baseline SLI, so the ladder reaches from in-process fakes
+#: (milliseconds) to real weight-loading cold starts (minutes)
+COLD_START_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                      60.0, 120.0)
+
 #: how long a handoff bridge waits on the survivor when the request
 #: carries NO deadline (deadline-bearing requests wait out their own
 #: remaining budget instead)
@@ -401,6 +407,7 @@ class EngineFleet:
     def _add_replica(self, role: str = "unified",
                      model_id: str = "") -> ReplicaHandle:
         """Caller holds the lock."""
+        created_at = time.monotonic()
         rid = str(self._next_id)
         self._next_id += 1
         gauge_id = f"{self.name}-{rid}"
@@ -415,6 +422,9 @@ class EngineFleet:
         handle = ReplicaHandle(id=rid, engine=engine, gauge_id=gauge_id,
                                role=role, model_id=model_id,
                                breaker=self._breaker_factory())
+        # anchor cold start at replica creation, BEFORE engine construction
+        # finished, so prewarm/weight-load time is inside the measurement
+        handle.started_at = created_at
         METRICS.gauge("fleet_breaker_state", replica=gauge_id).set(
             handle.breaker.state_code)
         if self._client is not None:
@@ -423,8 +433,16 @@ class EngineFleet:
             handle.state = "pending"  # routable once the scheduler binds it
         else:
             handle.state = "ready"
+            self._observe_cold_start(handle)
         self._replicas[rid] = handle
         return handle
+
+    @staticmethod
+    def _observe_cold_start(handle: ReplicaHandle) -> None:
+        """Replica just became routable: record created → first-routable."""
+        METRICS.histogram(
+            "fleet_replica_cold_start_seconds", buckets=COLD_START_BUCKETS
+        ).observe(time.monotonic() - handle.started_at)
 
     def _set_replica_gauge(self) -> None:
         METRICS.gauge("fleet_replicas").set(self.desired_replicas)
@@ -519,9 +537,14 @@ class EngineFleet:
                     continue
                 node = (pod.get("spec") or {}).get("nodeName")
                 if h.state == "pending" and node:
+                    promoted = False
                     with self._lock:
-                        h.state = "ready"
-                        h.node = node
+                        if h.state == "pending":
+                            h.state = "ready"
+                            h.node = node
+                            promoted = True
+                    if promoted:
+                        self._observe_cold_start(h)
 
     # -- request path --------------------------------------------------------
     #: attempts per submit (first + retries); each RETRY also needs a
@@ -539,8 +562,21 @@ class EngineFleet:
         METRICS.gauge("fleet_breaker_state", replica=handle.gauge_id).set(
             handle.breaker.state_code)
 
+    def _note_tenant_tokens(self, direction: str, n: int) -> None:
+        """Per-tenant token metering (the fleet's namespace IS the tenant):
+        ``in`` = prompt tokens admitted, ``out`` = tokens delivered."""
+        if n > 0:
+            METRICS.counter("tenant_tokens_total",
+                            namespace=self._namespace or "default",
+                            direction=direction).inc(n)
+
     def _outcome_cb(self, handle: ReplicaHandle) -> Callable[[Any], None]:
         def on_done(req: Any) -> None:
+            # count delivered tokens BEFORE any early return: a cancelled
+            # request still delivered what it streamed, and on_done fires
+            # exactly once per request (handoff rebinds it to the final
+            # decode replica)
+            self._note_tenant_tokens("out", len(getattr(req, "tokens", ()) or ()))
             reason = getattr(req, "finish_reason", None)
             if reason == "cancelled":
                 return  # client walked away; says nothing about the replica
@@ -612,11 +648,13 @@ class EngineFleet:
                                                     priority=priority,
                                                     model_id=model)
                 try:
-                    return handle.engine.submit(
+                    fut = handle.engine.submit(
                         prompt_ids, max_new_tokens, eos_id=eos_id,
                         temperature=temperature, traceparent=traceparent,
                         deadline=deadline, priority=priority,
                         on_done=self._outcome_cb(handle))
+                    self._note_tenant_tokens("in", len(prompt_ids))
+                    return fut
                 except RuntimeError as e:
                     # engine wedged/closed outside our control: retire the
                     # handle and retry the route against the survivors

@@ -201,6 +201,7 @@ class Checkpointer:
         """Restore into the shardings/dtypes of ``state_template`` — arrays
         land directly on the template's mesh (cross-topology resume). With
         ``step=None``, walks newest→oldest past corrupt checkpoints."""
+        t0 = time.perf_counter()
         for chosen in self._restore_order(step):
             try:
                 arrays, _meta = self._load_arrays(chosen)
@@ -216,6 +217,7 @@ class Checkpointer:
                     break
                 out.append(_place_like(arrays[key], leaf))
             else:
+                self._observe_restore(t0)
                 return jax.tree_util.tree_unflatten(treedef, out)
         raise FileNotFoundError(f"no usable checkpoint under {self.directory}")
 
@@ -225,6 +227,7 @@ class Checkpointer:
         """(pytree of numpy arrays, meta) without a template — only for
         checkpoints whose structure is nested dicts/lists (the canonical
         elastic format). Walks newest→oldest past corrupt checkpoints."""
+        t0 = time.perf_counter()
         for chosen in self._restore_order(step):
             try:
                 manifest = self._load_manifest(chosen)
@@ -234,8 +237,17 @@ class Checkpointer:
             tree: Any = None
             for entry in manifest["leaves"]:
                 tree = _insert_by_tokens(tree, entry["path"], arrays[entry["key"]])
+            self._observe_restore(t0)
             return tree, meta
         raise FileNotFoundError(f"no usable checkpoint under {self.directory}")
+
+    @staticmethod
+    def _observe_restore(t0: float) -> None:
+        # only successful restores count: a FileNotFoundError walk over an
+        # empty directory is init-path control flow, not restore cost
+        METRICS.histogram(
+            "checkpoint_restore_seconds", buckets=SAVE_BUCKETS
+        ).observe(time.perf_counter() - t0)
 
     def wait(self) -> None:
         with self._lock:

@@ -20,6 +20,10 @@ preemptible, restartable workers:
 Metrics: ``training_preemptions_survived_total``,
 ``training_restart_seconds`` (plus ``checkpoint_save_seconds`` from
 training/checkpoint.py) — the elastic e2e driver asserts on all three.
+Every run also feeds a :class:`~kubeflow_tpu.monitoring.goodput.GoodputLedger`
+(scheduling_wait / checkpoint_restore / reshard / checkpoint_save intervals,
+per-step goodput-vs-replay attribution) — the goodput e2e driver asserts the
+decomposition reconciles against its own wallclock measurement.
 """
 
 from __future__ import annotations
@@ -30,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api import meta as apimeta
+from ..monitoring.goodput import GoodputLedger
 from ..runtime.metrics import METRICS
 from ..scheduler.gang import (
     DRAIN_ACK_ANNOTATION,
@@ -185,6 +190,7 @@ class ElasticTrainer:
         checkpoint_every: int = 0,
         handler_factory: Optional[Callable[[SliceOffer], Any]] = None,
         max_incarnations: int = 32,
+        goodput: Optional[GoodputLedger] = None,
     ) -> None:
         self.workload = workload
         self.ckpt = checkpointer
@@ -193,14 +199,29 @@ class ElasticTrainer:
         self.checkpoint_every = int(checkpoint_every)
         self.handler_factory = handler_factory
         self.max_incarnations = int(max_incarnations)
+        self.goodput = goodput if goodput is not None else GoodputLedger()
 
     def run(self) -> ElasticReport:
         report = ElasticReport(completed=False)
+        gp = self.goodput
+        gp.start()
+        step_clock = getattr(self.workload, "clock", None)
+        if step_clock is not None:
+            gp.attach_step_clock(step_clock)
+        try:
+            return self._run(report)
+        finally:
+            gp.finish()
+
+    def _run(self, report: ElasticReport) -> ElasticReport:
+        gp = self.goodput
         for attempt in range(self.max_incarnations):
             t0 = time.perf_counter()
+            gp.begin_incarnation(attempt)
             offer = self.slice_provider(attempt)
             if offer is None:
                 break
+            gp.note("scheduling_wait", time.perf_counter() - t0)
             state, start = self._restore_or_init(offer)
             handler = self.handler_factory(offer) if self.handler_factory else None
             if attempt > 0:
@@ -213,6 +234,7 @@ class ElasticTrainer:
             outcome, end_step = self._train(state, start, handler, report)
             inc["outcome"] = outcome
             inc["endStep"] = end_step
+            inc["goodput"] = gp.end_incarnation(outcome, end_step)
             if outcome == "completed":
                 report.completed = True
                 return report
@@ -224,17 +246,28 @@ class ElasticTrainer:
 
     # -- one incarnation -----------------------------------------------------
     def _restore_or_init(self, offer: SliceOffer) -> Tuple[Any, int]:
+        t0 = time.perf_counter()
         try:
             snap, meta = self.ckpt.restore_numpy()
         except FileNotFoundError:
-            return self.workload.init(offer), 0
+            # nothing to read — first incarnation's build is mesh/step_fn
+            # setup for the offered shape, i.e. the reshard bucket
+            t1 = time.perf_counter()
+            state = self.workload.init(offer)
+            self.goodput.note("reshard", time.perf_counter() - t1)
+            return state, 0
+        self.goodput.note("checkpoint_restore", time.perf_counter() - t0)
+        t1 = time.perf_counter()
         state = self.workload.restore(offer, snap, meta)
+        self.goodput.note("reshard", time.perf_counter() - t1)
         return state, int(meta.get("step", -1)) + 1
 
     def _train(self, state, start: int, handler, report: ElasticReport):
         step = start
         while step < self.total_steps:
+            s0 = time.perf_counter()
             state, loss = self.workload.run_step(state, step)
+            self.goodput.step(step, time.perf_counter() - s0)
             report.losses[step] = float(loss)
             if self.checkpoint_every and (step + 1) % self.checkpoint_every == 0:
                 self._save(state, step)
@@ -256,10 +289,12 @@ class ElasticTrainer:
         return "completed", step
 
     def _save(self, state, step: int) -> None:
+        t0 = time.perf_counter()
         snap, wmeta = self.workload.snapshot(state)
         meta = {"step": step}
         meta.update(wmeta or {})
         self.ckpt.save(step, snap, meta=meta)
+        self.goodput.note("checkpoint_save", time.perf_counter() - t0)
 
 
 class CompositeWorkload:
@@ -272,6 +307,12 @@ class CompositeWorkload:
     Batches are derived from the step index (seeded), never from an
     in-memory iterator, so the data pipeline "cursor" in the checkpoint
     meta is just the step — replay after restore sees identical data.
+
+    With a ``clock`` (``tpu.profiling.StepClock``) the workload phases its
+    step body — batch synthesis under ``data_wait``, a one-time AOT compile
+    per incarnation under ``compile``, execution under ``compute``, the loss
+    readback under ``fetch`` — so the goodput ledger can drain compile and
+    data-wait time out of step wall time into their own badput buckets.
     """
 
     def __init__(
@@ -284,6 +325,7 @@ class CompositeWorkload:
         data_seed: int = 0,
         init_seed: int = 0,
         gather_mode: str = "eager",
+        clock: Optional[Any] = None,
     ) -> None:
         from ..parallel.composite import CompositeConfig
 
@@ -294,6 +336,7 @@ class CompositeWorkload:
         self.data_seed = data_seed
         self.init_seed = init_seed
         self.gather_mode = gather_mode
+        self.clock = clock
 
     def _setup(self, offer: SliceOffer):
         from ..parallel.composite import make_train_step
@@ -357,6 +400,28 @@ class CompositeWorkload:
         return jax.device_put(ids, batch_sharding(state["mesh"]))
 
     def run_step(self, state, step: int):
-        params, loss = state["step_fn"](state["params"], self._batch(state, step))
+        if self.clock is None:
+            params, loss = state["step_fn"](state["params"], self._batch(state, step))
+            state["params"] = params
+            return state, float(loss)
+        clock = self.clock
+        with clock.data_wait():
+            batch = self._batch(state, step)
+        if not state.get("warm"):
+            # one AOT compile per incarnation so XLA time lands in the
+            # clock's separate compile accumulator, never in a step
+            with clock.compile():
+                try:
+                    state["step_fn"] = (
+                        state["step_fn"].lower(state["params"], batch).compile()
+                    )
+                except AttributeError:  # already an AOT executable
+                    pass
+            state["warm"] = True
+        with clock.compute():
+            params, loss = state["step_fn"](state["params"], batch)
+        with clock.fetch():
+            loss = float(loss)
+        clock.end_step()
         state["params"] = params
-        return state, float(loss)
+        return state, loss

@@ -104,6 +104,13 @@ SPECS: Dict[str, Tuple[str, float]] = {
     "failover_to_bind_p99_s": ("lower", 0.50),
     "recovery_replay_seconds": ("lower", 0.50),
     "wal_append_p99_ms": ("lower", 1.00),
+    # ISSUE-19 goodput row (e2e/goodput_driver.py → GOODPUT_r*.json): the
+    # wallclock-goodput fraction of the chaos dryrun. On the CPU topology
+    # the run is XLA-compile-dominated (one AOT compile per incarnation of
+    # a deliberately preemption-heavy run), so the fraction is small and
+    # wobbles with compile time — wide band; the absolute floor below is
+    # the real guard.
+    "training_goodput_fraction": ("higher", 0.50),
 }
 
 #: Absolute flagship floors: {metric: (floor, applies_from_round)} — checked
@@ -124,6 +131,11 @@ FLOORS: Dict[str, Tuple[float, int]] = {
     # self-draft as bench default — the BASELINE note r06 carried for
     # spec_accept_rate is retired; from r08 on the rate must hold the floor.
     "spec_accept_rate": (0.4, 8),
+    # ISSUE-19: a ledger that stops crediting goodput (or a platform change
+    # that silently doubles scheduling/restore badput) reads ~0 here; the
+    # committed GOODPUT_r01 measured 0.10 on the compile-dominated CPU run,
+    # so 0.05 trips on broken accounting, not compile wobble.
+    "training_goodput_fraction": (0.05, 1),
 }
 
 
@@ -211,14 +223,15 @@ def load_history(history_dir: Path, exclude: List[str],
     """All rounds' metrics, keyed by round number, BENCH_* and MULTICHIP_*
     files of the same round merged. ``exclude`` drops rounds by "rNN".
     ``family`` restricts to one history family ("BENCH" / "MULTICHIP" /
-    "CONTROLPLANE") — families number their rounds independently, so the
+    "CONTROLPLANE" / "GOODPUT") — families number their rounds independently, so the
     CLI gates each family at its own newest round (a CONTROLPLANE_r02
     landing next to BENCH_r06 is still gated against CONTROLPLANE_r01
     rather than skipped for not being the globally newest round)."""
     skip = {int(e.lstrip("rR")) for e in exclude}
     rounds: Dict[int, Dict[str, float]] = {}
     for path in sorted(history_dir.glob("*.json")):
-        m = re.fullmatch(r"(BENCH|MULTICHIP|CONTROLPLANE)_r(\d+)\.json", path.name)
+        m = re.fullmatch(r"(BENCH|MULTICHIP|CONTROLPLANE|GOODPUT)_r(\d+)\.json",
+                         path.name)
         if not m or int(m.group(2)) in skip:
             continue
         if family is not None and m.group(1) != family:
@@ -231,7 +244,7 @@ def load_history(history_dir: Path, exclude: List[str],
     return rounds
 
 
-FAMILIES = ("BENCH", "MULTICHIP", "CONTROLPLANE")
+FAMILIES = ("BENCH", "MULTICHIP", "CONTROLPLANE", "GOODPUT")
 
 
 def gate(rounds: Dict[int, Dict[str, float]],
@@ -319,6 +332,12 @@ def render(results: List[dict], newest: Optional[int],
             lines.append(f"{r['metric']:<44}{r['value']:>12.2f}{'—':>12}{'—':>7}"
                          f"{'—':>9}{r['tolerance']:>7.0%}  BASELINE (first round"
                          " with this metric)")
+            continue
+        if "delta_pct" not in r:
+            # first round carrying the metric, failed/waived on its floor
+            lines.append(f"{r['metric']:<44}{r['value']:>12.2f}{'—':>12}{'—':>7}"
+                         f"{'—':>9}{r['tolerance']:>7.0%}  {r['verdict']}"
+                         f"{floor_note}")
             continue
         arrow = "+" if r["delta_pct"] >= 0 else ""
         lines.append(
