@@ -252,6 +252,23 @@ def goodput_e2e() -> Dict:
     return b.build()
 
 
+def straggler_e2e() -> Dict:
+    """The straggler-plane job: the chaos detection dryrun — a live
+    8-virtual-device elastic run where per-worker step beacons federate
+    through a real scrape, a chaos-slowed worker is flagged within the
+    k-of-n window budget, a chaos-wedged worker draws a hang verdict whose
+    all-thread stack dump names the wedged frame, the hosting node is
+    quarantined (ledger cordon + ``quarantined`` flight-recorder verdicts)
+    and the gang reshards around the loss with loss parity vs the
+    uninterrupted reference (e2e/straggler_driver.py asserts all of it) —
+    plus the beacon / detector / cordon / chaos-injector unit suite."""
+    b = WorkflowBuilder("straggler-e2e")
+    b.run("straggler-chaos-dryrun", ["python", "-m", "e2e.straggler_driver"],
+          env=EIGHT_DEVICE_ENV)
+    b.pytest("straggler-unit", "tests/test_stragglers.py", env=EIGHT_DEVICE_ENV)
+    return b.build()
+
+
 def paged_kv_e2e() -> Dict:
     """The paged-KV serving job: a 2-replica fleet on the paged arena +
     chunked prefill + speculative decode path over real HTTP — greedy
@@ -431,6 +448,7 @@ WORKFLOWS: Dict[str, Callable[[], Dict]] = {
     "disagg-serving-e2e": disagg_serving_e2e,
     "elastic-e2e": elastic_e2e,
     "goodput-e2e": goodput_e2e,
+    "straggler-e2e": straggler_e2e,
     "platlint": platlint,
     "bench-regression": bench_regression,
     "autotune-smoke": autotune_smoke,

@@ -15,6 +15,9 @@ nothing that could read one. This package closes the loop:
   lifecycle, emitted as deduplicated K8s Warning Events,
 - ``plane``   — ``MonitoringPlane`` composing the three, serving
   ``/federate`` and ``/debug/alerts``,
+- ``stragglers`` — cross-sectional straggler/hang detection over the
+  federated worker beacons (``training/heartbeat.py``), with stack-dump
+  forensics and quarantine-driven remediation, at ``/debug/stragglers``,
 - ``goodput`` — the accounting layer over all of it: wallclock-reconciled
   goodput/badput decomposition per training workload, per-tenant chip and
   token metering, and the serving token-goodput view, at
@@ -42,6 +45,7 @@ from .rules import (  # noqa: F401
     SLOBurnRateAlert,
 )
 from .traces import TraceCollector, critical_path, traces_url  # noqa: F401
+from .stragglers import StragglerDetector, straggler_rules  # noqa: F401
 from .plane import MonitoringPlane, install_cluster_collector  # noqa: F401
 from .goodput import (  # noqa: F401
     BADPUT_BUCKETS,

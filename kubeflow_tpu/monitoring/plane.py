@@ -45,6 +45,7 @@ class MonitoringPlane:
         stale_after: int = 3,
         timeout_s: float = 5.0,
         traces: Optional[TraceCollector] = None,
+        stragglers=None,
     ) -> None:
         self.tsdb = tsdb if tsdb is not None else TSDB()
         self.scraper = scraper if scraper is not None else Scraper(
@@ -57,6 +58,9 @@ class MonitoringPlane:
         # trace federation rides the same discovery + cadence as metrics;
         # optional because not every plane consumer wants the span store
         self.traces = traces
+        # straggler/hang detection cross-sections the freshly scraped TSDB
+        # on the same cadence (monitoring/stragglers.py); optional likewise
+        self.stragglers = stragglers
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -66,6 +70,8 @@ class MonitoringPlane:
         self.scraper.scrape_once(now)
         if self.traces is not None:
             self.traces.collect_once()
+        if self.stragglers is not None:
+            self.stragglers.tick(now)
         return self.rules.evaluate(now)
 
     def start(self, interval_s: float = 5.0) -> None:
@@ -128,6 +134,9 @@ class MonitoringPlane:
         from ..runtime.obs import EXPOSITION_CONTENT_TYPE, register_debug_source
 
         register_debug_source("alerts", lambda req: self.rules.snapshot())
+        if self.stragglers is not None:
+            register_debug_source(
+                "stragglers", lambda req: self.stragglers.snapshot())
         if self.traces is not None:
             self.traces.mount(app)
         if any(pattern == "/federate" for _m, pattern, _fn in app.iter_routes()):

@@ -85,6 +85,8 @@ class TraceCollector:
         self._traces: Dict[str, Dict[str, dict]] = {}
         # trace_id -> monotonic counter of last update (oldest-first drops)
         self._seen_at: Dict[str, int] = {}
+        # trace_id -> detector verdicts attached out-of-band (hang forensics)
+        self._verdicts: Dict[str, List[dict]] = {}
         self._clock = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -220,12 +222,24 @@ class TraceCollector:
                         continue
                     total -= len(self._traces.pop(tid))
                     self._seen_at.pop(tid, None)
+                    self._verdicts.pop(tid, None)
                     dropped += 1
                     self._registry.counter(
                         "tracing_collector_traces_dropped_total",
                         protected=str(protected).lower()).inc()
             self._registry.gauge("tracing_collector_spans").set(float(total))
         return dropped
+
+    # -- out-of-band verdicts ------------------------------------------------
+    def attach_verdict(self, trace_id: str, verdict: dict) -> None:
+        """Attach a detector verdict (a hang/straggler forensic record) to a
+        federated trace. Verdicts are not spans — they arrive from the
+        monitoring plane, not a scraped ring — but they ride the assembled
+        ``trace()`` view so the gang's trace tells the whole story. Verdicts
+        for traces the tail sampler has dropped (or never saw) are held
+        until the trace shows up or the store drops it."""
+        with self._lock:
+            self._verdicts.setdefault(trace_id, []).append(dict(verdict))
 
     # -- assembled views -----------------------------------------------------
     def trace_ids(self) -> List[str]:
@@ -238,11 +252,12 @@ class TraceCollector:
         client, apiserver, scheduler)."""
         with self._lock:
             spans = list(self._traces.get(trace_id, {}).values())
+            verdicts = [dict(v) for v in self._verdicts.get(trace_id, ())]
         if not spans:
             return None
         spans.sort(key=lambda s: s.get("startTimeUnixNano", 0))
         ends = [s.get("endTimeUnixNano", 0) for s in spans]
-        return {
+        out = {
             "traceId": trace_id,
             "spans": spans,
             "services": sorted({s.get("service", "unknown") for s in spans}),
@@ -250,6 +265,9 @@ class TraceCollector:
             "durationMs": round(
                 (max(ends) - spans[0].get("startTimeUnixNano", 0)) / 1e6, 3),
         }
+        if verdicts:
+            out["verdicts"] = verdicts
+        return out
 
     def slowest_binds(self, n: int = 10) -> List[dict]:
         """Gang-bind traces ranked by the scheduler's recorded bind latency

@@ -127,3 +127,20 @@ def composite_comm_bytes(
     out = {"pipe": pipe, "fsdp": fsdp, "model": model, "data": data}
     out["total"] = sum(out.values())
     return out
+
+
+def collective_wait_seconds(
+    total_bytes: float, *, link_bandwidth_gbps: float = 100.0
+) -> float:
+    """Analytic lower bound on a step's collective-wait wall time: the
+    modeled per-device wire bytes (``composite_comm_bytes(...)["total"]``)
+    pushed over one ICI link at ``link_bandwidth_gbps``.
+
+    The straggler plane's beacons use it as the *expected* collective-wait
+    baseline when a workload has no measured ``collective_wait`` phase: a
+    worker whose measured wait dwarfs this analytic floor is waiting on a
+    peer, not on the wire.
+    """
+    if total_bytes <= 0.0 or link_bandwidth_gbps <= 0.0:
+        return 0.0
+    return float(total_bytes) / (link_bandwidth_gbps * 1e9 / 8.0)

@@ -50,7 +50,19 @@ callable returning one, so the harness can swap in restarted processes):
   the procs key, default ``"scheduler"``); the standby must take over the
   Lease and finish the gang wave.
 
-Every firing bumps ``chaos_faults_injected_total{kind}``.
+ISSUE 20 adds the training-worker kinds for the straggler plane (pass
+``workers=``, a mapping of worker id → ``training.heartbeat.WorkerBeacon``;
+with no mapping the process-global beacon registry is consulted):
+
+- ``slow_worker``  — stretch one worker's per-step pacing by factor
+  ``param`` for ``duration`` seconds (degraded host): the skew detector
+  must flag it;
+- ``wedge_worker`` — park one worker inside its beacon's ``_wedge_wait``
+  frame (zero forward progress) until ``duration`` elapses or stop():
+  the hang detector must verdict it, and the stack dump names the frame.
+
+Both reset on ``stop()`` so a finished chaos run never leaves a worker
+degraded. Every firing bumps ``chaos_faults_injected_total{kind}``.
 """
 
 from __future__ import annotations
@@ -70,7 +82,8 @@ LOG = logging.getLogger(__name__)
 
 KINDS = ("kill_node", "preempt_gang", "drop_informer_watch", "delay_apiserver",
          "slow_replica", "crash_replica_mid_decode", "client_abandon",
-         "flood_apiserver", "kill9_apiserver", "kill9_scheduler")
+         "flood_apiserver", "kill9_apiserver", "kill9_scheduler",
+         "slow_worker", "wedge_worker")
 
 #: chaos components stamp Events under this source
 COMPONENT = "chaos-monkey"
@@ -152,6 +165,7 @@ class ChaosMonkey:
         fleet: Any = None,
         apiserver_url: Optional[str] = None,
         procs: Optional[Dict[str, Any]] = None,
+        workers: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._client = client
         self._schedule = schedule
@@ -165,6 +179,9 @@ class ChaosMonkey:
         #: role name → Popen (or zero-arg callable returning one) for the
         #: process-level kill9 kinds
         self._procs = dict(procs or {})
+        #: worker id → WorkerBeacon (training/heartbeat.py) — the target set
+        #: for the straggler-plane kinds slow_worker / wedge_worker
+        self._workers = dict(workers or {})
         #: (sent, rejected) tallies of completed floods, for harness asserts
         self.flood_stats: List[Dict[str, int]] = []
         self._stop = threading.Event()
@@ -172,6 +189,9 @@ class ChaosMonkey:
         #: engines slowed by slow_replica, reset on stop() so a finished
         #: chaos run never leaves a replica degraded
         self._slowed: List[Any] = []
+        #: worker beacons degraded by slow_worker/wedge_worker, likewise
+        #: restored on stop()
+        self._degraded_workers: List[Any] = []
         self.fired: List[Fault] = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -185,6 +205,9 @@ class ChaosMonkey:
         self._stop.set()
         for eng in self._slowed:
             eng.step_delay_s = 0.0
+        for beacon in self._degraded_workers:
+            beacon.slow_factor = 1.0
+            beacon.release()
         for t in self._threads:
             t.join(timeout=5.0)
 
@@ -378,6 +401,67 @@ class ChaosMonkey:
                 eng.step_delay_s = 0.0
 
             t = threading.Thread(target=recover, name="chaos-slow-recover", daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    # -- training-worker injectors --------------------------------------------
+    def _find_worker(self, target: Optional[str]):
+        """Resolve ``target`` against the registered worker beacons; None
+        (with exactly one worker) picks it, else the target is required."""
+        if not self._workers:
+            # fall back to the process-global beacon registry so a harness
+            # that built beacons after the monkey still resolves targets
+            from ..training.heartbeat import beacons
+
+            self._workers = beacons()
+        if not self._workers:
+            raise RuntimeError("worker faults need registered worker beacons")
+        if target is None:
+            if len(self._workers) == 1:
+                return next(iter(self._workers.values()))
+            raise RuntimeError("ambiguous worker target (several registered)")
+        beacon = self._workers.get(target)
+        if beacon is None:
+            raise RuntimeError(f"no worker beacon named {target!r}")
+        return beacon
+
+    def _slow_worker(self, fault: Fault) -> None:
+        """Degraded host / thermal throttle on one gang member: the worker's
+        per-step pacing stretches by factor ``param`` (>1). Its peers stall
+        in collectives behind it — the persistent-straggler signature the
+        detector must flag. After ``duration`` seconds (or stop()) the
+        worker recovers."""
+        beacon = self._find_worker(fault.target)
+        beacon.slow_factor = max(1.0, fault.param)
+        self._degraded_workers.append(beacon)
+        if fault.duration > 0:
+
+            def recover():
+                self._stop.wait(fault.duration)
+                beacon.slow_factor = 1.0
+
+            t = threading.Thread(target=recover, name="chaos-slow-worker-recover",
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def _wedge_worker(self, fault: Fault) -> None:
+        """Hard wedge: the worker parks inside its beacon's ``_wedge_wait``
+        frame at the next step and publishes nothing — zero forward
+        progress, the hang the detector must verdict (and whose stack dump
+        names this very frame). Released after ``duration`` seconds, or by
+        stop(), or by the detector-driven eviction tearing the worker down."""
+        beacon = self._find_worker(fault.target)
+        beacon.wedge()
+        self._degraded_workers.append(beacon)
+        if fault.duration > 0:
+
+            def release():
+                self._stop.wait(fault.duration)
+                beacon.release()
+
+            t = threading.Thread(target=release, name="chaos-wedge-release",
+                                 daemon=True)
             self._threads.append(t)
             t.start()
 

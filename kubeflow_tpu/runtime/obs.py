@@ -21,12 +21,15 @@ scrapeable wherever the work runs.
 
 from __future__ import annotations
 
+import collections
 import gc
 import os
+import re
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+import traceback
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 from ..web.http import App, HttpError, JsonResponse, Request
 from .metrics import (
@@ -57,6 +60,72 @@ def register_debug_source(name: str, handler: Callable[[Request], Any]) -> None:
     """Expose ``handler(req) -> JSON-able`` at ``GET /debug/<name>`` on every
     app that mounts observability (the Go expvar/pprof publish pattern)."""
     _DEBUG_SOURCES[name] = handler
+
+
+# -- /debug/stacks: all-thread stack dumps (the py-spy you always have) ------
+
+#: bounded history of captured dumps, newest last — a hang verdict's
+#: forensics must survive until an operator reads them, but an aggressive
+#: detector must not grow host memory without limit
+MAX_STACK_DUMPS = 32
+_STACK_HISTORY: Deque[Dict[str, Any]] = collections.deque(maxlen=MAX_STACK_DUMPS)
+_STACK_LOCK = threading.Lock()
+
+
+def _thread_label(name: str) -> str:
+    """Collapse digit runs (``worker-3`` → ``worker-N``) — same bounded-
+    cardinality discipline as ``runtime_thread_crashes_total``."""
+    return re.sub(r"\d+", "N", name or "unnamed")
+
+
+def capture_stacks(reason: str = "manual") -> Dict[str, Any]:
+    """Snapshot every live thread's Python stack via ``sys._current_frames``
+    into the bounded dump ring, and return the dump. The straggler plane's
+    hang forensics: the dump for a wedged worker names the exact frame the
+    thread is parked in."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    threads: List[Dict[str, Any]] = []
+    for ident, frame in sys._current_frames().items():
+        stack = traceback.extract_stack(frame)
+        threads.append({
+            "thread": _thread_label(names.get(ident, "")),
+            "threadName": names.get(ident, "unnamed"),
+            "frames": [
+                {"file": os.path.basename(f.filename), "line": f.lineno,
+                 "function": f.name}
+                for f in stack
+            ],
+            # innermost frame last in `frames`; surfaced for quick triage
+            "current": stack[-1].name if stack else None,
+        })
+    dump = {
+        "reason": reason,
+        "capturedAt": time.time(),
+        "pid": os.getpid(),
+        "threadCount": len(threads),
+        "threads": threads,
+    }
+    with _STACK_LOCK:
+        _STACK_HISTORY.append(dump)
+    return dump
+
+
+def _stacks_source(req: Request) -> Dict[str, Any]:
+    """``GET /debug/stacks`` — a fresh capture plus the bounded history
+    (``?history=0`` suppresses it; ``?capture=0`` serves history only)."""
+    capture = req.query1("capture", "1") != "0"
+    want_history = req.query1("history", "1") != "0"
+    live = capture_stacks(reason="debug-endpoint") if capture else None
+    with _STACK_LOCK:
+        history = list(_STACK_HISTORY) if want_history else []
+    return {
+        "live": live,
+        "history": history,
+        "maxDumps": MAX_STACK_DUMPS,
+    }
+
+
+register_debug_source("stacks", _stacks_source)
 
 
 def otlp_traces(tracer: Tracer, trace_id: Optional[str] = None,

@@ -37,6 +37,13 @@ DRAIN_GRACE_ANNOTATION = "scheduling.kubeflow.org/drain-grace-seconds"
 DRAIN_DEADLINE_ANNOTATION = "scheduling.kubeflow.org/drain-deadline"
 DRAIN_ACK_ANNOTATION = "scheduling.kubeflow.org/drain-acked"
 
+#: Node quarantine annotation (docs/SCHEDULER.md). The straggler detector
+#: stamps it on a node hosting a hung worker; the ChipLedger then excludes
+#: the node from placement (flight-recorder verdict: ``quarantined``) until
+#: an operator clears the annotation. Cordon, not drain: pods already bound
+#: there are evicted through the normal drain protocol, new work never lands.
+QUARANTINE_ANNOTATION = "scheduling.kubeflow.org/quarantined"
+
 #: Name of the per-namespace ResourceQuota ProfileReconciler materializes.
 QUOTA_NAME = "kf-resource-quota"
 #: The hard-limit key for TPU chips inside that quota.
@@ -102,6 +109,13 @@ def drain_grace_of(pod: Dict[str, Any]) -> float:
         return max(0.0, float(raw))
     except (TypeError, ValueError):
         return 0.0
+
+
+def is_quarantined(node: Dict[str, Any]) -> bool:
+    """Is this node cordoned by the straggler detector? Any value other
+    than empty/"false" counts — the annotation carries a JSON verdict."""
+    raw = apimeta.annotations_of(node).get(QUARANTINE_ANNOTATION)
+    return raw is not None and raw not in ("", "false")
 
 
 def is_terminal(pod: Dict[str, Any]) -> bool:

@@ -39,7 +39,7 @@ from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 from ..api import meta as apimeta
 from ..monitoring.goodput import TENANT_METER
 from ..tpu.topology import RESOURCE_TPU, pod_tpu_chips
-from .gang import TERMINAL_PHASES, gang_of
+from .gang import TERMINAL_PHASES, gang_of, is_quarantined
 
 PodKey = Tuple[Optional[str], str]
 GangKey = Tuple[Optional[str], str]
@@ -90,6 +90,12 @@ class ChipLedger:
         self._base_free: Dict[str, int] = {}  # node -> capacity - used
         self._hn: Dict[str, Optional[str]] = {}  # node -> hostname label value
         self._by_hostname: Dict[str, Set[str]] = {}
+        # Nodes cordoned by the straggler detector's quarantine annotation
+        # (scheduler/gang.py QUARANTINE_ANNOTATION): excluded from placement
+        # in BOTH the scan and indexed paths (decision parity holds), still
+        # tracked for capacity/used so explain() can say why. Maintained
+        # from node events; an annotation clear un-cordons on the next event.
+        self._cordoned: Set[str] = set()
 
     # -- event feeds ---------------------------------------------------------
 
@@ -99,8 +105,15 @@ class ChipLedger:
             if event_type == "DELETED":
                 self._capacity.pop(name, None)
                 self._labels.pop(name, None)
+                self._cordoned.discard(name)
                 self._index_drop(name)
             else:
+                # cordon state first: _index_touch consults it to keep the
+                # pool index free of quarantined nodes
+                if is_quarantined(node):
+                    self._cordoned.add(name)
+                else:
+                    self._cordoned.discard(name)
                 if name not in self._capacity:
                     # mirrors dict insertion order: re-adding a deleted node
                     # appends it, re-setting an existing key keeps its slot
@@ -148,6 +161,7 @@ class ChipLedger:
             self._base_free.clear()
             self._hn.clear()
             self._by_hostname.clear()
+            self._cordoned.clear()
         # settle tenant meter intervals for everything we just forgot; pods
         # still bound re-open their interval when re-listed below
         for key in stale:
@@ -250,8 +264,9 @@ class ChipLedger:
         """Per-node feasibility verdict for the flight recorder: why each
         candidate node can or cannot host (part of) the gang. Reasons are
         machine-readable — ``feasible``, ``selector_mismatch``,
-        ``insufficient_chips``, ``reserved_by_other_gang`` — the scheduler
-        analog of kube-scheduler's per-plugin filter failure messages.
+        ``insufficient_chips``, ``reserved_by_other_gang``, ``quarantined``
+        — the scheduler analog of kube-scheduler's per-plugin filter
+        failure messages.
 
         A node is judged against the *smallest* matching requirement: "can
         this node host ANY member" — per-member assignment is the placer's
@@ -269,7 +284,12 @@ class ChipLedger:
                     for chips, selector in requirements
                     if not any(labels.get(k) != v for k, v in (selector or {}).items())
                 ]
-                if not matching:
+                if node in self._cordoned:
+                    # quarantine outranks every other verdict: the node may
+                    # have free matching chips, the detector said never mind
+                    reason = "quarantined"
+                    need = min(matching or [c for c, _s in requirements] or [0])
+                elif not matching:
                     reason = "selector_mismatch"
                     need = min((c for c, _s in requirements), default=0)
                 else:
@@ -315,6 +335,8 @@ class ChipLedger:
         for chips, selector in requirements:
             best: Optional[str] = None
             for node in self._capacity:
+                if node in self._cordoned:
+                    continue
                 labels = self._labels.get(node, {})
                 if any(labels.get(k) != v for k, v in (selector or {}).items()):
                     continue
@@ -406,6 +428,8 @@ class ChipLedger:
     ) -> Optional[Tuple[int, int, str]]:
         if node not in self._capacity:
             return None  # assume_freed may name nodes the ledger never saw
+        if node in self._cordoned:
+            return None
         labels = self._labels.get(node, {})
         if any(labels.get(k) != v for k, v in sel.items()):
             return None
@@ -471,6 +495,15 @@ class ChipLedger:
         cap = self._capacity.get(name)
         if cap is None:
             self._index_drop(name)
+            return
+        if name in self._cordoned:
+            # keep the node out of the pool index entirely; _peek_bucket's
+            # lazy deletion (fp mismatch) purges any stale heap entries. The
+            # hostname map stays — _node_candidate rejects cordoned nodes.
+            fp = self._fp.pop(name, None)
+            if fp is not None:
+                self._pool_remove(name, fp)
+            self._base_free.pop(name, None)
             return
         labels = self._labels.get(name, {})
         hostname = labels.get(HOSTNAME_LABEL)
@@ -578,4 +611,5 @@ class ChipLedger:
                 "used": dict(self._used),
                 "records": {k: vars(v).copy() for k, v in self._records.items()},
                 "reserved": {k: dict(v[1]) for k, v in self._reserved.items()},
+                "cordoned": sorted(self._cordoned),
             }

@@ -165,6 +165,7 @@ class TpuMetricsService:
             "goodput": self._goodput_overview(),
             "tenants": self._tenant_overview(),
             "tracing": self._tracing_overview(),
+            "stragglers": self._straggler_overview(),
             "alerts": alerts,
             "series": self.tsdb.stats(),
         }
@@ -219,6 +220,33 @@ class TpuMetricsService:
              "tokensOut": tokens.get(ns, {}).get("out", 0.0)}
             for ns in sorted(set(chip_seconds) | set(tokens))
         ]
+
+    def _straggler_overview(self) -> Optional[Dict[str, Any]]:
+        """The straggler plane's fleet view (ISSUE 20): per-worker skew
+        scores and hang counts from the federated TSDB, plus — when the
+        plane runs a StragglerDetector — its active quarantines and the
+        last hang verdict. None when no straggler series have federated
+        and no detector is wired."""
+        scores = {
+            labels.get("worker", ""): value
+            for labels, _ts, value in self.tsdb.latest(
+                "training_straggler_score")
+        }
+        hangs: Dict[str, float] = {}
+        for labels, _ts, value in self.tsdb.latest(
+                "training_hangs_detected_total"):
+            worker = labels.get("worker", "")
+            hangs[worker] = hangs.get(worker, 0.0) + value
+        detector = getattr(self.monitoring, "stragglers", None)
+        snap = detector.snapshot() if detector is not None else None
+        if not scores and not hangs and snap is None:
+            return None
+        return {
+            "workerScores": scores or None,
+            "hangsDetected": hangs or None,
+            "activeQuarantines": snap["quarantined"] if snap else [],
+            "lastHangVerdict": snap["lastHangVerdict"] if snap else None,
+        }
 
     def _tracing_overview(self) -> Optional[Dict[str, Any]]:
         """Slowest gang binds from the plane's TraceCollector, each carrying

@@ -153,6 +153,12 @@ class StepClock:
         """D2H readback of step outputs (loss/metrics scalars)."""
         return self.phase("fetch")
 
+    def collective(self):
+        """Host blocked on cross-worker synchronization (barriers, collective
+        dispatch waits) — the straggler plane's skew signal: one slow worker
+        inflates every peer's collective_wait, not their compute."""
+        return self.phase("collective_wait")
+
     @contextmanager
     def compile(self):
         """XLA compile — accumulated separately, never charged to a step."""

@@ -215,9 +215,14 @@ class ElasticTrainer:
 
     def _run(self, report: ElasticReport) -> ElasticReport:
         gp = self.goodput
+        beacon = getattr(self.workload, "beacon", None)
         for attempt in range(self.max_incarnations):
             t0 = time.perf_counter()
             gp.begin_incarnation(attempt)
+            if beacon is not None:
+                # incarnation gauge bumps BEFORE any step publishes, so the
+                # straggler detector reads restart-then-step-reset in order
+                beacon.begin_incarnation(attempt)
             offer = self.slice_provider(attempt)
             if offer is None:
                 break
@@ -326,6 +331,7 @@ class CompositeWorkload:
         init_seed: int = 0,
         gather_mode: str = "eager",
         clock: Optional[Any] = None,
+        beacon: Optional[Any] = None,
     ) -> None:
         from ..parallel.composite import CompositeConfig
 
@@ -337,6 +343,9 @@ class CompositeWorkload:
         self.init_seed = init_seed
         self.gather_mode = gather_mode
         self.clock = clock
+        #: training.heartbeat.WorkerBeacon — per-step heartbeat + the chaos
+        #: plane's throttle point (slow_worker / wedge_worker land here)
+        self.beacon = beacon
 
     def _setup(self, offer: SliceOffer):
         from ..parallel.composite import make_train_step
@@ -401,8 +410,15 @@ class CompositeWorkload:
 
     def run_step(self, state, step: int):
         if self.clock is None:
+            t0 = time.perf_counter()
             params, loss = state["step_fn"](state["params"], self._batch(state, step))
             state["params"] = params
+            if self.beacon is not None:
+                wait = self.beacon.throttle()
+                self.beacon.publish(
+                    {"total": time.perf_counter() - t0, "collective_wait": wait},
+                    step,
+                )
             return state, float(loss)
         clock = self.clock
         with clock.data_wait():
@@ -422,6 +438,13 @@ class CompositeWorkload:
             params, loss = state["step_fn"](state["params"], batch)
         with clock.fetch():
             loss = float(loss)
-        clock.end_step()
+        if self.beacon is not None:
+            # the gradient-sync barrier stand-in: a slowed/wedged worker
+            # parks HERE, inside the measured collective_wait phase
+            with clock.collective():
+                self.beacon.throttle()
+        rec = clock.end_step()
+        if self.beacon is not None:
+            self.beacon.publish(rec, step)
         state["params"] = params
         return state, loss

@@ -1,0 +1,523 @@
+"""Straggler & hang detection plane: beacons, detectors, forensics,
+quarantine (ISSUE 20).
+
+Covers the detector edge cases the issue calls out — incarnation restart
+resets the step index without a hang verdict, single-worker gangs never
+self-flag, counter-reset-aware skew windows, quarantine idempotent under
+informer echo — plus the beacon publish path, the chaos injectors, the
+stack-dump forensic naming the wedged frame, and the ledger's cordon
+behaviour (placement + explain + snapshot).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api.meta import annotations_of, new_object
+from kubeflow_tpu.controllers.builtin import make_tpu_node
+from kubeflow_tpu.monitoring.stragglers import StragglerDetector, straggler_rules
+from kubeflow_tpu.monitoring.traces import TraceCollector
+from kubeflow_tpu.monitoring.tsdb import TSDB
+from kubeflow_tpu.runtime.chaos import ChaosMonkey, ChaosSchedule, Fault
+from kubeflow_tpu.runtime.metrics import METRICS
+from kubeflow_tpu.runtime.obs import capture_stacks
+from kubeflow_tpu.runtime.tracing import BIND_TRACEPARENT_ANNOTATION
+from kubeflow_tpu.scheduler.gang import (
+    DRAIN_DEADLINE_ANNOTATION,
+    POD_GROUP_LABEL,
+    QUARANTINE_ANNOTATION,
+    is_quarantined,
+)
+from kubeflow_tpu.scheduler.ledger import ChipLedger
+from kubeflow_tpu.training.heartbeat import (
+    WorkerBeacon,
+    beacons,
+    clear_beacons,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_beacons():
+    clear_beacons()
+    yield
+    clear_beacons()
+
+
+# -- TSDB feeding helpers -----------------------------------------------------
+
+
+def feed(tsdb, worker, ts, *, wall, step, incarnation=0):
+    """Publish one worker's beacon cross-section straight into the TSDB,
+    the way a scrape of ``training_worker_*`` would land it."""
+    labels = {"worker": worker}
+    tsdb.add_sample("training_worker_step_wall_seconds", labels, ts, wall)
+    tsdb.add_sample("training_worker_step_index", labels, ts, float(step))
+    tsdb.add_sample("training_worker_incarnation", labels, ts, float(incarnation))
+    tsdb.add_sample(
+        "training_worker_last_step_timestamp_seconds", labels, ts, ts
+    )
+
+
+def make_detector(tsdb=None, **kw):
+    return StragglerDetector(tsdb if tsdb is not None else TSDB(), **kw)
+
+
+# -- skew detection -----------------------------------------------------------
+
+
+class TestSkew:
+    def test_persistent_straggler_flagged_k_of_n(self):
+        tsdb = TSDB()
+        det = make_detector(tsdb, skew_factor=2.0, k=3, n=5)
+        for i in range(3):
+            now = 10.0 + i
+            feed(tsdb, "w0", now, wall=0.1, step=i)
+            feed(tsdb, "w1", now, wall=0.1, step=i)
+            feed(tsdb, "w2", now, wall=0.9, step=i)  # 9x the median
+            det.tick(now)
+        snap = det.snapshot()
+        assert snap["workers"]["w2"]["flagged"] is True
+        assert snap["workers"]["w2"]["score"] == pytest.approx(3 / 5)
+        assert snap["workers"]["w0"]["flagged"] is False
+        assert METRICS.value("training_straggler_score", worker="w2") == \
+            pytest.approx(3 / 5)
+        assert METRICS.value(
+            "training_stragglers_flagged_total", worker="w2") == 1
+
+    def test_transient_skew_below_k_never_flags(self):
+        tsdb = TSDB()
+        det = make_detector(tsdb, skew_factor=2.0, k=3, n=5)
+        for i in range(6):
+            now = 10.0 + i
+            # w2 is slow only on the first two windows, then recovers
+            wall = 0.9 if i < 2 else 0.1
+            feed(tsdb, "w0", now, wall=0.1, step=i)
+            feed(tsdb, "w1", now, wall=0.1, step=i)
+            feed(tsdb, "w2", now, wall=wall, step=i)
+            det.tick(now)
+        snap = det.snapshot()
+        assert snap["workers"]["w2"]["flagged"] is False
+        assert METRICS.value(
+            "training_stragglers_flagged_total", worker="w2") == 0
+
+    def test_single_worker_gang_never_self_flags(self):
+        tsdb = TSDB()
+        det = make_detector(tsdb, k=1, n=1)
+        for i in range(10):
+            feed(tsdb, "solo", 10.0 + i, wall=5.0, step=i)
+            det.tick(10.0 + i)
+        snap = det.snapshot()
+        assert snap["workers"]["solo"]["flagged"] is False
+        assert snap["workers"]["solo"]["score"] == 0.0
+        assert METRICS.value("training_straggler_score", worker="solo") == 0
+
+    def test_counter_reset_clears_skew_window(self):
+        """A restart mid-window must not let stale skew observations carry
+        into the new incarnation's k-of-n verdict."""
+        tsdb = TSDB()
+        det = make_detector(tsdb, skew_factor=2.0, k=3, n=5)
+        for i in range(2):  # two skewed windows — one short of k
+            now = 10.0 + i
+            feed(tsdb, "w0", now, wall=0.1, step=i)
+            feed(tsdb, "w1", now, wall=0.9, step=i)
+            feed(tsdb, "w2", now, wall=0.1, step=i)
+            det.tick(now)
+        # w1 restarts: step index goes backwards under a new incarnation
+        feed(tsdb, "w0", 20.0, wall=0.1, step=5)
+        feed(tsdb, "w1", 20.0, wall=0.9, step=0, incarnation=1)
+        feed(tsdb, "w2", 20.0, wall=0.1, step=5)
+        det.tick(20.0)
+        feed(tsdb, "w1", 21.0, wall=0.9, step=1, incarnation=1)
+        det.tick(21.0)
+        # only two post-restart windows observed — still below k
+        snap = det.snapshot()
+        assert snap["workers"]["w1"]["flagged"] is False
+        assert METRICS.value(
+            "training_stragglers_flagged_total", worker="w1") == 0
+
+
+# -- hang detection -----------------------------------------------------------
+
+
+class TestHang:
+    def test_stalled_worker_gets_hang_verdict_with_stack_dump(self):
+        tsdb = TSDB()
+        det = make_detector(tsdb, hang_deadline_s=5.0)
+        feed(tsdb, "w0", 10.0, wall=0.1, step=3)
+        assert det.tick(10.0) == []
+        verdicts = det.tick(16.0)  # 6s of silence > 5s deadline
+        assert len(verdicts) == 1
+        v = verdicts[0]
+        assert v["kind"] == "hang" and v["worker"] == "w0"
+        assert v["stepIndex"] == 3
+        assert v["stalledSeconds"] > 5.0
+        assert v["stackThreads"]  # forensic dump captured
+        assert METRICS.value("training_hangs_detected_total", worker="w0") == 1
+        assert det.snapshot()["lastHangVerdict"]["worker"] == "w0"
+        # the verdict latches: the same stall never double-fires
+        assert det.tick(30.0) == []
+        assert METRICS.value("training_hangs_detected_total", worker="w0") == 1
+
+    def test_incarnation_restart_resets_step_index_without_hang(self):
+        """The issue's headline edge case: a new incarnation replaying from
+        step 0 is recovery, never a hang — even when the restore gap
+        exceeds the hang deadline."""
+        tsdb = TSDB()
+        det = make_detector(tsdb, hang_deadline_s=5.0)
+        feed(tsdb, "w0", 10.0, wall=0.1, step=7)
+        det.tick(10.0)
+        # restart: incarnation bumps, step index resets to 0, and the tick
+        # lands well past the old incarnation's hang deadline
+        feed(tsdb, "w0", 30.0, wall=0.1, step=0, incarnation=1)
+        assert det.tick(30.0) == []
+        assert METRICS.value("training_hangs_detected_total", worker="w0") == 0
+        snap = det.snapshot()["workers"]["w0"]
+        assert snap["hung"] is False and snap["stepIndex"] == 0
+        # the hang clock restarted at the restart — a fresh deadline must
+        # elapse before a post-restart stall matures into a verdict
+        assert det.tick(33.0) == []
+        verdicts = det.tick(36.5)
+        assert [v["worker"] for v in verdicts] == ["w0"]
+        assert verdicts[0]["incarnation"] == 1
+
+    def test_step_counter_reset_alone_reads_as_restart(self):
+        """Counter-reset awareness without the incarnation gauge: the step
+        index moving backwards is itself proof of a restart (the gauge may
+        federate a scrape later)."""
+        tsdb = TSDB()
+        det = make_detector(tsdb, hang_deadline_s=5.0)
+        feed(tsdb, "w0", 10.0, wall=0.1, step=9)
+        det.tick(10.0)
+        feed(tsdb, "w0", 30.0, wall=0.1, step=0)  # incarnation still 0
+        assert det.tick(30.0) == []
+        assert METRICS.value("training_hangs_detected_total", worker="w0") == 0
+
+    def test_worker_that_never_progressed_is_not_a_hang(self):
+        tsdb = TSDB()
+        det = make_detector(tsdb, hang_deadline_s=2.0)
+        feed(tsdb, "w0", 10.0, wall=0.0, step=-1)  # beacon built, no step yet
+        det.tick(10.0)
+        assert det.tick(100.0) == []
+
+
+# -- remediation: quarantine + drain ------------------------------------------
+
+
+def _gang_pod(name, gang, node, size=2):
+    pod = new_object(
+        "v1", "Pod", name, "default",
+        labels={POD_GROUP_LABEL: gang},
+        annotations={"scheduling.kubeflow.org/pod-group-size": str(size)},
+        spec={"nodeName": node},
+        status={"phase": "Running"},
+    )
+    return pod
+
+
+class TestRemediation:
+    def _hang(self, det, tsdb, worker, t0=10.0):
+        feed(tsdb, worker, t0, wall=0.1, step=3)
+        det.tick(t0)
+        return det.tick(t0 + det.hang_deadline_s + 5.0)
+
+    def test_hang_quarantines_node_and_drains_gang(self, client):
+        client.create(make_tpu_node("node-a", "v5e", "2x2", 4))
+        client.create(_gang_pod("w0", "g1", "node-a"))
+        client.create(_gang_pod("w1", "g1", "node-a"))
+        tsdb = TSDB()
+        det = make_detector(tsdb, client=client, hang_deadline_s=2.0)
+        verdicts = self._hang(det, tsdb, "w0")
+        assert verdicts and verdicts[0]["node"] == "node-a"
+        assert verdicts[0]["gang"] == "g1"
+        node = client.get_opt("v1", "Node", "node-a", None)
+        assert is_quarantined(node)
+        assert "w0" in annotations_of(node)[QUARANTINE_ANNOTATION]
+        # the whole gang gets drain deadlines, not just the hung worker
+        for name in ("w0", "w1"):
+            pod = client.get_opt("v1", "Pod", name, "default")
+            assert DRAIN_DEADLINE_ANNOTATION in annotations_of(pod)
+        assert det.snapshot()["quarantined"] == ["node-a"]
+        reasons = {e["reason"] for e in client.list("v1", "Event", "default")}
+        assert "WorkerHung" in reasons
+        assert "NodeQuarantined" in reasons
+
+    def test_quarantine_idempotent_under_informer_echo(self, client):
+        client.create(make_tpu_node("node-a", "v5e", "2x2", 4))
+        client.create(_gang_pod("w0", "g1", "node-a"))
+        tsdb = TSDB()
+        det = make_detector(tsdb, client=client, hang_deadline_s=2.0)
+        patches = []
+        real_patch = client.patch
+
+        def counting_patch(api, kind, name, body, ns=None, **kw):
+            if kind == "Node":
+                patches.append(name)
+            return real_patch(api, kind, name, body, ns, **kw)
+
+        client.patch = counting_patch
+        try:
+            assert self._hang(det, tsdb, "w0")
+            assert patches == ["node-a"]
+            stamped = annotations_of(
+                client.get_opt("v1", "Node", "node-a", None)
+            )[QUARANTINE_ANNOTATION]
+            # a second detector (fresh cordon set — the informer-echo /
+            # restarted-detector shape) sees the annotation and never
+            # re-patches the node
+            det2 = make_detector(tsdb, client=client, hang_deadline_s=2.0)
+            assert self._hang(det2, tsdb, "w0")
+            assert patches == ["node-a"]
+            assert annotations_of(
+                client.get_opt("v1", "Node", "node-a", None)
+            )[QUARANTINE_ANNOTATION] == stamped
+            assert det2.snapshot()["quarantined"] == ["node-a"]
+        finally:
+            client.patch = real_patch
+
+    def test_drain_idempotent_when_deadline_already_stamped(self, client):
+        client.create(make_tpu_node("node-a", "v5e", "2x2", 4))
+        pod = _gang_pod("w0", "g1", "node-a")
+        pod["metadata"]["annotations"][DRAIN_DEADLINE_ANNOTATION] = "123.0"
+        client.create(pod)
+        tsdb = TSDB()
+        det = make_detector(tsdb, client=client, hang_deadline_s=2.0)
+        assert self._hang(det, tsdb, "w0")
+        anns = annotations_of(client.get_opt("v1", "Pod", "w0", "default"))
+        assert anns[DRAIN_DEADLINE_ANNOTATION] == "123.0"  # untouched
+
+    def test_hang_verdict_attaches_to_federated_trace(self, client):
+        traces = TraceCollector()
+        trace_id = "0af7651916cd43dd8448eb211c80319c"
+        # the gang's bind journey federated one span under this trace id
+        traces.ingest({"resourceSpans": [{
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": "scheduler"}},
+                {"key": "service.instance.id",
+                 "value": {"stringValue": "h:1"}},
+            ]},
+            "scopeSpans": [{"scope": {"name": "test"}, "spans": [{
+                "traceId": trace_id, "spanId": "b7ad6b7169203331",
+                "name": "gang.bind",
+                "startTimeUnixNano": 1_000, "endTimeUnixNano": 2_000,
+                "status": {"code": "OK", "message": ""},
+                "attributes": {"service.name": "scheduler"},
+            }]}],
+        }]})
+        pod = _gang_pod("w0", "g1", "node-a")
+        pod["metadata"]["annotations"][BIND_TRACEPARENT_ANNOTATION] = \
+            f"00-{trace_id}-b7ad6b7169203331-01"
+        client.create(make_tpu_node("node-a", "v5e", "2x2", 4))
+        client.create(pod)
+        tsdb = TSDB()
+        det = make_detector(
+            tsdb, client=client, hang_deadline_s=2.0, traces=traces)
+        assert self._hang(det, tsdb, "w0")
+        got = traces.trace(trace_id)
+        assert got["verdicts"][0]["kind"] == "hang"
+        assert got["verdicts"][0]["worker"] == "w0"
+
+
+# -- ledger cordon ------------------------------------------------------------
+
+
+class TestLedgerCordon:
+    def _node(self, name, chips=4):
+        return make_tpu_node(name, "v5e", "2x2", chips)
+
+    def _quarantined_node(self, name, chips=4):
+        node = self._node(name, chips)
+        node["metadata"].setdefault("annotations", {})[
+            QUARANTINE_ANNOTATION] = '{"reason": "hang"}'
+        return node
+
+    def test_placement_skips_cordoned_node(self):
+        for use_index in (True, False):
+            led = ChipLedger()
+            led.on_node_event("ADDED", self._quarantined_node("bad"))
+            led.on_node_event("ADDED", self._node("good"))
+            got = led.place_and_reserve(
+                (None, "g"), [(4, {})], ttl=None, now=1.0,
+                use_index=use_index)
+            assert got == ["good"], f"use_index={use_index}"
+
+    def test_cordoned_only_cluster_is_infeasible(self):
+        led = ChipLedger()
+        led.on_node_event("ADDED", self._quarantined_node("bad"))
+        assert led.place_and_reserve(
+            (None, "g"), [(1, {})], ttl=None, now=1.0) is None
+
+    def test_explain_says_quarantined(self):
+        led = ChipLedger()
+        led.on_node_event("ADDED", self._quarantined_node("bad"))
+        led.on_node_event("ADDED", self._node("good"))
+        verdicts = {v["node"]: v["reason"]
+                    for v in led.explain((None, "g"), [(4, {})], now=1.0)}
+        assert verdicts == {"bad": "quarantined", "good": "feasible"}
+
+    def test_uncordon_restores_node(self):
+        led = ChipLedger()
+        led.on_node_event("ADDED", self._quarantined_node("n0"))
+        assert led.place_and_reserve(
+            (None, "g"), [(4, {})], ttl=None, now=1.0) is None
+        led.on_node_event("MODIFIED", self._node("n0"))  # annotation cleared
+        assert led.snapshot()["cordoned"] == []
+        assert led.place_and_reserve(
+            (None, "g"), [(4, {})], ttl=None, now=2.0) == ["n0"]
+
+    def test_mid_life_cordon_and_snapshot(self):
+        led = ChipLedger()
+        led.on_node_event("ADDED", self._node("n0"))
+        led.on_node_event("MODIFIED", self._quarantined_node("n0"))
+        assert led.snapshot()["cordoned"] == ["n0"]
+        assert led.place_and_reserve(
+            (None, "g"), [(1, {})], ttl=None, now=1.0) is None
+        assert [v["reason"] for v in led.explain((None, "g"), [(1, {})],
+                                                 now=1.0)] == ["quarantined"]
+
+
+# -- beacon + chaos injectors -------------------------------------------------
+
+
+class TestBeacon:
+    def test_publish_lands_worker_metrics(self):
+        b = WorkerBeacon("w0")
+        b.begin_incarnation(2)
+        b.publish({"total": 0.5, "compute": 0.3, "collective_wait": 0.1}, step=4)
+        assert METRICS.value("training_worker_incarnation", worker="w0") == 2.0
+        assert METRICS.value("training_worker_step_index", worker="w0") == 4.0
+        assert METRICS.value(
+            "training_worker_step_wall_seconds", worker="w0") == 0.5
+        assert METRICS.value("training_worker_step_total", worker="w0") == 1
+        assert METRICS.value(
+            "training_worker_phase_seconds", worker="w0",
+            phase="collective_wait") == pytest.approx(0.1)
+        assert METRICS.value(
+            "training_worker_phase_seconds", worker="w0",
+            phase="data_wait") == 0.0
+
+    def test_analytic_collective_floor_when_unmeasured(self):
+        b = WorkerBeacon("w0", expected_collective_s=lambda: 0.02)
+        b.publish({"total": 0.5})
+        assert METRICS.value(
+            "training_worker_phase_seconds", worker="w0",
+            phase="collective_wait") == pytest.approx(0.02)
+
+    def test_incarnation_restart_resets_local_step_counter(self):
+        b = WorkerBeacon("w0")
+        b.publish({"total": 0.1})
+        b.publish({"total": 0.1})
+        assert b.step_index == 1
+        b.begin_incarnation(1)
+        assert b.step_index == -1
+        b.publish({"total": 0.1})
+        assert b.step_index == 0
+
+    def test_slow_factor_stretches_throttle(self):
+        b = WorkerBeacon("w0", step_delay_s=0.02)
+        base = b.throttle()
+        b.slow_factor = 5.0
+        slowed = b.throttle()
+        assert slowed > base * 2
+
+    def test_wedge_parks_and_release_frees(self):
+        b = WorkerBeacon("w0")
+        b.wedge()
+        done = threading.Event()
+
+        def run():
+            b.throttle()
+            done.set()
+
+        t = threading.Thread(target=run, name="worker-sim-0", daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert not done.is_set()
+        # the forensic: a live stack dump names the wedged frame
+        dump = capture_stacks(reason="test-wedge")
+        frames = {
+            f["function"]
+            for th in dump["threads"] for f in th["frames"]
+        }
+        assert "_wedge_wait" in frames
+        wedged = [th for th in dump["threads"]
+                  if any(f["function"] == "_wedge_wait" for f in th["frames"])]
+        assert wedged and wedged[0]["thread"] == "worker-sim-N"  # digits collapsed
+        b.release()
+        assert done.wait(2.0)
+        t.join(timeout=2.0)
+
+
+class TestChaosInjectors:
+    def _monkey(self, client):
+        return ChaosMonkey(client, ChaosSchedule([]))
+
+    def test_slow_worker_bounded_and_reset_on_stop(self, client):
+        b = WorkerBeacon("w0")
+        monkey = self._monkey(client)
+        monkey.inject(Fault(at=0, kind="slow_worker", target="w0", param=4.0))
+        assert b.slow_factor == 4.0
+        assert METRICS.value(
+            "chaos_faults_injected_total", kind="slow_worker") == 1
+        monkey.stop()
+        assert b.slow_factor == 1.0
+
+    def test_slow_worker_duration_expires(self, client):
+        b = WorkerBeacon("w0")
+        monkey = self._monkey(client)
+        monkey.inject(Fault(at=0, kind="slow_worker", target="w0",
+                            param=4.0, duration=0.1))
+        assert b.slow_factor == 4.0
+        deadline = time.monotonic() + 2.0
+        while b.slow_factor != 1.0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert b.slow_factor == 1.0
+        monkey.stop()
+
+    def test_wedge_worker_and_stop_releases(self, client):
+        b = WorkerBeacon("w0")
+        monkey = self._monkey(client)
+        monkey.inject(Fault(at=0, kind="wedge_worker", target="w0"))
+        assert b.wedged
+        assert METRICS.value(
+            "chaos_faults_injected_total", kind="wedge_worker") == 1
+        monkey.stop()
+        assert not b.wedged
+
+    def test_sole_worker_is_default_target(self, client):
+        b = WorkerBeacon("only")
+        monkey = self._monkey(client)
+        monkey.inject(Fault(at=0, kind="slow_worker", param=2.0))
+        assert b.slow_factor == 2.0
+        monkey.stop()
+
+    def test_targets_resolve_from_live_registry(self, client):
+        # beacons registered after the monkey was built are still reachable
+        monkey = self._monkey(client)
+        b = WorkerBeacon("late")
+        monkey.inject(Fault(at=0, kind="wedge_worker", target="late"))
+        assert b.wedged
+        monkey.stop()
+        assert beacons()["late"] is b
+
+
+# -- rules bundle -------------------------------------------------------------
+
+
+class TestStragglerRules:
+    def test_skew_recording_rule_ratio(self):
+        tsdb = TSDB()
+        feed(tsdb, "w0", 10.0, wall=0.1, step=1)
+        feed(tsdb, "w1", 10.0, wall=0.1, step=1)
+        feed(tsdb, "w2", 10.0, wall=0.4, step=1)
+        rules = straggler_rules()
+        rec = rules[0]
+        assert rec.record == "platform:training_worker_step_skew"
+        rows = rec.fn(tsdb, 10.0)
+        assert rows[0][1] == pytest.approx(4.0)
+
+    def test_skew_rule_silent_on_single_worker(self):
+        tsdb = TSDB()
+        feed(tsdb, "w0", 10.0, wall=0.1, step=1)
+        assert straggler_rules()[0].fn(tsdb, 10.0) == []

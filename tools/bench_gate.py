@@ -111,6 +111,12 @@ SPECS: Dict[str, Tuple[str, float]] = {
     # wobbles with compile time — wide band; the absolute floor below is
     # the real guard.
     "training_goodput_fraction": ("higher", 0.50),
+    # ISSUE-20 straggler rows (e2e/straggler_driver.py → STRAGGLER_r*.json):
+    # detection latencies are quantized by the monitoring tick cadence and
+    # the hang deadline, then jittered by scrape/publish phase alignment —
+    # much wider than the 10% a `seconds` name would get by default.
+    "straggler_detect_seconds": ("lower", 0.50),
+    "hang_detect_seconds": ("lower", 0.50),
 }
 
 #: Absolute flagship floors: {metric: (floor, applies_from_round)} — checked
@@ -223,14 +229,14 @@ def load_history(history_dir: Path, exclude: List[str],
     """All rounds' metrics, keyed by round number, BENCH_* and MULTICHIP_*
     files of the same round merged. ``exclude`` drops rounds by "rNN".
     ``family`` restricts to one history family ("BENCH" / "MULTICHIP" /
-    "CONTROLPLANE" / "GOODPUT") — families number their rounds independently, so the
+    "CONTROLPLANE" / "GOODPUT" / "STRAGGLER") — families number their rounds independently, so the
     CLI gates each family at its own newest round (a CONTROLPLANE_r02
     landing next to BENCH_r06 is still gated against CONTROLPLANE_r01
     rather than skipped for not being the globally newest round)."""
     skip = {int(e.lstrip("rR")) for e in exclude}
     rounds: Dict[int, Dict[str, float]] = {}
     for path in sorted(history_dir.glob("*.json")):
-        m = re.fullmatch(r"(BENCH|MULTICHIP|CONTROLPLANE|GOODPUT)_r(\d+)\.json",
+        m = re.fullmatch(r"(BENCH|MULTICHIP|CONTROLPLANE|GOODPUT|STRAGGLER)_r(\d+)\.json",
                          path.name)
         if not m or int(m.group(2)) in skip:
             continue
@@ -244,7 +250,7 @@ def load_history(history_dir: Path, exclude: List[str],
     return rounds
 
 
-FAMILIES = ("BENCH", "MULTICHIP", "CONTROLPLANE", "GOODPUT")
+FAMILIES = ("BENCH", "MULTICHIP", "CONTROLPLANE", "GOODPUT", "STRAGGLER")
 
 
 def gate(rounds: Dict[int, Dict[str, float]],
